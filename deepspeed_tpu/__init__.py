@@ -1,0 +1,34 @@
+"""DeepSpeed-TPU: a TPU-native large-scale training framework.
+
+Ground-up JAX/XLA/Pallas re-design with the capabilities of early DeepSpeed
+(reference: feifeibear/DeepSpeed v0.3.11; see SURVEY.md).  Public surface
+mirrors the reference ``deepspeed/__init__.py``: ``initialize()``,
+``add_config_arguments()``, plus the elasticity / checkpointing / ops
+subpackages.
+"""
+
+__version__ = "0.1.0"
+__git_hash__ = None
+__git_branch__ = None
+
+from . import comm  # noqa: F401
+from . import elasticity  # noqa: F401
+from .parallel import (CANONICAL_AXES, DATA_AXIS, MODEL_AXIS, PIPE_AXIS,  # noqa: F401
+                       SEQ_AXIS, MeshGrid, PipeDataParallelTopology,
+                       PipeModelDataParallelTopology, ProcessTopology, make_mesh)
+from .runtime.config import DeepSpeedConfig  # noqa: F401
+from .utils import init_distributed, log_dist, logger  # noqa: F401
+
+
+def initialize(*args, **kwargs):
+    """Engine factory (reference ``deepspeed/__init__.py:50-139``)."""
+    from .runtime.engine import initialize as _initialize
+
+    return _initialize(*args, **kwargs)
+
+
+def add_config_arguments(parser):
+    """Add --deepspeed / --deepspeed_config args (reference ``__init__.py:193``)."""
+    from .runtime.arguments import add_config_arguments as _add
+
+    return _add(parser)

@@ -1,0 +1,28 @@
+"""Flops-profiler config (reference ``deepspeed/profiling/config.py``)."""
+
+FLOPS_PROFILER = "flops_profiler"
+FLOPS_PROFILER_ENABLED = "enabled"
+FLOPS_PROFILER_ENABLED_DEFAULT = False
+FLOPS_PROFILER_PROFILE_STEP = "profile_step"
+FLOPS_PROFILER_PROFILE_STEP_DEFAULT = 1
+FLOPS_PROFILER_MODULE_DEPTH = "module_depth"
+FLOPS_PROFILER_MODULE_DEPTH_DEFAULT = -1
+FLOPS_PROFILER_TOP_MODULES = "top_modules"
+FLOPS_PROFILER_TOP_MODULES_DEFAULT = 3
+FLOPS_PROFILER_DETAILED = "detailed"
+FLOPS_PROFILER_DETAILED_DEFAULT = True
+
+
+class DeepSpeedFlopsProfilerConfig:
+    def __init__(self, param_dict):
+        d = param_dict.get(FLOPS_PROFILER, {})
+        self.enabled = d.get(FLOPS_PROFILER_ENABLED, FLOPS_PROFILER_ENABLED_DEFAULT)
+        self.profile_step = d.get(FLOPS_PROFILER_PROFILE_STEP, FLOPS_PROFILER_PROFILE_STEP_DEFAULT)
+        self.module_depth = d.get(FLOPS_PROFILER_MODULE_DEPTH, FLOPS_PROFILER_MODULE_DEPTH_DEFAULT)
+        self.top_modules = d.get(FLOPS_PROFILER_TOP_MODULES, FLOPS_PROFILER_TOP_MODULES_DEFAULT)
+        self.detailed = d.get(FLOPS_PROFILER_DETAILED, FLOPS_PROFILER_DETAILED_DEFAULT)
+
+    def repr(self):
+        return dict(enabled=self.enabled, profile_step=self.profile_step,
+                    module_depth=self.module_depth, top_modules=self.top_modules,
+                    detailed=self.detailed)

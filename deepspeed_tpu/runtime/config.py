@@ -1,0 +1,462 @@
+"""DeepSpeed-TPU config system.
+
+Behavioral port of the reference ``deepspeed/runtime/config.py``: one JSON
+file (or dict) parsed once into a typed config; the batch triple
+``train_batch_size = micro_batch_per_device × gradient_accumulation_steps ×
+data_parallel_size`` is solved/validated exactly as in the reference
+(``config.py:655-721``); feature subsections become typed sub-configs.
+
+TPU deltas:
+- ``world_size`` for the batch solver is the *data-parallel* mesh-axis size
+  (devices on the ``data`` axis), not a process count.
+- a ``mesh`` subsection declares the parallelism axes (data/model/pipe/seq);
+  in the reference this shape was implied by the launcher world size + mpu.
+- a ``bf16`` subsection: native TPU mixed precision, no loss scaling. The
+  reference's "ZeRO requires fp16" check (``config.py:746-756``) accepts
+  bf16 here.
+"""
+
+import json
+
+from ..utils.logging import logger
+from . import constants as C
+from .config_utils import dict_raise_error_on_duplicate_keys, get_scalar_param
+from .zero.config import DeepSpeedZeroConfig
+from .activation_checkpointing.config import DeepSpeedActivationCheckpointingConfig
+from ..profiling.config import DeepSpeedFlopsProfilerConfig
+
+TENSOR_CORE_ALIGN_SIZE = 8
+ADAM_OPTIMIZER = C.ADAM_OPTIMIZER
+LAMB_OPTIMIZER = C.LAMB_OPTIMIZER
+ONEBIT_ADAM_OPTIMIZER = C.ONEBIT_ADAM_OPTIMIZER
+DEEPSPEED_OPTIMIZERS = C.DEEPSPEED_OPTIMIZERS
+
+
+def get_fp16_enabled(param_dict):
+    if C.FP16 in param_dict:
+        return get_scalar_param(param_dict[C.FP16], C.FP16_ENABLED, C.FP16_ENABLED_DEFAULT)
+    return False
+
+
+def get_bf16_enabled(param_dict):
+    if C.BF16 in param_dict:
+        return get_scalar_param(param_dict[C.BF16], C.BF16_ENABLED, C.BF16_ENABLED_DEFAULT)
+    return False
+
+
+def get_loss_scale(param_dict):
+    if get_fp16_enabled(param_dict):
+        return get_scalar_param(param_dict[C.FP16], C.FP16_LOSS_SCALE, C.FP16_LOSS_SCALE_DEFAULT)
+    return C.FP16_LOSS_SCALE_DEFAULT
+
+
+def get_initial_dynamic_scale(param_dict):
+    if get_fp16_enabled(param_dict):
+        initial_scale_power = get_scalar_param(param_dict[C.FP16], C.FP16_INITIAL_SCALE_POWER,
+                                               C.FP16_INITIAL_SCALE_POWER_DEFAULT)
+    else:
+        initial_scale_power = C.FP16_INITIAL_SCALE_POWER_DEFAULT
+    return 2 ** initial_scale_power
+
+
+def get_dynamic_loss_scale_args(param_dict):
+    loss_scale_args = None
+    if get_fp16_enabled(param_dict):
+        fp16_dict = param_dict[C.FP16]
+        dynamic_props = [C.FP16_INITIAL_SCALE_POWER, C.FP16_LOSS_SCALE_WINDOW,
+                         C.FP16_MIN_LOSS_SCALE, C.FP16_HYSTERESIS]
+        if any(prop in fp16_dict for prop in dynamic_props):
+            init_scale = get_scalar_param(fp16_dict, C.FP16_INITIAL_SCALE_POWER,
+                                          C.FP16_INITIAL_SCALE_POWER_DEFAULT)
+            scale_window = get_scalar_param(fp16_dict, C.FP16_LOSS_SCALE_WINDOW,
+                                            C.FP16_LOSS_SCALE_WINDOW_DEFAULT)
+            delayed_shift = get_scalar_param(fp16_dict, C.FP16_HYSTERESIS,
+                                             C.FP16_HYSTERESIS_DEFAULT)
+            min_loss_scale = get_scalar_param(fp16_dict, C.FP16_MIN_LOSS_SCALE,
+                                              C.FP16_MIN_LOSS_SCALE_DEFAULT)
+            loss_scale_args = {
+                "init_scale": 2 ** init_scale,
+                "scale_window": scale_window,
+                "delayed_shift": delayed_shift,
+                "min_scale": min_loss_scale,
+            }
+    return loss_scale_args
+
+
+def get_optimizer_name(param_dict):
+    if C.OPTIMIZER in param_dict and C.TYPE in param_dict[C.OPTIMIZER]:
+        return param_dict[C.OPTIMIZER][C.TYPE]
+    return C.OPTIMIZER_TYPE_DEFAULT
+
+
+def get_optimizer_params(param_dict):
+    if get_optimizer_name(param_dict) is not None and C.OPTIMIZER_PARAMS in param_dict[C.OPTIMIZER]:
+        return param_dict[C.OPTIMIZER][C.OPTIMIZER_PARAMS]
+    return None
+
+
+def get_scheduler_name(param_dict):
+    if C.SCHEDULER in param_dict and C.TYPE in param_dict[C.SCHEDULER]:
+        return param_dict[C.SCHEDULER][C.TYPE]
+    return C.SCHEDULER_TYPE_DEFAULT
+
+
+def get_scheduler_params(param_dict):
+    if get_scheduler_name(param_dict) is not None and C.SCHEDULER_PARAMS in param_dict[C.SCHEDULER]:
+        return param_dict[C.SCHEDULER][C.SCHEDULER_PARAMS]
+    return None
+
+
+def get_sparse_attention(param_dict):
+    """Parse the sparse-attention subsection into a kwargs dict per mode
+    (reference ``config.py:192-360``)."""
+    if C.SPARSE_ATTENTION not in param_dict:
+        return None
+    sparsity = param_dict[C.SPARSE_ATTENTION]
+    mode = get_scalar_param(sparsity, C.SPARSE_MODE, C.SPARSE_MODE_DEFAULT)
+    common = {
+        C.SPARSE_MODE: mode,
+        C.SPARSE_BLOCK: get_scalar_param(sparsity, C.SPARSE_BLOCK, C.SPARSE_BLOCK_DEFAULT),
+        C.SPARSE_DIFFERENT_LAYOUT_PER_HEAD: get_scalar_param(
+            sparsity, C.SPARSE_DIFFERENT_LAYOUT_PER_HEAD,
+            C.SPARSE_DIFFERENT_LAYOUT_PER_HEAD_DEFAULT),
+    }
+    if mode == C.SPARSE_DENSE_MODE:
+        return common
+    if mode == C.SPARSE_FIXED_MODE:
+        extra = {
+            C.SPARSE_NUM_LOCAL_BLOCKS: get_scalar_param(
+                sparsity, C.SPARSE_NUM_LOCAL_BLOCKS, C.SPARSE_NUM_LOCAL_BLOCKS_DEFAULT),
+            C.SPARSE_NUM_GLOBAL_BLOCKS: get_scalar_param(
+                sparsity, C.SPARSE_NUM_GLOBAL_BLOCKS, C.SPARSE_NUM_GLOBAL_BLOCKS_DEFAULT),
+            C.SPARSE_ATTENTION_TYPE: get_scalar_param(
+                sparsity, C.SPARSE_ATTENTION_TYPE, C.SPARSE_ATTENTION_TYPE_DEFAULT),
+            C.SPARSE_HORIZONTAL_GLOBAL_ATTENTION: get_scalar_param(
+                sparsity, C.SPARSE_HORIZONTAL_GLOBAL_ATTENTION,
+                C.SPARSE_HORIZONTAL_GLOBAL_ATTENTION_DEFAULT),
+            C.SPARSE_NUM_DIFFERENT_GLOBAL_PATTERNS: get_scalar_param(
+                sparsity, C.SPARSE_NUM_DIFFERENT_GLOBAL_PATTERNS,
+                C.SPARSE_NUM_DIFFERENT_GLOBAL_PATTERNS_DEFAULT),
+        }
+    elif mode == C.SPARSE_VARIABLE_MODE:
+        extra = {
+            C.SPARSE_NUM_RANDOM_BLOCKS: get_scalar_param(
+                sparsity, C.SPARSE_NUM_RANDOM_BLOCKS, C.SPARSE_NUM_RANDOM_BLOCKS_DEFAULT),
+            C.SPARSE_LOCAL_WINDOW_BLOCKS: get_scalar_param(
+                sparsity, C.SPARSE_LOCAL_WINDOW_BLOCKS, C.SPARSE_LOCAL_WINDOW_BLOCKS_DEFAULT),
+            C.SPARSE_GLOBAL_BLOCK_INDICES: get_scalar_param(
+                sparsity, C.SPARSE_GLOBAL_BLOCK_INDICES, C.SPARSE_GLOBAL_BLOCK_INDICES_DEFAULT),
+            C.SPARSE_GLOBAL_BLOCK_END_INDICES: get_scalar_param(
+                sparsity, C.SPARSE_GLOBAL_BLOCK_END_INDICES,
+                C.SPARSE_GLOBAL_BLOCK_END_INDICES_DEFAULT),
+            C.SPARSE_ATTENTION_TYPE: get_scalar_param(
+                sparsity, C.SPARSE_ATTENTION_TYPE, C.SPARSE_ATTENTION_TYPE_DEFAULT),
+            C.SPARSE_HORIZONTAL_GLOBAL_ATTENTION: get_scalar_param(
+                sparsity, C.SPARSE_HORIZONTAL_GLOBAL_ATTENTION,
+                C.SPARSE_HORIZONTAL_GLOBAL_ATTENTION_DEFAULT),
+        }
+    elif mode == C.SPARSE_BIGBIRD_MODE:
+        extra = {
+            C.SPARSE_NUM_RANDOM_BLOCKS: get_scalar_param(
+                sparsity, C.SPARSE_NUM_RANDOM_BLOCKS, C.SPARSE_NUM_RANDOM_BLOCKS_DEFAULT),
+            C.SPARSE_NUM_SLIDING_WINDOW_BLOCKS: get_scalar_param(
+                sparsity, C.SPARSE_NUM_SLIDING_WINDOW_BLOCKS,
+                C.SPARSE_NUM_SLIDING_WINDOW_BLOCKS_DEFAULT),
+            C.SPARSE_NUM_GLOBAL_BLOCKS: get_scalar_param(
+                sparsity, C.SPARSE_NUM_GLOBAL_BLOCKS, C.SPARSE_NUM_GLOBAL_BLOCKS_DEFAULT),
+        }
+    elif mode == C.SPARSE_BSLONGFORMER_MODE:
+        extra = {
+            C.SPARSE_NUM_SLIDING_WINDOW_BLOCKS: get_scalar_param(
+                sparsity, C.SPARSE_NUM_SLIDING_WINDOW_BLOCKS,
+                C.SPARSE_NUM_SLIDING_WINDOW_BLOCKS_DEFAULT),
+            C.SPARSE_GLOBAL_BLOCK_INDICES: get_scalar_param(
+                sparsity, C.SPARSE_GLOBAL_BLOCK_INDICES, C.SPARSE_GLOBAL_BLOCK_INDICES_DEFAULT),
+            C.SPARSE_GLOBAL_BLOCK_END_INDICES: get_scalar_param(
+                sparsity, C.SPARSE_GLOBAL_BLOCK_END_INDICES,
+                C.SPARSE_GLOBAL_BLOCK_END_INDICES_DEFAULT),
+        }
+    else:
+        raise NotImplementedError(f"Given sparsity mode, {mode!r}, has not been implemented yet!")
+    common.update(extra)
+    return common
+
+
+def get_pipeline_config(param_dict):
+    """Pipeline subsection with defaults (reference ``config.py:363-374``)."""
+    default_pipeline = {
+        C.PIPELINE_STAGES: C.PIPELINE_STAGES_DEFAULT,
+        C.PIPELINE_PARTITION: C.PIPELINE_PARTITION_DEFAULT,
+        C.PIPELINE_SEED_LAYERS: C.PIPELINE_SEED_LAYERS_DEFAULT,
+        C.PIPELINE_ACTIVATION_CHECKPOINT_INTERVAL: C.PIPELINE_ACTIVATION_CHECKPOINT_INTERVAL_DEFAULT,
+    }
+    config = default_pipeline.copy()
+    for key, val in param_dict.get(C.PIPELINE, {}).items():
+        config[key] = val
+    return config
+
+
+def get_progressive_layer_drop(param_dict):
+    pld = param_dict.get(C.PROGRESSIVE_LAYER_DROP, {})
+    return {
+        "enabled": get_scalar_param(pld, C.PLD_ENABLED, C.PLD_ENABLED_DEFAULT),
+        "theta": get_scalar_param(pld, C.PLD_THETA, C.PLD_THETA_DEFAULT),
+        "gamma": get_scalar_param(pld, C.PLD_GAMMA, C.PLD_GAMMA_DEFAULT),
+    }
+
+
+def get_mesh_config(param_dict):
+    """TPU addition: mesh axis sizes (data/model/pipe/seq), defaults 1 with
+    ``data`` inferred (-1) from available devices when unspecified."""
+    mesh = dict(param_dict.get(C.MESH, {}))
+    mesh.setdefault(C.MESH_DATA, -1)
+    mesh.setdefault(C.MESH_MODEL, 1)
+    mesh.setdefault(C.MESH_PIPE, 1)
+    mesh.setdefault(C.MESH_SEQ, 1)
+    return mesh
+
+
+class DeepSpeedConfigError(Exception):
+    pass
+
+
+class DeepSpeedConfig:
+    def __init__(self, json_file_or_dict, mpu=None, param_dict=None, world_size=None):
+        if param_dict is None:
+            if isinstance(json_file_or_dict, dict):
+                self._param_dict = json_file_or_dict
+            else:
+                with open(json_file_or_dict, "r") as f:
+                    self._param_dict = json.load(
+                        f, object_pairs_hook=dict_raise_error_on_duplicate_keys)
+        else:
+            self._param_dict = param_dict
+
+        # Data-parallel world size for the batch solver.  Priority: explicit
+        # argument > mpu > mesh subsection > all visible devices.  (The
+        # reference used torch.distributed world size / mpu,
+        # config.py:520-537.)
+        if world_size is not None:
+            self.world_size = world_size
+        elif mpu is not None:
+            self.world_size = mpu.get_data_parallel_world_size()
+        else:
+            mesh = get_mesh_config(self._param_dict)
+            dp = mesh[C.MESH_DATA]
+            if dp == -1:
+                try:
+                    import jax
+
+                    denom = mesh[C.MESH_MODEL] * mesh[C.MESH_PIPE] * mesh[C.MESH_SEQ]
+                    dp = max(1, jax.device_count() // max(denom, 1))
+                except Exception:
+                    dp = 1
+            self.world_size = dp
+
+        # Elasticity may override the batch triple before parsing
+        # (reference config.py:538-588).
+        from ..elasticity import (compute_elastic_config, elasticity_enabled,
+                                  ensure_immutable_elastic_config)
+        from ..elasticity.config import ElasticityConfigError
+        from ..elasticity.constants import (ELASTICITY, IGNORE_NON_ELASTIC_BATCH_INFO,
+                                            IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT)
+        self.elasticity_enabled = elasticity_enabled(self._param_dict)
+        if self.elasticity_enabled:
+            logger.info("DeepSpeed elasticity support enabled")
+            final_batch_size, valid_gpus, micro_batch_size = compute_elastic_config(
+                ds_config=self._param_dict, target_deepspeed_version="0",
+                world_size=self.world_size)
+            elastic_dict = self._param_dict[ELASTICITY]
+            ensure_immutable_elastic_config(runtime_elastic_config_dict=elastic_dict)
+
+            if not elastic_dict.get(IGNORE_NON_ELASTIC_BATCH_INFO,
+                                    IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT):
+                batch_params = [C.TRAIN_BATCH_SIZE, C.TRAIN_MICRO_BATCH_SIZE_PER_GPU,
+                                C.GRADIENT_ACCUMULATION_STEPS]
+                if any(t in self._param_dict for t in batch_params):
+                    raise ElasticityConfigError(
+                        "One or more batch related parameters were found in your ds_config. "
+                        "These parameters *will not be used* since elastic training is "
+                        "enabled, which takes control of these parameters. To suppress this "
+                        f"error set '{IGNORE_NON_ELASTIC_BATCH_INFO}':true in your "
+                        "elasticity config.")
+
+            gradient_accu_steps = final_batch_size // (micro_batch_size * self.world_size)
+            logger.info(f"[Elasticity] valid device counts: {valid_gpus}")
+            self._param_dict[C.TRAIN_BATCH_SIZE] = final_batch_size
+            self._param_dict[C.TRAIN_MICRO_BATCH_SIZE_PER_GPU] = micro_batch_size
+            self._param_dict[C.GRADIENT_ACCUMULATION_STEPS] = gradient_accu_steps
+
+        self._initialize_params(self._param_dict)
+        self._configure_train_batch_size()
+        self._do_sanity_check()
+
+    def _initialize_params(self, param_dict):
+        self.train_batch_size = get_scalar_param(param_dict, C.TRAIN_BATCH_SIZE,
+                                                 C.TRAIN_BATCH_SIZE_DEFAULT)
+        self.train_micro_batch_size_per_gpu = get_scalar_param(
+            param_dict, C.TRAIN_MICRO_BATCH_SIZE_PER_GPU,
+            C.TRAIN_MICRO_BATCH_SIZE_PER_GPU_DEFAULT)
+        self.gradient_accumulation_steps = get_scalar_param(
+            param_dict, C.GRADIENT_ACCUMULATION_STEPS, C.GRADIENT_ACCUMULATION_STEPS_DEFAULT)
+        self.steps_per_print = get_scalar_param(param_dict, C.STEPS_PER_PRINT,
+                                                C.STEPS_PER_PRINT_DEFAULT)
+        self.dump_state = get_scalar_param(param_dict, C.DUMP_STATE, C.DUMP_STATE_DEFAULT)
+
+        self.disable_allgather = get_scalar_param(param_dict, C.DISABLE_ALLGATHER,
+                                                  C.DISABLE_ALLGATHER_DEFAULT)
+        self.allreduce_always_fp32 = get_scalar_param(param_dict, C.FP32_ALLREDUCE,
+                                                      C.FP32_ALLREDUCE_DEFAULT)
+        self.prescale_gradients = get_scalar_param(param_dict, C.PRESCALE_GRADIENTS,
+                                                   C.PRESCALE_GRADIENTS_DEFAULT)
+        self.gradient_predivide_factor = get_scalar_param(
+            param_dict, C.GRADIENT_PREDIVIDE_FACTOR, C.GRADIENT_PREDIVIDE_FACTOR_DEFAULT)
+        self.sparse_gradients_enabled = get_scalar_param(param_dict, C.SPARSE_GRADIENTS,
+                                                         C.SPARSE_GRADIENTS_DEFAULT)
+
+        self.zero_config = DeepSpeedZeroConfig(param_dict)
+        self.zero_optimization_stage = self.zero_config.stage
+        self.zero_enabled = self.zero_optimization_stage > 0
+
+        self.activation_checkpointing_config = DeepSpeedActivationCheckpointingConfig(param_dict)
+        self.flops_profiler_config = DeepSpeedFlopsProfilerConfig(param_dict)
+
+        self.fp16_enabled = get_fp16_enabled(param_dict)
+        self.bf16_enabled = get_bf16_enabled(param_dict)
+        self.loss_scale = get_loss_scale(param_dict)
+        self.initial_dynamic_scale = get_initial_dynamic_scale(param_dict)
+        self.dynamic_loss_scale_args = get_dynamic_loss_scale_args(param_dict)
+
+        self.gradient_clipping = get_scalar_param(param_dict, C.GRADIENT_CLIPPING,
+                                                  C.GRADIENT_CLIPPING_DEFAULT)
+
+        self.optimizer_name = get_optimizer_name(param_dict)
+        if self.optimizer_name is not None and self.optimizer_name.lower() in DEEPSPEED_OPTIMIZERS:
+            self.optimizer_name = self.optimizer_name.lower()
+        self.optimizer_params = get_optimizer_params(param_dict)
+        self.optimizer_legacy_fusion = get_scalar_param(
+            param_dict.get(C.OPTIMIZER, {}), C.LEGACY_FUSION, C.LEGACY_FUSION_DEFAULT)
+        self.zero_allow_untested_optimizer = get_scalar_param(
+            param_dict, C.ZERO_ALLOW_UNTESTED_OPTIMIZER, C.ZERO_ALLOW_UNTESTED_OPTIMIZER_DEFAULT)
+
+        self.scheduler_name = get_scheduler_name(param_dict)
+        self.scheduler_params = get_scheduler_params(param_dict)
+
+        self.wall_clock_breakdown = get_scalar_param(param_dict, C.WALL_CLOCK_BREAKDOWN,
+                                                     C.WALL_CLOCK_BREAKDOWN_DEFAULT)
+        self.memory_breakdown = get_scalar_param(param_dict, C.MEMORY_BREAKDOWN,
+                                                 C.MEMORY_BREAKDOWN_DEFAULT)
+
+        tb = param_dict.get(C.TENSORBOARD, {})
+        self.tensorboard_enabled = get_scalar_param(tb, C.TENSORBOARD_ENABLED,
+                                                    C.TENSORBOARD_ENABLED_DEFAULT)
+        self.tensorboard_output_path = get_scalar_param(tb, C.TENSORBOARD_OUTPUT_PATH,
+                                                        C.TENSORBOARD_OUTPUT_PATH_DEFAULT)
+        self.tensorboard_job_name = get_scalar_param(tb, C.TENSORBOARD_JOB_NAME,
+                                                     C.TENSORBOARD_JOB_NAME_DEFAULT)
+
+        self.sparse_attention = get_sparse_attention(param_dict)
+        self.pipeline = get_pipeline_config(param_dict)
+        self.pld_enabled = get_progressive_layer_drop(param_dict)["enabled"]
+        self.pld_params = get_progressive_layer_drop(param_dict)
+        self.mesh_config = get_mesh_config(param_dict)
+
+    def _batch_assertion(self):
+        train_batch = self.train_batch_size
+        micro_batch = self.train_micro_batch_size_per_gpu
+        grad_acc = self.gradient_accumulation_steps
+        assert train_batch > 0, f"Train batch size: {train_batch} has to be greater than 0"
+        assert micro_batch > 0, f"Micro batch size per gpu: {micro_batch} has to be greater than 0"
+        assert grad_acc > 0, f"Gradient accumulation steps: {grad_acc} has to be greater than 0"
+        assert train_batch == micro_batch * grad_acc * self.world_size, (
+            f"Check batch related parameters. train_batch_size is not equal"
+            f" to micro_batch_per_gpu * gradient_acc_step * world_size"
+            f" {train_batch} != {micro_batch} * {grad_acc} * {self.world_size}")
+
+    def _set_batch_related_parameters(self):
+        """Solve the batch triple given any subset (reference ``config.py:675-721``)."""
+        train_batch = self.train_batch_size
+        micro_batch = self.train_micro_batch_size_per_gpu
+        grad_acc = self.gradient_accumulation_steps
+
+        # all values are provided nothing needs to be set
+        if train_batch is not None and micro_batch is not None and grad_acc is not None:
+            return
+        # global_accumulation_steps needs to be set
+        elif train_batch is not None and micro_batch is not None:
+            grad_acc = train_batch // micro_batch
+            grad_acc //= self.world_size
+            self.gradient_accumulation_steps = grad_acc
+        # micro_batch_per_gpu needs to be set
+        elif train_batch is not None and grad_acc is not None:
+            micro_batch = train_batch // self.world_size
+            micro_batch //= grad_acc
+            self.train_micro_batch_size_per_gpu = micro_batch
+        # train_batch_size needs to be set
+        elif micro_batch is not None and grad_acc is not None:
+            train_batch_size = micro_batch * grad_acc
+            train_batch_size *= self.world_size
+            self.train_batch_size = train_batch_size
+        # gradient_accumulation_steps and micro_batch_per_gpus is set
+        elif train_batch is not None:
+            self.gradient_accumulation_steps = 1
+            self.train_micro_batch_size_per_gpu = train_batch // self.world_size
+        # train_batch_size and gradient_accumulation_step is set
+        elif micro_batch is not None:
+            self.train_batch_size = micro_batch * self.world_size
+            self.gradient_accumulation_steps = 1
+        else:
+            raise DeepSpeedConfigError(
+                "Either train_batch_size or train_micro_batch_size_per_gpu needs to be provided")
+
+    def _configure_train_batch_size(self):
+        self._set_batch_related_parameters()
+        self._batch_assertion()
+
+    def _do_sanity_check(self):
+        self._do_error_check()
+        self._do_warning_check()
+
+    def _do_error_check(self):
+        assert self.train_micro_batch_size_per_gpu, (
+            f"DeepSpeedConfig: {C.TRAIN_MICRO_BATCH_SIZE_PER_GPU} is not defined")
+        assert self.gradient_accumulation_steps, (
+            f"DeepSpeedConfig: {C.GRADIENT_ACCUMULATION_STEPS} is not defined")
+        if self.zero_enabled:
+            # The reference demands fp16 under ZeRO (config.py:746-756); on
+            # TPU bf16 satisfies the same requirement (sharded fp32 master +
+            # low-precision compute).  fp32 ZeRO is additionally allowed —
+            # sharding fp32 state is harmless under SPMD.
+            pass
+        if self.zero_config.cpu_offload:
+            assert self.zero_optimization_stage >= C.ZERO_OPTIMIZATION_GRADIENTS, (
+                "DeepSpeedConfig: cpu-offload supported ZeRO stage is "
+                f"{C.ZERO_OPTIMIZATION_GRADIENTS}")
+        assert not (self.fp16_enabled and self.bf16_enabled), (
+            "fp16 and bf16 modes are mutually exclusive")
+
+    def _do_warning_check(self):
+        fp16_enabled = self.fp16_enabled
+        vocabulary_size = self._param_dict.get("vocabulary_size", None)
+        if vocabulary_size and vocabulary_size % TENSOR_CORE_ALIGN_SIZE != 0:
+            logger.warning(
+                f"DeepSpeedConfig: vocabulary size {vocabulary_size} is not aligned to "
+                f"{TENSOR_CORE_ALIGN_SIZE}, may import training performance")
+        if (self.optimizer_params is not None
+                and C.MAX_GRAD_NORM in self.optimizer_params.keys()
+                and self.optimizer_params[C.MAX_GRAD_NORM] > 0):
+            if fp16_enabled:
+                logger.warning(
+                    f"DeepSpeedConfig: In FP16 mode, DeepSpeed will pass {C.MAX_GRAD_NORM}:"
+                    f"{self.optimizer_params[C.MAX_GRAD_NORM]} to FP16 wrapper")
+            else:
+                logger.warning(
+                    f"DeepSpeedConfig: In FP32 mode, DeepSpeed does not permit MAX_GRAD_NORM"
+                    f" ({self.optimizer_params[C.MAX_GRAD_NORM]}) > 0, setting to zero")
+                self.optimizer_params[C.MAX_GRAD_NORM] = 0.0
+
+    def print(self, name="DeepSpeedConfig"):
+        logger.info(f"{name} is:")
+        for key in sorted(self.__dict__):
+            if key != "_param_dict":
+                logger.info(f"  {key:.<40}{self.__dict__[key]}")

@@ -1,0 +1,60 @@
+"""ZeRO config subsection (reference ``deepspeed/runtime/zero/config.py``)."""
+
+from ..config_utils import get_scalar_param
+from .. import constants as C
+
+
+class DeepSpeedZeroConfig:
+    def __init__(self, param_dict):
+        self.stage = C.ZERO_STAGE_DEFAULT
+        self.contiguous_gradients = C.ZERO_CONTIGUOUS_GRADIENTS_DEFAULT
+        self.reduce_scatter = C.ZERO_REDUCE_SCATTER_DEFAULT
+        self.reduce_bucket_size = C.ZERO_REDUCE_BUCKET_SIZE_DEFAULT
+        self.allgather_bucket_size = C.ZERO_ALLGATHER_BUCKET_SIZE_DEFAULT
+        self.overlap_comm = C.ZERO_OVERLAP_COMM_DEFAULT
+        self.cpu_offload = C.ZERO_CPU_OFFLOAD_DEFAULT
+        self.elastic_checkpoint = C.ZERO_ELASTIC_CHECKPOINT_DEFAULT
+
+        if C.ZERO_OPTIMIZATION in param_dict:
+            zero_config_dict = param_dict[C.ZERO_OPTIMIZATION]
+            # Deprecated boolean form "zero_optimization": true ⇒ stage 1
+            # (reference zero/config.py:35-48).
+            if isinstance(zero_config_dict, bool):
+                zero_config_dict = {
+                    C.ZERO_STAGE: 1 if zero_config_dict else 0
+                }
+        else:
+            zero_config_dict = {}
+        self._initialize(zero_config_dict)
+
+    def _initialize(self, d):
+        self.stage = get_scalar_param(d, C.ZERO_STAGE, C.ZERO_STAGE_DEFAULT)
+        assert 0 <= self.stage <= C.MAX_STAGE_ZERO_OPTIMIZATION, (
+            f"ZeRO stage must be in [0,{C.MAX_STAGE_ZERO_OPTIMIZATION}], got {self.stage}")
+        self.contiguous_gradients = get_scalar_param(d, C.ZERO_CONTIGUOUS_GRADIENTS,
+                                                     C.ZERO_CONTIGUOUS_GRADIENTS_DEFAULT)
+        self.reduce_bucket_size = get_scalar_param(d, C.ZERO_REDUCE_BUCKET_SIZE,
+                                                   C.ZERO_REDUCE_BUCKET_SIZE_DEFAULT)
+        self.reduce_scatter = get_scalar_param(d, C.ZERO_REDUCE_SCATTER,
+                                               C.ZERO_REDUCE_SCATTER_DEFAULT)
+        self.overlap_comm = get_scalar_param(d, C.ZERO_OVERLAP_COMM,
+                                             C.ZERO_OVERLAP_COMM_DEFAULT)
+        self.allgather_bucket_size = get_scalar_param(d, C.ZERO_ALLGATHER_BUCKET_SIZE,
+                                                      C.ZERO_ALLGATHER_BUCKET_SIZE_DEFAULT)
+        self.cpu_offload = get_scalar_param(d, C.ZERO_CPU_OFFLOAD,
+                                            C.ZERO_CPU_OFFLOAD_DEFAULT)
+        self.elastic_checkpoint = get_scalar_param(d, C.ZERO_ELASTIC_CHECKPOINT,
+                                                   C.ZERO_ELASTIC_CHECKPOINT_DEFAULT)
+
+    def repr(self):
+        return dict(stage=self.stage,
+                    contiguous_gradients=self.contiguous_gradients,
+                    reduce_scatter=self.reduce_scatter,
+                    reduce_bucket_size=self.reduce_bucket_size,
+                    allgather_bucket_size=self.allgather_bucket_size,
+                    overlap_comm=self.overlap_comm,
+                    cpu_offload=self.cpu_offload,
+                    elastic_checkpoint=self.elastic_checkpoint)
+
+    def __repr__(self):
+        return str(self.repr())

@@ -1,0 +1,3 @@
+from .logging import logger, log_dist
+from .timer import SynchronizedWallClockTimer, ThroughputTimer
+from .distributed import init_distributed, get_rank, get_world_size

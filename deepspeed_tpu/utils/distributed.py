@@ -1,0 +1,66 @@
+"""Multi-host bootstrap.
+
+TPU analog of ``deepspeed/utils/distributed.py:12-142`` in the reference.
+The reference wires up ``torch.distributed.init_process_group('nccl')`` from a
+MASTER_ADDR/RANK env dance (optionally discovered through mpi4py).  On TPU the
+runtime already knows the pod topology; ``jax.distributed.initialize()`` only
+needs a coordinator address and the process count, and single-host runs need
+no initialization at all.
+"""
+
+import os
+
+from .logging import logger
+
+_initialized = False
+
+
+def init_distributed(dist_backend: str = "xla",
+                     auto_mpi_discovery: bool = True,
+                     coordinator_address: str = None,
+                     num_processes: int = None,
+                     process_id: int = None,
+                     verbose: bool = True):
+    """Initialize multi-host JAX if the environment asks for it.
+
+    Env contract (set by our launcher, mirrors the reference launcher's
+    MASTER_ADDR/MASTER_PORT/WORLD_SIZE/RANK): ``DS_COORDINATOR``,
+    ``DS_NUM_PROCESSES``, ``DS_PROCESS_ID``.  No-op on single host.
+    """
+    global _initialized
+    if _initialized:
+        return
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get("DS_COORDINATOR")
+    num_processes = num_processes or int(os.environ.get("DS_NUM_PROCESSES", "0") or 0)
+    process_id = process_id if process_id is not None else (
+        int(os.environ["DS_PROCESS_ID"]) if "DS_PROCESS_ID" in os.environ else None)
+
+    if coordinator_address and num_processes > 1:
+        if verbose:
+            logger.info(
+                f"Initializing multi-host JAX: coordinator={coordinator_address} "
+                f"num_processes={num_processes} process_id={process_id}")
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    _initialized = True
+
+
+def get_rank() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def get_world_size() -> int:
+    try:
+        import jax
+
+        return jax.process_count()
+    except Exception:
+        return 1

@@ -1,0 +1,56 @@
+"""Logging utilities.
+
+TPU-native analog of the reference's ``deepspeed/utils/logging.py:7-56``
+(single framework logger + rank-filtered ``log_dist``).  On TPU the "rank"
+is ``jax.process_index()`` (one process per host under multi-host SPMD),
+not a per-device rank.
+"""
+
+import logging
+import sys
+from typing import Iterable, Optional
+
+_FORMAT = "[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s"
+
+
+class LoggerFactory:
+    @staticmethod
+    def create_logger(name: str = "DeepSpeedTPU", level=logging.INFO) -> logging.Logger:
+        if name is None:
+            raise ValueError("name for logger cannot be None")
+        formatter = logging.Formatter(_FORMAT)
+        logger_ = logging.getLogger(name)
+        logger_.setLevel(level)
+        logger_.propagate = False
+        if not logger_.handlers:
+            ch = logging.StreamHandler(stream=sys.stdout)
+            ch.setLevel(level)
+            ch.setFormatter(formatter)
+            logger_.addHandler(ch)
+        return logger_
+
+
+logger = LoggerFactory.create_logger()
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def log_dist(message: str, ranks: Optional[Iterable[int]] = None, level=logging.INFO) -> None:
+    """Log ``message`` only on the listed process indices (``[-1]`` or None = all).
+
+    Mirrors the rank-filtering semantics of the reference ``log_dist``
+    (``deepspeed/utils/logging.py:40-56``) with JAX process indices standing
+    in for torch.distributed ranks.
+    """
+    my_rank = _process_index()
+    ranks = list(ranks) if ranks is not None else []
+    should_log = not ranks or -1 in ranks or my_rank in ranks
+    if should_log:
+        logger.log(level, f"[Rank {my_rank}] {message}")

@@ -1,0 +1,160 @@
+"""Wall-clock + throughput timers.
+
+TPU analog of the reference's ``deepspeed/utils/timer.py``:
+- ``SynchronizedWallClockTimer`` (reference ``:19-94``) — named timers whose
+  start/stop fence outstanding device work.  The reference calls
+  ``torch.cuda.synchronize()``; here the fence is draining the async XLA
+  dispatch queue (``jax.block_until_ready`` has to be applied by callers on
+  their live arrays; as a global fence we submit and block on a trivial
+  computation, which orders after previously enqueued work on that device).
+- ``ThroughputTimer`` (reference ``:97-163``) — samples/sec with warmup skip.
+"""
+
+import time
+
+from .logging import logger
+
+
+def device_fence():
+    """Block until previously dispatched device computations complete."""
+    try:
+        import jax
+
+        # Effectively a barrier on the default device's execution stream:
+        # jax dispatches in order per device, so blocking on a fresh trivial
+        # computation flushes the queue.
+        jax.block_until_ready(jax.device_put(0))
+    except Exception:
+        pass
+
+
+class SynchronizedWallClockTimer:
+    """Named timers with device fencing, matching the reference API."""
+
+    class Timer:
+        def __init__(self, name):
+            self.name_ = name
+            self.elapsed_ = 0.0
+            self.started_ = False
+            self.start_time = time.time()
+
+        def start(self, sync=True):
+            assert not self.started_, f"{self.name_} timer has already been started"
+            if sync:
+                device_fence()
+            self.start_time = time.time()
+            self.started_ = True
+
+        def stop(self, sync=True):
+            assert self.started_, "timer is not started"
+            if sync:
+                device_fence()
+            self.elapsed_ += time.time() - self.start_time
+            self.started_ = False
+
+        def reset(self):
+            self.elapsed_ = 0.0
+            self.started_ = False
+
+        def elapsed(self, reset=True):
+            started_ = self.started_
+            if self.started_:
+                self.stop()
+            elapsed_ = self.elapsed_
+            if reset:
+                self.reset()
+            if started_:
+                self.start()
+            return elapsed_
+
+        def mean(self, count):
+            return self.elapsed(reset=False) / max(count, 1)
+
+    def __init__(self):
+        self.timers = {}
+
+    def __call__(self, name):
+        if name not in self.timers:
+            self.timers[name] = self.Timer(name)
+        return self.timers[name]
+
+    @staticmethod
+    def memory_usage():
+        try:
+            import jax
+
+            stats = jax.local_devices()[0].memory_stats() or {}
+            alloc = stats.get("bytes_in_use", 0) / (1024.0 * 1024.0 * 1024.0)
+            peak = stats.get("peak_bytes_in_use", 0) / (1024.0 * 1024.0 * 1024.0)
+            return f"mem allocated {alloc:.4f} GB peak {peak:.4f} GB"
+        except Exception:
+            return "mem stats unavailable"
+
+    def log(self, names, normalizer=1.0, reset=True, memory_breakdown=False, ranks=None):
+        assert normalizer > 0.0
+        string = "time (ms)"
+        for name in names:
+            if name in self.timers:
+                elapsed_time = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                string += f" | {name}: {elapsed_time:.2f}"
+        logger.info(string)
+
+
+class ThroughputTimer:
+    """Samples/sec with warm-up skipping (reference ``timer.py:97-163``)."""
+
+    def __init__(self, batch_size, num_workers, start_step=2, steps_per_output=50, monitor_memory=False, logging_fn=None):
+        self.start_time = 0
+        self.end_time = 0
+        self.started = False
+        self.batch_size = max(batch_size, 1)
+        self.num_workers = num_workers
+        self.start_step = start_step
+        self.epoch_count = 0
+        self.micro_step_count = 0
+        self.global_step_count = 0
+        self.total_elapsed_time = 0
+        self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory
+        self.logging = logging_fn or logger.info
+        self.initialized = False
+
+    def update_epoch_count(self):
+        self.epoch_count += 1
+        self.micro_step_count = 0
+
+    def _init_timer(self):
+        self.initialized = True
+
+    def start(self):
+        self._init_timer()
+        self.started = True
+        if self.global_step_count >= self.start_step:
+            device_fence()
+            self.start_time = time.time()
+
+    def stop(self, report_speed=True):
+        if not self.started:
+            return
+        self.started = False
+        self.micro_step_count += 1
+        self.global_step_count += 1
+        if self.start_time > 0:
+            device_fence()
+            self.end_time = time.time()
+            duration = self.end_time - self.start_time
+            self.total_elapsed_time += duration
+            if self.global_step_count % self.steps_per_output == 0 and report_speed:
+                self.logging(
+                    f"{self.__class__.__name__}: epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
+                    f"global_step={self.global_step_count}, RunningAvgSamplesPerSec={self.avg_samples_per_sec():.2f}, "
+                    f"CurrSamplesPerSec={(self.batch_size * self.num_workers) / duration:.2f}"
+                )
+
+    def avg_samples_per_sec(self):
+        if self.global_step_count > 0 and self.total_elapsed_time > 0:
+            samples_per_step = self.batch_size * self.num_workers
+            total_step_offset = self.global_step_count - self.start_step
+            avg_time_per_step = self.total_elapsed_time / max(total_step_offset, 1)
+            return samples_per_step / avg_time_per_step
+        return float("-inf")

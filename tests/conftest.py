@@ -1,0 +1,37 @@
+"""Test harness configuration.
+
+The reference exercised "multi-node" logic as multi-process NCCL on one host
+(``tests/unit/common.py:16-105``).  Here the analogous trick is a *virtual
+multi-chip mesh*: ``--xla_force_host_platform_device_count=8`` gives 8 CPU
+devices in one process, and meshes/shardings built over them execute the
+same SPMD programs (same collectives, same partitioning) that run on a real
+pod.  These env vars must be set before jax initializes its backends, hence
+the module-level code in conftest.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# Prefer CPU for tests: compiles are fast and results deterministic.  (The
+# axon TPU plugin may still register; tests pin meshes to cpu devices.)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    devs = jax.devices("cpu")
+    assert len(devs) >= 8, f"expected >=8 virtual cpu devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture(autouse=True)
+def _default_cpu():
+    """Run unsharded computations on CPU regardless of the default backend."""
+    cpu0 = jax.devices("cpu")[0]
+    with jax.default_device(cpu0):
+        yield

@@ -1,0 +1,158 @@
+"""Config/batch-solver tests (modeled on reference ``tests/unit/test_config.py``
+and ``test_ds_config.py``)."""
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
+
+
+def make_config(d, world_size=1):
+    return DeepSpeedConfig(d, world_size=world_size)
+
+
+@pytest.mark.parametrize("num_devices,batch,micro_batch,gas,success", [
+    (2, 32, 16, 1, True),
+    (2, 32, 8, 2, True),
+    (2, 33, 17, 2, False),
+    (2, 32, 18, 1, False),
+])
+def test_batch_config(num_devices, batch, micro_batch, gas, success):
+    ds_config = {
+        "train_batch_size": batch,
+        "train_micro_batch_size_per_gpu": micro_batch,
+        "gradient_accumulation_steps": gas,
+    }
+    if success:
+        cfg = make_config(ds_config, world_size=num_devices)
+        assert cfg.train_batch_size == batch
+    else:
+        with pytest.raises(AssertionError):
+            make_config(ds_config, world_size=num_devices)
+
+
+def test_two_of_three_micro_derived():
+    cfg = make_config({"train_batch_size": 32, "gradient_accumulation_steps": 2},
+                      world_size=4)
+    assert cfg.train_micro_batch_size_per_gpu == 4
+
+
+def test_two_of_three_gas_derived():
+    cfg = make_config({"train_batch_size": 32, "train_micro_batch_size_per_gpu": 4},
+                      world_size=4)
+    assert cfg.gradient_accumulation_steps == 2
+
+
+def test_only_train_batch():
+    cfg = make_config({"train_batch_size": 32}, world_size=4)
+    assert cfg.train_micro_batch_size_per_gpu == 8
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_only_micro_batch():
+    cfg = make_config({"train_micro_batch_size_per_gpu": 8}, world_size=4)
+    assert cfg.train_batch_size == 32
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_no_batch_info_raises():
+    with pytest.raises(DeepSpeedConfigError):
+        make_config({"steps_per_print": 5}, world_size=1)
+
+
+def test_duplicate_json_keys_rejected(tmp_path):
+    p = tmp_path / "dup.json"
+    p.write_text('{"train_batch_size": 8, "train_batch_size": 16}')
+    with pytest.raises(ValueError):
+        DeepSpeedConfig(str(p), world_size=1)
+
+
+def test_zero_config_parsing():
+    cfg = make_config({
+        "train_batch_size": 8,
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2, "cpu_offload": True},
+    }, world_size=1)
+    assert cfg.zero_enabled
+    assert cfg.zero_optimization_stage == 2
+    assert cfg.zero_config.cpu_offload
+
+
+def test_zero_deprecated_bool_form():
+    cfg = make_config({
+        "train_batch_size": 8,
+        "bf16": {"enabled": True},
+        "zero_optimization": True,
+    }, world_size=1)
+    assert cfg.zero_optimization_stage == 1
+
+
+def test_cpu_offload_requires_stage2():
+    with pytest.raises(AssertionError):
+        make_config({
+            "train_batch_size": 8,
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 1, "cpu_offload": True},
+        }, world_size=1)
+
+
+def test_fp16_and_bf16_exclusive():
+    with pytest.raises(AssertionError):
+        make_config({
+            "train_batch_size": 8,
+            "fp16": {"enabled": True},
+            "bf16": {"enabled": True},
+        }, world_size=1)
+
+
+def test_fp16_dynamic_loss_scale_args():
+    cfg = make_config({
+        "train_batch_size": 8,
+        "fp16": {
+            "enabled": True,
+            "initial_scale_power": 16,
+            "loss_scale_window": 500,
+            "hysteresis": 4,
+            "min_loss_scale": 0.5,
+        },
+    }, world_size=1)
+    assert cfg.dynamic_loss_scale_args == {
+        "init_scale": 2 ** 16,
+        "scale_window": 500,
+        "delayed_shift": 4,
+        "min_scale": 0.5,
+    }
+    assert cfg.initial_dynamic_scale == 2 ** 16
+
+
+def test_scheduler_optimizer_parsing():
+    cfg = make_config({
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.001}},
+        "scheduler": {"type": "WarmupLR", "params": {"warmup_num_steps": 10}},
+    }, world_size=1)
+    assert cfg.optimizer_name == "adam"
+    assert cfg.optimizer_params == {"lr": 0.001}
+    assert cfg.scheduler_name == "WarmupLR"
+    assert cfg.scheduler_params == {"warmup_num_steps": 10}
+
+
+def test_sparse_attention_modes():
+    cfg = make_config({
+        "train_batch_size": 8,
+        "sparse_attention": {"mode": "fixed", "block": 32, "num_local_blocks": 8},
+    }, world_size=1)
+    sa = cfg.sparse_attention
+    assert sa["mode"] == "fixed"
+    assert sa["block"] == 32
+    assert sa["num_local_blocks"] == 8
+    with pytest.raises(NotImplementedError):
+        make_config({
+            "train_batch_size": 8,
+            "sparse_attention": {"mode": "bogus"},
+        }, world_size=1)
+
+
+def test_pipeline_defaults():
+    cfg = make_config({"train_batch_size": 8}, world_size=1)
+    assert cfg.pipeline["partition"] == "best"
+    assert cfg.pipeline["activation_checkpoint_interval"] == 0

@@ -1,0 +1,121 @@
+"""Elasticity tests (modeled on reference ``tests/unit/test_elastic.py``)."""
+
+import pytest
+
+import deepspeed_tpu as deepspeed
+
+base_ds_config = {
+    "elasticity": {
+        "enabled": True,
+        "max_train_batch_size": 10000,
+        "micro_batch_sizes": [8, 12, 16, 17],
+        "min_gpus": 32,
+        "max_gpus": 1500,
+        "min_time": 20,
+        "version": 0.1,
+    }
+}
+
+
+def copy_config():
+    import copy
+
+    return copy.deepcopy(base_ds_config)
+
+
+def test_basic_10k():
+    ds_config = copy_config()
+    final_batch_size, valid_gpus = deepspeed.elasticity.compute_elastic_config(
+        ds_config=ds_config, target_deepspeed_version="0")
+    for gpu_num in valid_gpus:
+        assert final_batch_size % gpu_num == 0
+        batch_per_gpu = final_batch_size // gpu_num
+        found_valid_mb = any(batch_per_gpu % mb == 0
+                             for mb in ds_config["elasticity"]["micro_batch_sizes"])
+        assert found_valid_mb, "No valid mb found"
+    assert len(valid_gpus) == 23
+    assert final_batch_size == 9792
+
+
+def test_disabled():
+    ds_config = copy_config()
+    ds_config["elasticity"]["enabled"] = False
+    with pytest.raises(deepspeed.elasticity.ElasticityError):
+        deepspeed.elasticity.compute_elastic_config(
+            ds_config=ds_config, target_deepspeed_version="0")
+
+
+def test_valid_world_size():
+    ds_config = copy_config()
+    final_batch_size, valid_gpus, mbsize = deepspeed.elasticity.compute_elastic_config(
+        ds_config=ds_config, target_deepspeed_version="0", world_size=64)
+    assert mbsize == 17
+
+
+def test_invalid_world_size():
+    ds_config = copy_config()
+    with pytest.raises(deepspeed.elasticity.ElasticityIncompatibleWorldSize):
+        deepspeed.elasticity.compute_elastic_config(
+            ds_config=ds_config, target_deepspeed_version="0", world_size=128)
+
+
+def test_future_elastic_version():
+    ds_config = copy_config()
+    ds_config["elasticity"]["version"] = "0.2"
+    with pytest.raises(deepspeed.elasticity.ElasticityError):
+        deepspeed.elasticity.compute_elastic_config(
+            ds_config=ds_config, target_deepspeed_version="0")
+
+
+def test_missing_max_batch():
+    ds_config = copy_config()
+    del ds_config["elasticity"]["max_train_batch_size"]
+    with pytest.raises(deepspeed.elasticity.ElasticityError):
+        deepspeed.elasticity.compute_elastic_config(
+            ds_config=ds_config, target_deepspeed_version="0")
+
+
+def test_missing_micro_batch():
+    ds_config = copy_config()
+    del ds_config["elasticity"]["micro_batch_sizes"]
+    with pytest.raises(deepspeed.elasticity.ElasticityError):
+        deepspeed.elasticity.compute_elastic_config(
+            ds_config=ds_config, target_deepspeed_version="0")
+
+
+def test_empty_config():
+    ds_config = {"elasticity": {"enabled": True}}
+    with pytest.raises(deepspeed.elasticity.ElasticityError):
+        deepspeed.elasticity.compute_elastic_config(
+            ds_config=ds_config, target_deepspeed_version="0")
+
+
+def test_config_batch_override():
+    """Elasticity overrides the batch triple inside DeepSpeedConfig
+    (reference ``runtime/config.py:538-588``)."""
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+    ds_config = copy_config()
+    cfg = DeepSpeedConfig(ds_config, world_size=64)
+    assert cfg.train_batch_size == 9792
+    assert cfg.train_micro_batch_size_per_gpu == 17
+    assert cfg.gradient_accumulation_steps == 9792 // (17 * 64)
+
+
+def test_config_batch_conflict_raises():
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+    ds_config = copy_config()
+    ds_config["train_batch_size"] = 4
+    with pytest.raises(deepspeed.elasticity.ElasticityError):
+        DeepSpeedConfig(ds_config, world_size=64)
+
+
+def test_config_batch_conflict_ignored():
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+    ds_config = copy_config()
+    ds_config["train_batch_size"] = 4
+    ds_config["elasticity"]["ignore_non_elastic_batch_info"] = True
+    cfg = DeepSpeedConfig(ds_config, world_size=64)
+    assert cfg.train_batch_size == 9792

@@ -1,0 +1,58 @@
+"""Partitioning + pytree utility tests (modeled on reference
+``tests/unit/test_partition_balanced.py`` and flatten-op usage)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.runtime import utils as ds_utils
+
+
+def check_partition(weights, num_parts, target_diff):
+    result = ds_utils.partition_balanced(weights=weights, num_parts=num_parts)
+    parts_sum = []
+    for b, e in zip(result[:-1], result[1:]):
+        parts_sum.append(sum(weights[b:e]))
+    assert max(parts_sum) - min(parts_sum) == target_diff, (
+        f"ds_utils.partition_balanced(weights={weights}, num_parts={num_parts}) "
+        f"return {result}")
+
+
+def test_partition_balanced():
+    check_partition([1, 2, 1], 4, target_diff=2)
+    check_partition([1, 1, 1, 1], 4, target_diff=0)
+    check_partition([1, 1, 1, 1, 1], 4, target_diff=1)
+    check_partition([1, 1, 1, 1, 0, 1], 4, target_diff=1)
+
+
+def test_partition_uniform():
+    parts = ds_utils.partition_uniform(10, 2)
+    assert parts == [0, 5, 10]
+    parts = ds_utils.partition_uniform(3, 5)
+    assert parts[-1] == 3
+    assert len(parts) == 6
+
+
+def test_flatten_roundtrip():
+    tree = {"a": jnp.arange(6, dtype=jnp.float32).reshape(2, 3),
+            "b": [jnp.ones((4,), jnp.float32), jnp.zeros((2, 2), jnp.float32)]}
+    flat = ds_utils.flatten_tree(tree)
+    assert flat.shape == (14,)
+    back = ds_utils.unflatten_like(flat, tree)
+    np.testing.assert_array_equal(np.asarray(back["a"]), np.asarray(tree["a"]))
+    np.testing.assert_array_equal(np.asarray(back["b"][1]), np.asarray(tree["b"][1]))
+
+
+def test_global_norm_and_clip():
+    tree = {"w": jnp.array([3.0, 4.0])}
+    norm = ds_utils.global_norm(tree)
+    assert abs(float(norm) - 5.0) < 1e-6
+    clipped, _ = ds_utils.clip_grads_by_global_norm(tree, 1.0)
+    cn = ds_utils.global_norm(clipped)
+    assert float(cn) <= 1.0 + 1e-5
+
+
+def test_has_overflow():
+    ok = {"w": jnp.ones((3,))}
+    bad = {"w": jnp.array([1.0, float("inf")])}
+    assert not bool(ds_utils.has_overflow(ok))
+    assert bool(ds_utils.has_overflow(bad))
