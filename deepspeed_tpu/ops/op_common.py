@@ -1,0 +1,55 @@
+"""Shared helpers for flat-parameter-space optimizer ops.
+
+The reference implements optimizers as chunked multi-tensor CUDA kernels
+(``csrc/adam/multi_tensor_adam.cu``, ``csrc/lamb/fused_lamb_cuda_kernel.cu``)
+to amortize launch overhead.  On TPU the analog is a *flat parameter space*:
+all parameters live in one 1-D fp32 buffer (padded to the data-parallel
+degree), the optimizer update is one fused elementwise XLA computation over
+it, and ZeRO sharding is a trivial even split of the buffer along the
+``data`` mesh axis.  Per-tensor structure (needed by LAMB trust ratios and
+checkpoint I/O) is carried by a static ``Segments`` descriptor.
+"""
+
+from typing import List, NamedTuple, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class Segments(NamedTuple):
+    """Static map from flat-buffer offsets back to parameter tensors."""
+
+    offsets: Tuple[int, ...]   # start offset of each tensor
+    sizes: Tuple[int, ...]     # element count of each tensor
+    total: int                 # flat length including padding
+
+    @property
+    def num_segments(self):
+        return len(self.sizes)
+
+    def segment_ids(self) -> np.ndarray:
+        """i32[total] mapping each flat element to its tensor index; padding
+        elements map to an extra trailing segment id."""
+        ids = np.full((self.total,), self.num_segments, dtype=np.int32)
+        for i, (o, n) in enumerate(zip(self.offsets, self.sizes)):
+            ids[o:o + n] = i
+        return ids
+
+
+def build_segments(sizes: List[int], pad_to: int = 1) -> Segments:
+    offsets = []
+    off = 0
+    for n in sizes:
+        offsets.append(off)
+        off += n
+    total = off
+    if pad_to > 1 and total % pad_to != 0:
+        total += pad_to - (total % pad_to)
+    return Segments(offsets=tuple(offsets), sizes=tuple(sizes), total=total)
+
+
+def segment_l2_norms(flat: jnp.ndarray, segment_ids: jnp.ndarray, num_segments: int):
+    """Per-tensor L2 norms of a flat buffer in one scatter-add pass."""
+    sq = jnp.asarray(flat, jnp.float32) ** 2
+    sums = jnp.zeros((num_segments + 1,), jnp.float32).at[segment_ids].add(sq)
+    return jnp.sqrt(sums[:num_segments])

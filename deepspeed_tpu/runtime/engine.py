@@ -1,0 +1,767 @@
+"""DeepSpeed-TPU training engine.
+
+TPU-native re-design of ``deepspeed/runtime/engine.py`` (DeepSpeedEngine,
+reference ``:95-1573``).  The public API is kept — ``initialize()`` returns
+``(engine, optimizer, dataloader, lr_scheduler)``; the engine exposes
+``forward/backward/step``, ``train_batch``, ``save_checkpoint`` /
+``load_checkpoint``, and the config accessor methods — but the execution
+model is rebuilt around XLA:
+
+- The train step is three jitted programs: ``_fwd_bwd`` (loss + grads, with
+  the loss pre-scaled by loss-scale / grad-accumulation), ``_accum`` (flat
+  gradient accumulation), and ``_apply`` (unscale → overflow check → clip →
+  fused optimizer update on the flat fp32 master).  There are no backward
+  hooks (reference ``stage2.py:583``) — gradient partitioning is expressed
+  as sharding annotations and XLA GSPMD inserts reduce-scatter/all-gather
+  collectives and overlaps them with compute.
+- ZeRO stages are *sharding policies of the flat parameter space* over the
+  ``data`` mesh axis (see ``zero/`` package), not runtime bucketing
+  (reference ``stage1.py``/``stage2.py``).
+- Mixed precision is bf16-first; fp16 + in-jit dynamic loss scaling is kept
+  for config parity (reference ``fp16/fused_optimizer.py``).
+- DP gradient averaging (reference ``allreduce_gradients``/
+  ``buffered_allreduce_fallback``, ``engine.py:836-1246``) falls out of
+  batch sharding: the model's mean loss over the globally-sharded batch
+  makes XLA emit the gradient all-reduce (or reduce-scatter under ZeRO≥2).
+
+Model contract: ``model.init(rng) -> params`` and
+``model.apply(params, batch, rng=key, train=bool, **kw) -> scalar loss`` in
+training (any pytree output for ``train=False``).  A bare callable
+``loss_fn(params, batch, rng, **kw)`` plus explicit ``model_parameters`` is
+also accepted.  Optional ``model.partition_specs(mesh) -> pytree of
+PartitionSpec`` enables tensor parallelism over the ``model`` axis.
+"""
+
+import json
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..ops.adam.fused_adam import FusedAdam
+from ..ops.lamb.fused_lamb import FusedLamb
+from ..ops.op_common import build_segments
+from ..parallel.mesh import DATA_AXIS, MeshGrid, make_mesh
+from ..utils.distributed import init_distributed
+from ..utils.logging import log_dist, logger
+from ..utils.timer import SynchronizedWallClockTimer, ThroughputTimer
+from . import constants as C
+from .config import DeepSpeedConfig
+from .dataloader import DeepSpeedDataLoader, RepeatingLoader
+from .fp16.loss_scaler import DynamicScaleState, update_scale_state
+from .lr_schedules import SCHEDULE_CLASSES
+from .progressive_layer_drop import ProgressiveLayerDrop
+from .utils import flatten_tree, unflatten_like
+
+MODEL_STATES_NPZ = "model_states.npz"
+OPTIM_STATES_NPZ = "zero_optim_states.npz"
+META_JSON = "meta.json"
+CLIENT_STATE_PKL = "client_state.pkl"
+LATEST_FILE = "latest"
+
+
+def initialize(args=None,
+               model=None,
+               optimizer=None,
+               model_parameters=None,
+               training_data=None,
+               lr_scheduler=None,
+               mpu=None,
+               dist_init_required=None,
+               collate_fn=None,
+               config=None,
+               config_params=None,
+               mesh=None):
+    """Initialize the DeepSpeed-TPU engine (reference ``__init__.py:50-139``).
+
+    Returns ``(engine, optimizer, training_dataloader, lr_scheduler)``.
+    """
+    log_dist("DeepSpeed-TPU initialize", ranks=[0])
+    from .pipe.module import PipelineModule
+
+    if isinstance(model, PipelineModule):
+        from .pipe.engine import PipelineEngine
+
+        engine = PipelineEngine(args=args, model=model, optimizer=optimizer,
+                                model_parameters=model_parameters,
+                                training_data=training_data, lr_scheduler=lr_scheduler,
+                                dist_init_required=dist_init_required,
+                                collate_fn=collate_fn, config=config,
+                                config_params=config_params, mesh=mesh)
+    else:
+        engine = DeepSpeedEngine(args=args, model=model, optimizer=optimizer,
+                                 model_parameters=model_parameters,
+                                 training_data=training_data, lr_scheduler=lr_scheduler,
+                                 mpu=mpu, dist_init_required=dist_init_required,
+                                 collate_fn=collate_fn, config=config,
+                                 config_params=config_params, mesh=mesh)
+    return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
+
+
+class DeepSpeedEngine:
+    """Central training engine (reference ``engine.py:95``)."""
+
+    def __init__(self, args=None, model=None, optimizer=None, model_parameters=None,
+                 training_data=None, lr_scheduler=None, mpu=None,
+                 dist_init_required=None, collate_fn=None, config=None,
+                 config_params=None, mesh=None, dont_build_steps=False):
+        assert model is not None, "deepspeed.initialize requires a model"
+        if dist_init_required or dist_init_required is None:
+            init_distributed()
+
+        # -- config resolution (reference engine.py:460-470) --
+        config = config if config is not None else config_params
+        if config is None and args is not None:
+            config = getattr(args, "deepspeed_config", None) or getattr(
+                args, "deepscale_config", None)
+        assert config is not None, (
+            "DeepSpeed requires --deepspeed_config, a config dict, or config_params")
+
+        self.mpu = mpu
+        self._config_source = config
+
+        # -- mesh (replaces process-group setup, reference engine.py:521-538) --
+        if mesh is not None:
+            self.mesh = mesh
+            world_size = int(np.prod(mesh.devices.shape)) // max(
+                1, dict(zip(mesh.axis_names, mesh.devices.shape)).get("model", 1)
+                * dict(zip(mesh.axis_names, mesh.devices.shape)).get("pipe", 1)
+                * dict(zip(mesh.axis_names, mesh.devices.shape)).get("seq", 1))
+            self._config = DeepSpeedConfig(config, mpu, world_size=world_size)
+        else:
+            self._config = DeepSpeedConfig(config, mpu)
+            self.mesh = make_mesh(self._config.mesh_config)
+        shape = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+        self.dp_world_size = shape.get("data", 1)
+        self.mp_world_size = shape.get("model", 1)
+        assert self.dp_world_size == self._config.world_size, (
+            f"mesh data axis {self.dp_world_size} != config world size "
+            f"{self._config.world_size}")
+        self.grid = MeshGrid(self.mesh)
+        self.world_size = self.grid.world_size
+
+        # -- precision --
+        if self._config.fp16_enabled:
+            self.compute_dtype = jnp.float16
+        elif self._config.bf16_enabled:
+            self.compute_dtype = jnp.bfloat16
+        else:
+            self.compute_dtype = jnp.float32
+        self.dynamic_loss_scale_enabled = (
+            self._config.fp16_enabled and self._config.loss_scale == 0)
+        self.static_loss_scale = (self._config.loss_scale
+                                  if self._config.fp16_enabled and self._config.loss_scale != 0
+                                  else 1.0)
+
+        # -- model / loss function --
+        self.module = model
+        if hasattr(model, "apply"):
+            self._loss_fn = model.apply
+        elif callable(model):
+            self._loss_fn = model
+        else:
+            raise TypeError("model must expose .apply(params, batch, ...) or be callable")
+
+        # -- parameter init --
+        rng_seed = int(self._config._param_dict.get("seed", 0))
+        self._rng = jax.random.PRNGKey(rng_seed)
+        if model_parameters is not None:
+            params0 = model_parameters
+        else:
+            assert hasattr(model, "init"), (
+                "model has no .init(rng); pass model_parameters explicitly")
+            with self.mesh:
+                params0 = model.init(self._rng)
+        params0 = jax.tree_util.tree_map(jnp.asarray, params0)
+        self._param_template = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, self.compute_dtype), params0)
+
+        # TP sharding rules for module params
+        if hasattr(model, "partition_specs"):
+            self._param_specs = model.partition_specs(self.mesh)
+        else:
+            self._param_specs = jax.tree_util.tree_map(lambda _: P(), params0)
+
+        # -- ZeRO flat parameter space (see zero/ package for the policy) --
+        from .zero.coordinator import FlatParamCoordinator
+
+        self.zero_stage = self._config.zero_optimization_stage
+        self.flat = FlatParamCoordinator(
+            mesh=self.mesh, params_template=params0, stage=self.zero_stage,
+            dp_size=self.dp_world_size,
+            cpu_offload=self._config.zero_config.cpu_offload)
+        self.segments = self.flat.segments
+
+        # master weights (flat fp32, sharded per stage)
+        master0 = self.flat.flatten_to_master(params0)
+
+        # -- optimizer (reference _configure_optimizer engine.py:544-712) --
+        self.client_optimizer = optimizer
+        self.optimizer = self._configure_basic_optimizer(optimizer)
+        self._opt_shardings = self._make_opt_shardings()
+        with self.mesh:
+            opt0 = jax.jit(self.optimizer.init_state,
+                           out_shardings=self._opt_shardings)(master0)
+
+        scale0 = DynamicScaleState.create(
+            init_scale=(self._config.initial_dynamic_scale
+                        if self.dynamic_loss_scale_enabled else self.static_loss_scale),
+            delayed_shift=(self._config.dynamic_loss_scale_args or {}).get(
+                "delayed_shift", 1))
+
+        self.state = {
+            "master": master0,
+            "opt": opt0,
+            "scale": scale0,
+            "skipped": jnp.asarray(0, jnp.int32),
+        }
+
+        # cached module-dtype params (stage<=2 keeps them resident;
+        # stage 3 materializes them inside fwd_bwd from the sharded master)
+        self._module_params = None
+
+        # -- schedules / aux --
+        self.lr_scheduler = self._configure_lr_scheduler(lr_scheduler)
+        self.progressive_layer_drop = (ProgressiveLayerDrop(
+            theta=self._config.pld_params["theta"],
+            gamma=self._config.pld_params["gamma"])
+            if self._config.pld_enabled else None)
+
+        self.timers = SynchronizedWallClockTimer()
+        self.tput_timer = ThroughputTimer(
+            batch_size=self.train_micro_batch_size_per_gpu() * self.dp_world_size,
+            num_workers=1, steps_per_output=self.steps_per_print())
+
+        self.global_steps = 0
+        self.micro_steps = 0
+        self.global_samples = 0
+        self._losses = []
+        self._acc_grads = None
+        self._overflow = False
+
+        # -- data pipeline (reference deepspeed_io engine.py:719-760) --
+        self.training_dataloader = None
+        if training_data is not None:
+            self.training_dataloader = self.deepspeed_io(training_data,
+                                                         collate_fn=collate_fn)
+        self.collate_fn = collate_fn
+
+        if not dont_build_steps:
+            self._build_step_functions()
+            with self.mesh:
+                self._refresh_module_params()
+
+        if self._config.dump_state:
+            self._config.print("DeepSpeedEngine configuration")
+
+    # ------------------------------------------------------------------
+    # configuration accessors (reference engine.py:217-398)
+    # ------------------------------------------------------------------
+    def train_batch_size(self):
+        return self._config.train_batch_size
+
+    def train_micro_batch_size_per_gpu(self):
+        return self._config.train_micro_batch_size_per_gpu
+
+    def gradient_accumulation_steps(self):
+        return self._config.gradient_accumulation_steps
+
+    def steps_per_print(self):
+        return self._config.steps_per_print
+
+    def zero_optimization(self):
+        return self._config.zero_enabled
+
+    def zero_optimization_stage(self):
+        return self._config.zero_optimization_stage
+
+    def zero_cpu_offload(self):
+        return self._config.zero_config.cpu_offload
+
+    def fp16_enabled(self):
+        return self._config.fp16_enabled
+
+    def bfloat16_enabled(self):
+        return self._config.bf16_enabled
+
+    def dynamic_loss_scale(self):
+        return self.dynamic_loss_scale_enabled
+
+    def gradient_clipping(self):
+        return self._config.gradient_clipping
+
+    def wall_clock_breakdown(self):
+        return self._config.wall_clock_breakdown
+
+    def sparse_gradients_enabled(self):
+        return self._config.sparse_gradients_enabled
+
+    def progressive_layer_drop_enabled(self):
+        return self._config.pld_enabled
+
+    @property
+    def loss_scale(self):
+        return float(jax.device_get(self.state["scale"].cur_scale))
+
+    @property
+    def skipped_steps(self):
+        return int(jax.device_get(self.state["skipped"]))
+
+    def get_lr(self):
+        return [g["lr"] for g in self.optimizer.param_groups]
+
+    def get_params(self):
+        """Current parameters as an (unsharded view) pytree in compute dtype."""
+        if self._module_params is not None:
+            return self._module_params
+        with self.mesh:
+            return self._cast_params_fn(self.state["master"])
+
+    def get_master_params(self):
+        return self.state["master"]
+
+    # ------------------------------------------------------------------
+    # construction helpers
+    # ------------------------------------------------------------------
+    def _make_opt_shardings(self):
+        """Optimizer-state shardings: flat buffers follow the master's
+        sharding; scalars (step counters) replicate."""
+        opt_shape = jax.eval_shape(
+            self.optimizer.init_state,
+            jax.ShapeDtypeStruct((self.segments.total,), jnp.float32))
+        return jax.tree_util.tree_map(
+            lambda l: self.flat.master_sharding if l.ndim > 0 else self.flat.replicated,
+            opt_shape)
+
+    def _configure_basic_optimizer(self, client_optimizer):
+        if client_optimizer is not None:
+            if hasattr(client_optimizer, "init_state") and hasattr(client_optimizer, "update"):
+                return client_optimizer
+            raise TypeError(
+                "client optimizer must implement init_state/update/hyperparams "
+                "(flat-optimizer protocol)")
+        name = self._config.optimizer_name
+        params = dict(self._config.optimizer_params or {})
+        params.pop(C.MAX_GRAD_NORM, None)
+        if name is None:
+            name = C.ADAM_OPTIMIZER
+        name = name.lower()
+        if name in (C.ADAM_OPTIMIZER, "adamw"):
+            return FusedAdam(adam_w_mode=(name == "adamw" or params.pop("adam_w_mode", True)),
+                             **params)
+        if name == C.LAMB_OPTIMIZER:
+            return FusedLamb(**params)
+        if name == C.ONEBIT_ADAM_OPTIMIZER:
+            from ..runtime.fp16.onebit_adam import OnebitAdam
+
+            return OnebitAdam(deepspeed=self, **params)
+        raise ValueError(f"Unknown optimizer {name!r}")
+
+    def _configure_lr_scheduler(self, client_scheduler):
+        if client_scheduler is not None:
+            return client_scheduler
+        name = self._config.scheduler_name
+        if name is None:
+            return None
+        if name not in SCHEDULE_CLASSES:
+            raise ValueError(f"Unknown lr schedule {name!r}")
+        sched = SCHEDULE_CLASSES[name](self.optimizer,
+                                       **(self._config.scheduler_params or {}))
+        log_dist(f"DeepSpeed using configured LR scheduler = {name}", ranks=[0])
+        return sched
+
+    # ------------------------------------------------------------------
+    # jitted step construction
+    # ------------------------------------------------------------------
+    def _build_step_functions(self):
+        mesh = self.mesh
+        grad_sharding = self.flat.grad_sharding
+        master_sharding = self.flat.master_sharding
+        param_shardings = jax.tree_util.tree_map(
+            lambda spec: NamedSharding(mesh, spec), self._param_specs)
+        grad_acc = float(self.gradient_accumulation_steps())
+        stage3 = self.zero_stage >= 3
+        fp16 = self._config.fp16_enabled
+        clip = float(self._config.gradient_clipping or 0.0)
+        scale_args = self._config.dynamic_loss_scale_args or {}
+        dynamic = self.dynamic_loss_scale_enabled
+        optimizer = self.optimizer
+        segments = self.segments
+        seg_ids_needed = isinstance(optimizer, FusedLamb)
+        self._segment_ids = None
+        if seg_ids_needed:
+            self._segment_ids = jax.device_put(
+                segments.segment_ids(), self.flat.master_sharding)
+
+        def cast_params(master):
+            params = self.flat.unflatten_params(master, self._param_template,
+                                                self.compute_dtype)
+            return jax.tree_util.tree_map(
+                lambda x, s: jax.lax.with_sharding_constraint(x, s),
+                params, param_shardings)
+
+        self._cast_params_fn = jax.jit(cast_params,
+                                       out_shardings=param_shardings)
+
+        def fwd_bwd(params_or_master, batch, rng, cur_scale, extra):
+            if stage3:
+                params = cast_params(params_or_master)
+            else:
+                params = params_or_master
+
+            def scaled_loss(p):
+                loss = self._loss_fn(p, batch, rng=rng, train=True, **extra)
+                return (loss.astype(jnp.float32) * cur_scale) / grad_acc
+
+            sloss, grads = jax.value_and_grad(scaled_loss)(params)
+            flat_g = self.flat.flatten_grads(grads)
+            flat_g = jax.lax.with_sharding_constraint(flat_g, grad_sharding)
+            loss = sloss * grad_acc / cur_scale
+            return loss, flat_g
+
+        self._fwd_bwd_fn = jax.jit(fwd_bwd, out_shardings=(None, grad_sharding))
+
+        def accum(acc, g):
+            return acc + g
+
+        self._accum_fn = jax.jit(accum, donate_argnums=(0,),
+                                 out_shardings=grad_sharding)
+
+        def apply_update(master, opt_state, scale_state, skipped, flat_g, hp,
+                         segment_ids):
+            inv = 1.0 / scale_state.cur_scale
+            g = flat_g * inv
+            if fp16:
+                overflow = jnp.logical_not(jnp.all(jnp.isfinite(flat_g)))
+            else:
+                overflow = jnp.asarray(False)
+            if clip > 0.0:
+                gnorm = jnp.sqrt(jnp.sum(g.astype(jnp.float32) ** 2))
+                g = g * jnp.minimum(1.0, clip / (gnorm + 1e-6))
+            else:
+                gnorm = jnp.asarray(0.0, jnp.float32)
+
+            new_master, new_opt = optimizer.update(
+                opt_state, master, g, hp, segments=segments, segment_ids=segment_ids)
+
+            if fp16:
+                pick = lambda new, old: jnp.where(overflow, old, new)
+                new_master = pick(new_master, master)
+                new_opt = jax.tree_util.tree_map(pick, new_opt, opt_state)
+                if dynamic:
+                    scale_state = update_scale_state(
+                        scale_state, overflow,
+                        scale_window=scale_args.get("scale_window", 1000),
+                        min_scale=scale_args.get("min_scale", 1.0),
+                        delayed_shift=scale_args.get("delayed_shift", 1))
+                skipped = skipped + overflow.astype(jnp.int32)
+            return new_master, new_opt, scale_state, skipped, overflow, gnorm
+
+        self._apply_fn = jax.jit(
+            apply_update,
+            donate_argnums=(0, 1, 4),
+            out_shardings=(master_sharding, self._opt_shardings,
+                           None, None, None, None))
+
+        def eval_fwd(params_or_master, batch, rng, extra):
+            params = cast_params(params_or_master) if stage3 else params_or_master
+            return self._loss_fn(params, batch, rng=rng, train=False, **extra)
+
+        self._eval_fn = jax.jit(eval_fwd)
+
+    def _refresh_module_params(self):
+        if self.zero_stage >= 3:
+            self._module_params = None
+        else:
+            self._module_params = self._cast_params_fn(self.state["master"])
+
+    def _forward_params(self):
+        return self.state["master"] if self.zero_stage >= 3 else self._module_params
+
+    def _shard_batch(self, batch):
+        """Lay a host batch onto the mesh, sharded over the data axis."""
+        sharding = NamedSharding(self.mesh, P(DATA_AXIS))
+
+        def put(x):
+            x = np.asarray(x)
+            return jax.device_put(x, sharding)
+
+        return jax.tree_util.tree_map(put, batch)
+
+    def _extra_kwargs(self):
+        kwargs = {}
+        if self.progressive_layer_drop:
+            kwargs["pld_theta"] = jnp.asarray(
+                self.progressive_layer_drop.get_theta(), jnp.float32)
+        return kwargs
+
+    def _next_rng(self):
+        key = jax.random.fold_in(self._rng, self.micro_steps)
+        return key
+
+    # ------------------------------------------------------------------
+    # train loop API (reference engine.py:796-1158)
+    # ------------------------------------------------------------------
+    def forward(self, batch):
+        """Compute loss and gradients for one micro-batch (reference
+        ``engine.py:796``).  Returns the (async) scalar loss."""
+        if self.wall_clock_breakdown():
+            self.timers("forward").start(sync=False)
+        batch = self._shard_batch(batch)
+        scale = self.state["scale"].cur_scale
+        with self.mesh:
+            loss, flat_g = self._fwd_bwd_fn(self._forward_params(), batch,
+                                            self._next_rng(), scale,
+                                            self._extra_kwargs())
+        self._pending_grads = flat_g
+        self._last_loss = loss
+        if self.wall_clock_breakdown():
+            self.timers("forward").stop(sync=False)
+        return loss
+
+    __call__ = forward
+
+    def backward(self, loss=None, allreduce_gradients=True):
+        """Accumulate the gradients computed by :meth:`forward`
+        (reference ``engine.py:852``; grads were already produced by the
+        fused fwd+bwd program)."""
+        assert getattr(self, "_pending_grads", None) is not None, (
+            "backward() called before forward()")
+        with self.mesh:
+            if self._acc_grads is None:
+                self._acc_grads = self._pending_grads
+            else:
+                self._acc_grads = self._accum_fn(self._acc_grads, self._pending_grads)
+        self._pending_grads = None
+        self._losses.append(self._last_loss)
+        self.micro_steps += 1
+        self.global_samples += self.train_micro_batch_size_per_gpu() * self.dp_world_size
+        return loss
+
+    def is_gradient_accumulation_boundary(self):
+        """True when the next step() applies an update (reference
+        ``engine.py:989-991``)."""
+        return self.micro_steps % self.gradient_accumulation_steps() == 0
+
+    def step(self):
+        """Apply the optimizer at the accumulation boundary (reference
+        ``engine.py:993-1076``)."""
+        if not self.is_gradient_accumulation_boundary():
+            return
+        if self.wall_clock_breakdown():
+            self.timers("step").start(sync=False)
+        hp = self.optimizer.hyperparams()
+        with self.mesh:
+            (self.state["master"], self.state["opt"], self.state["scale"],
+             self.state["skipped"], overflow, gnorm) = self._apply_fn(
+                self.state["master"], self.state["opt"], self.state["scale"],
+                self.state["skipped"], self._acc_grads, hp, self._segment_ids)
+            self._refresh_module_params()
+        self._acc_grads = None
+        self.global_steps += 1
+
+        if self._config.fp16_enabled:
+            # fp16 parity: the reference also syncs on the overflow flag each
+            # step (CheckOverflow all_reduce, utils.py:100); scheduler must
+            # not step on a skipped update (engine.py:978-986).
+            self._overflow = bool(jax.device_get(overflow))
+        else:
+            self._overflow = False
+
+        if self.lr_scheduler is not None and not self._overflow:
+            self.lr_scheduler.step()
+        if self.progressive_layer_drop:
+            self.progressive_layer_drop.update_state(self.global_steps)
+
+        if self.global_steps % self.steps_per_print() == 0:
+            mean_loss = float(np.mean([np.asarray(jax.device_get(l))
+                                       for l in self._losses])) if self._losses else 0.0
+            lr = self.get_lr()[0] if self.optimizer.param_groups else 0.0
+            log_dist(
+                f"step={self.global_steps}, skipped={self.skipped_steps}, "
+                f"lr={lr:.6g}, loss={mean_loss:.5f}, "
+                f"loss_scale={self.loss_scale if self._config.fp16_enabled else 1.0}",
+                ranks=[0])
+        self._losses = []
+        if self.wall_clock_breakdown():
+            self.timers("step").stop(sync=False)
+            self.timers.log(["forward", "step"])
+
+    def train_batch(self, data_iter=None):
+        """One full training batch = grad_acc micro steps + update
+        (mirrors the pipeline engine's ``train_batch``, reference
+        ``pipe/engine.py:244``)."""
+        if data_iter is None:
+            assert self.training_dataloader is not None
+            if not hasattr(self, "_train_iter"):
+                self._train_iter = iter(RepeatingLoader(self.training_dataloader))
+            data_iter = self._train_iter
+        self.tput_timer.start()
+        losses = []
+        for _ in range(self.gradient_accumulation_steps()):
+            batch = next(data_iter)
+            loss = self.forward(batch)
+            self.backward(loss)
+            losses.append(loss)
+        self.step()
+        self.tput_timer.stop()
+        return jnp.mean(jnp.stack(losses))
+
+    def eval_batch(self, batch):
+        batch = self._shard_batch(batch)
+        with self.mesh:
+            return self._eval_fn(self._forward_params(), batch, self._next_rng(),
+                                 self._extra_kwargs())
+
+    # ------------------------------------------------------------------
+    # data (reference engine.py:719-760)
+    # ------------------------------------------------------------------
+    def deepspeed_io(self, dataset, batch_size=None, route=None, pin_memory=None,
+                     data_sampler=None, collate_fn=None, num_local_io_workers=None):
+        batch_size = batch_size or (self.train_micro_batch_size_per_gpu()
+                                    * self.dp_world_size)
+        return DeepSpeedDataLoader(dataset, batch_size=batch_size,
+                                   collate_fn=collate_fn,
+                                   tput_timer=self.tput_timer)
+
+    # ------------------------------------------------------------------
+    # checkpointing (reference engine.py:1275-1573; layout notes SURVEY §3.5)
+    # ------------------------------------------------------------------
+    def _params_to_host(self, tree):
+        flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+        out = {}
+        for path, leaf in flat:
+            key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+            out[key] = np.asarray(jax.device_get(leaf))
+        return out
+
+    def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True):
+        """Save model + optimizer + engine state.
+
+        Layout mirrors the reference's (SURVEY §3.5): a model-states archive,
+        a ZeRO optimizer-states archive (flat master saved *unpadded* so a
+        different DP degree can re-pad on load — the reference's elastic
+        checkpoint trick, ``stage1.py:848-883``), a meta json, and a
+        ``latest`` tag pointer.
+        """
+        tag = tag or f"global_step{self.global_steps}"
+        ckpt_dir = os.path.join(save_dir, str(tag))
+        os.makedirs(ckpt_dir, exist_ok=True)
+
+        params = self.get_params()
+        np.savez(os.path.join(ckpt_dir, MODEL_STATES_NPZ),
+                 **{k: v.astype(np.float32)
+                    for k, v in self._params_to_host(params).items()})
+
+        unpadded = self.flat.gather_master_unpadded(self.state["master"])
+        opt_host = self._params_to_host(self.state["opt"])
+        np.savez(os.path.join(ckpt_dir, OPTIM_STATES_NPZ),
+                 master=np.asarray(unpadded),
+                 **{f"opt/{k}": v for k, v in opt_host.items()})
+
+        meta = {
+            "global_steps": self.global_steps,
+            "micro_steps": self.micro_steps,
+            "global_samples": self.global_samples,
+            "skipped_steps": self.skipped_steps,
+            "scale_state": {
+                "cur_scale": float(jax.device_get(self.state["scale"].cur_scale)),
+                "cur_iter": int(jax.device_get(self.state["scale"].cur_iter)),
+                "last_overflow_iter": int(jax.device_get(
+                    self.state["scale"].last_overflow_iter)),
+                "cur_hysteresis": int(jax.device_get(
+                    self.state["scale"].cur_hysteresis)),
+            },
+            "lr_scheduler": (self.lr_scheduler.state_dict()
+                             if self.lr_scheduler is not None else None),
+            "dp_world_size": self.dp_world_size,
+            "mp_world_size": self.mp_world_size,
+            "zero_stage": self.zero_stage,
+            "param_count": int(sum(self.segments.sizes)),
+        }
+        with open(os.path.join(ckpt_dir, META_JSON), "w") as f:
+            json.dump(meta, f, indent=2)
+
+        if client_state:
+            with open(os.path.join(ckpt_dir, CLIENT_STATE_PKL), "wb") as f:
+                pickle.dump(client_state, f)
+
+        if save_latest:
+            with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
+                f.write(str(tag))
+        log_dist(f"saved checkpoint {ckpt_dir}", ranks=[0])
+        return True
+
+    def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
+                        load_optimizer_states=True, load_lr_scheduler_states=True):
+        """Restore a checkpoint (reference ``engine.py:1275-1446``); returns
+        ``(path, client_state)``.  Loading into a different DP degree re-pads
+        the unpadded flat master (elastic restore, ``stage2.py:1714-1841``)."""
+        if tag is None:
+            latest_path = os.path.join(load_dir, LATEST_FILE)
+            if not os.path.isfile(latest_path):
+                logger.warning(f"no 'latest' file at {latest_path}, cannot load")
+                return None, None
+            with open(latest_path) as f:
+                tag = f.read().strip()
+        ckpt_dir = os.path.join(load_dir, str(tag))
+        if not os.path.isdir(ckpt_dir):
+            logger.warning(f"checkpoint dir {ckpt_dir} missing")
+            return None, None
+
+        with open(os.path.join(ckpt_dir, META_JSON)) as f:
+            meta = json.load(f)
+
+        opt_npz = np.load(os.path.join(ckpt_dir, OPTIM_STATES_NPZ))
+        with self.mesh:
+            self.state["master"] = self.flat.scatter_master_from_unpadded(
+                opt_npz["master"])
+            if load_optimizer_states:
+                opt_host = {k[len("opt/"):]: opt_npz[k]
+                            for k in opt_npz.files if k.startswith("opt/")}
+                self.state["opt"] = self._restore_tree_like(
+                    self.state["opt"], opt_host)
+            self._refresh_module_params()
+
+        ss = meta["scale_state"]
+        self.state["scale"] = DynamicScaleState(
+            cur_scale=jnp.asarray(ss["cur_scale"], jnp.float32),
+            cur_iter=jnp.asarray(ss["cur_iter"], jnp.int32),
+            last_overflow_iter=jnp.asarray(ss["last_overflow_iter"], jnp.int32),
+            cur_hysteresis=jnp.asarray(ss["cur_hysteresis"], jnp.int32))
+        self.state["skipped"] = jnp.asarray(meta["skipped_steps"], jnp.int32)
+        self.global_steps = meta["global_steps"]
+        self.micro_steps = meta["micro_steps"]
+        self.global_samples = meta["global_samples"]
+        if load_lr_scheduler_states and self.lr_scheduler is not None and meta.get(
+                "lr_scheduler"):
+            self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+
+        client_state = None
+        cs_path = os.path.join(ckpt_dir, CLIENT_STATE_PKL)
+        if os.path.isfile(cs_path):
+            with open(cs_path, "rb") as f:
+                client_state = pickle.load(f)
+        log_dist(f"loaded checkpoint {ckpt_dir}", ranks=[0])
+        return ckpt_dir, client_state
+
+    def _restore_tree_like(self, tree, host_dict):
+        """Place host arrays into a pytree matching ``tree``'s structure and
+        shardings, keyed by tree paths.  Scalars (e.g. step counters) restore
+        by shape."""
+        flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+        leaves = []
+        for path, leaf in flat:
+            key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+            src = host_dict.get(key)
+            assert src is not None, f"checkpoint missing key {key}"
+            arr = np.asarray(src)
+            if arr.shape != leaf.shape and arr.size == sum(self.segments.sizes):
+                # flat buffer saved unpadded under a different DP degree
+                arr = self.flat.repad_unpadded(arr)
+            sharding = getattr(leaf, "sharding", None)
+            leaves.append(jax.device_put(arr.astype(leaf.dtype), sharding))
+        return jax.tree_util.tree_unflatten(treedef, leaves)

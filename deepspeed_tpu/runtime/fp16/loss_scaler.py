@@ -1,0 +1,179 @@
+"""Loss scaling.
+
+Behavioral clone of the reference ``deepspeed/runtime/fp16/loss_scaler.py``
+(classes ``:34-166``), in two forms:
+
+- Host-side classes (``LossScaler``/``DynamicLossScaler``) with the exact
+  reference API, used for config parity and unit tests.
+- A functional form (``DynamicScaleState`` + ``update_scale_state``) usable
+  *inside* a jitted train step with ``lax.cond`` — on TPU the
+  overflow-check/update must live in the compiled program, not host code,
+  to avoid a device→host sync every step.
+
+Under bf16 (TPU default) no scaling is needed; the engine then uses a
+static scale of 1.0 via ``LossScaler``.
+"""
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+INITIAL_LOSS_SCALE = "init_scale"
+SCALE_WINDOW = "scale_window"
+DELAYED_SHIFT = "delayed_shift"
+MIN_LOSS_SCALE = "min_scale"
+
+
+class LossScalerBase:
+    """Base of scaler classes (reference ``loss_scaler.py:34-53``)."""
+
+    def __init__(self, cur_scale):
+        self.cur_scale = cur_scale
+
+    @property
+    def loss_scale(self):
+        return self.cur_scale
+
+    def scale_gradient(self, module, grad_in, grad_out):
+        return tuple(self.loss_scale * g for g in grad_in)
+
+    def update_scale(self, overflow):
+        pass
+
+    def backward(self, loss, retain_graph=False):
+        raise NotImplementedError(
+            "TPU engine scales the loss inside the jitted step; "
+            "use engine.backward().")
+
+
+class LossScaler(LossScalerBase):
+    """Static loss scale (reference ``loss_scaler.py:56-76``)."""
+
+    def __init__(self, scale=1):
+        super().__init__(scale)
+
+    def has_overflow(self, params):
+        return False
+
+    @staticmethod
+    def _has_inf_or_nan(x):
+        return False
+
+
+class DynamicLossScaler(LossScalerBase):
+    """Dynamic loss scale with hysteresis (reference ``loss_scaler.py:79-166``).
+
+    Semantics of ``update_scale`` are cloned from reference ``:151-166``:
+    - on overflow: if no hysteresis budget left, halve (floored at
+      ``min_scale``); otherwise spend one unit of hysteresis; either way the
+      growth window restarts.
+    - on ``scale_window`` consecutive good iters: double the scale and (unless
+      ``consecutive_hysteresis``) refill the hysteresis budget.
+    """
+
+    def __init__(self,
+                 init_scale=2 ** 32,
+                 scale_factor=2.0,
+                 scale_window=1000,
+                 min_scale=1,
+                 delayed_shift=1,
+                 consecutive_hysteresis=False):
+        super().__init__(init_scale)
+        self.cur_iter = 0
+        self.last_overflow_iter = -1
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+        self.min_scale = min_scale
+        self.delayed_shift = delayed_shift
+        self.cur_hysteresis = delayed_shift
+        self.consecutive_hysteresis = consecutive_hysteresis
+
+    def has_overflow_serial(self, params):
+        import numpy as np
+
+        for p in params:
+            arr = np.asarray(p)
+            if not np.all(np.isfinite(arr)):
+                return True
+        return False
+
+    has_overflow = has_overflow_serial
+
+    @staticmethod
+    def _has_inf_or_nan(x):
+        import numpy as np
+
+        return not bool(np.all(np.isfinite(np.asarray(x))))
+
+    def update_scale(self, overflow):
+        if overflow:
+            if self.delayed_shift == 1 or self.cur_hysteresis == 1:
+                self.cur_scale = max(self.cur_scale / self.scale_factor, self.min_scale)
+            else:
+                self.cur_hysteresis -= 1
+            self.last_overflow_iter = self.cur_iter
+        else:
+            if self.consecutive_hysteresis:
+                self.cur_hysteresis = self.delayed_shift
+            if (self.cur_iter - self.last_overflow_iter) % self.scale_window == 0:
+                if not self.consecutive_hysteresis:
+                    self.cur_hysteresis = self.delayed_shift
+                self.cur_scale *= self.scale_factor
+        self.cur_iter += 1
+
+
+# ---------------------------------------------------------------------------
+# Functional (in-jit) form
+# ---------------------------------------------------------------------------
+
+class DynamicScaleState(NamedTuple):
+    """Traced scaler state carried in the TrainState."""
+
+    cur_scale: jnp.ndarray      # f32 scalar
+    cur_iter: jnp.ndarray       # i32
+    last_overflow_iter: jnp.ndarray  # i32
+    cur_hysteresis: jnp.ndarray      # i32
+
+    @staticmethod
+    def create(init_scale=2 ** 32, delayed_shift=1):
+        return DynamicScaleState(
+            cur_scale=jnp.asarray(float(init_scale), jnp.float32),
+            cur_iter=jnp.asarray(0, jnp.int32),
+            last_overflow_iter=jnp.asarray(-1, jnp.int32),
+            cur_hysteresis=jnp.asarray(delayed_shift, jnp.int32),
+        )
+
+
+def update_scale_state(state: DynamicScaleState,
+                       overflow,
+                       scale_factor=2.0,
+                       scale_window=1000,
+                       min_scale=1.0,
+                       delayed_shift=1,
+                       consecutive_hysteresis=False) -> DynamicScaleState:
+    """Pure-function clone of ``DynamicLossScaler.update_scale`` above; the
+    static knobs come from config so they are compile-time constants."""
+    overflow = jnp.asarray(overflow)
+
+    no_hyst_left = jnp.logical_or(delayed_shift == 1, state.cur_hysteresis == 1)
+    shrunk = jnp.maximum(state.cur_scale / scale_factor, min_scale)
+    scale_on_overflow = jnp.where(no_hyst_left, shrunk, state.cur_scale)
+    hyst_on_overflow = jnp.where(no_hyst_left, state.cur_hysteresis,
+                                 state.cur_hysteresis - 1)
+
+    window_hit = ((state.cur_iter - state.last_overflow_iter) % scale_window) == 0
+    scale_on_good = jnp.where(window_hit, state.cur_scale * scale_factor, state.cur_scale)
+    if consecutive_hysteresis:
+        hyst_on_good = jnp.asarray(delayed_shift, jnp.int32) * jnp.ones_like(state.cur_hysteresis)
+    else:
+        hyst_on_good = jnp.where(window_hit, delayed_shift, state.cur_hysteresis)
+
+    return DynamicScaleState(
+        cur_scale=jnp.where(overflow, scale_on_overflow, scale_on_good),
+        cur_iter=state.cur_iter + 1,
+        last_overflow_iter=jnp.where(overflow, state.cur_iter, state.last_overflow_iter),
+        cur_hysteresis=jnp.where(overflow, hyst_on_overflow, hyst_on_good).astype(jnp.int32),
+    )
+
+
+CLIP_GRAD = "clip_grad"

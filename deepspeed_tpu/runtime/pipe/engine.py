@@ -1,0 +1,9 @@
+"""Pipeline engine placeholder; full implementation lands with the pipeline
+parallelism milestone (SURVEY §7 step 6)."""
+
+from ..engine import DeepSpeedEngine
+
+
+class PipelineEngine(DeepSpeedEngine):
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError("PipelineEngine arrives with the pipeline milestone")

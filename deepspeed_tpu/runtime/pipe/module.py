@@ -1,0 +1,91 @@
+"""Pipeline module: layer-sequence model expression + stage partitioning.
+
+Re-design of ``deepspeed/runtime/pipe/module.py`` (LayerSpec ``:23``,
+TiedLayerSpec ``:71``, PipelineModule ``:85``).  Full implementation arrives
+with the pipeline engine; this module currently provides the specs and the
+partitioning logic, which are pure Python and independently testable.
+"""
+
+from ...runtime.utils import partition_balanced, partition_uniform
+from ...utils.logging import logger
+
+
+class LayerSpec:
+    """Delayed-construction layer description (reference ``module.py:23-69``).
+
+    ``typename(*module_args, **module_kwargs)`` builds the layer object; under
+    pipeline parallelism only the owning stage builds it.
+    """
+
+    def __init__(self, typename, *module_args, **module_kwargs):
+        self.typename = typename
+        self.module_args = module_args
+        self.module_kwargs = module_kwargs
+        if not issubclass(typename, object):
+            raise RuntimeError("LayerSpec only supports classes")
+
+    def build(self, log=False):
+        if log:
+            logger.info(f"building {repr(self)}")
+        return self.typename(*self.module_args, **self.module_kwargs)
+
+    def __repr__(self):
+        return f"LayerSpec({self.typename.__name__})"
+
+
+class TiedLayerSpec(LayerSpec):
+    """Layer whose parameters are shared across stages by key (reference
+    ``module.py:71-83``), e.g. input/output embeddings."""
+
+    def __init__(self, key, typename, *module_args, forward_fn=None,
+                 tied_weight_attr="weight", **module_kwargs):
+        super().__init__(typename, *module_args, **module_kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+        self.tied_weight_attr = tied_weight_attr
+
+
+class PipelineModule:
+    """Sequence-of-layers model for pipeline execution (reference
+    ``module.py:85-575``).  See ``pipe/engine.py`` for the TPU execution
+    model; partitioning (`partition_method`: 'uniform' | 'parameters' |
+    'type:regex') mirrors ``_partition_layers`` (reference ``:348-403``)."""
+
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seed_layers=False, seed_fn=None, base_seed=1234,
+                 partition_method="parameters",
+                 activation_checkpoint_interval=0,
+                 activation_checkpoint_func=None):
+        self.layer_specs = list(layers)
+        self.num_stages = num_stages
+        self.topology = topology
+        self.loss_fn = loss_fn
+        self.seed_layers = seed_layers
+        self.base_seed = base_seed
+        self.partition_method = partition_method
+        self.activation_checkpoint_interval = activation_checkpoint_interval
+        self.activation_checkpoint_func = activation_checkpoint_func
+        self._parts = None
+
+    def partition_layers(self, num_stages, param_counts=None, method=None):
+        """Compute stage boundaries (reference ``module.py:348-403``)."""
+        method = (method or self.partition_method).lower()
+        n = len(self.layer_specs)
+        if method == "uniform":
+            parts = partition_uniform(num_items=n, num_parts=num_stages)
+        elif method == "parameters":
+            assert param_counts is not None, "parameters method needs param counts"
+            parts = partition_balanced(weights=param_counts, num_parts=num_stages)
+        elif method.startswith("type:"):
+            import re
+
+            regex = method.split(":", 1)[1]
+            weights = [1 if re.search(regex, s.typename.__name__, re.IGNORECASE) else 0
+                       for s in self.layer_specs]
+            parts = partition_balanced(weights=weights, num_parts=num_stages)
+        elif method == "profile":
+            raise NotImplementedError("Partitioning by profiling is not implemented.")
+        else:
+            raise NotImplementedError(f"Partitioning method {method} not implemented.")
+        self._parts = parts
+        return parts

@@ -1,0 +1,32 @@
+"""Progressive Layer Drop (reference ``runtime/progressive_layer_drop.py:5-35``).
+
+Keep-probability schedule θ(t) = (1-θ̄)·exp(-γ·t) + θ̄.  The engine passes
+``theta`` into the model's apply as a traced scalar each step, so the
+schedule never recompiles; models implement the actual stochastic layer
+skip (see ``models/bert.py``).
+"""
+
+import numpy as np
+
+from ..utils.logging import log_dist
+
+
+class ProgressiveLayerDrop(object):
+    def __init__(self, theta=0.5, gamma=0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+        log_dist(f"Enabled progressive layer dropping (theta = {self.theta})", ranks=[0])
+
+    def get_state(self):
+        kwargs = {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+        return kwargs
+
+    def get_theta(self):
+        return self.current_theta
+
+    def update_state(self, global_step):
+        def _prob(x, gamma, p):
+            return (1.0 - p) * np.exp(-gamma * x) + p
+
+        self.current_theta = _prob(global_step, self.gamma, self.theta)

@@ -1,0 +1,75 @@
+"""Tiny model fixtures (analog of reference ``tests/unit/simple_model.py``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class SimpleModel:
+    """Linear stack with MSE loss; conforms to the engine's model contract."""
+
+    def __init__(self, hidden_dim, nlayers=1):
+        self.hidden_dim = hidden_dim
+        self.nlayers = nlayers
+
+    def init(self, rng):
+        params = {}
+        for i in range(self.nlayers):
+            k1, k2, rng = jax.random.split(rng, 3)
+            params[f"layer_{i}"] = {
+                "w": jax.random.normal(k1, (self.hidden_dim, self.hidden_dim),
+                                       jnp.float32) * 0.1,
+                "b": jnp.zeros((self.hidden_dim,), jnp.float32),
+            }
+        return params
+
+    def apply(self, params, batch, rng=None, train=True, **kwargs):
+        x, y = batch
+        h = x
+        for i in range(self.nlayers):
+            p = params[f"layer_{i}"]
+            h = jnp.tanh(h @ p["w"] + p["b"])
+        loss = jnp.mean((h - y) ** 2)
+        return loss
+
+
+class SimpleMLPWithLogits(SimpleModel):
+    """Variant returning logits when train=False (eval-path testing)."""
+
+    def apply(self, params, batch, rng=None, train=True, **kwargs):
+        x = batch[0] if isinstance(batch, (tuple, list)) else batch
+        h = x
+        for i in range(self.nlayers):
+            p = params[f"layer_{i}"]
+            h = jnp.tanh(h @ p["w"] + p["b"])
+        if not train:
+            return h
+        y = batch[1]
+        return jnp.mean((h - y) ** 2)
+
+
+def random_dataset(total_samples, hidden_dim, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(total_samples, hidden_dim)).astype(np.float32)
+    y = rng.normal(size=(total_samples, hidden_dim)).astype(np.float32)
+    return [(x[i], y[i]) for i in range(total_samples)]
+
+
+def random_batches(num_batches, batch_size, hidden_dim, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(num_batches):
+        x = rng.normal(size=(batch_size, hidden_dim)).astype(np.float32)
+        y = rng.normal(size=(batch_size, hidden_dim)).astype(np.float32)
+        out.append((x, y))
+    return out
+
+
+def base_config(**overrides):
+    cfg = {
+        "train_batch_size": 16,
+        "steps_per_print": 100,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.01}},
+    }
+    cfg.update(overrides)
+    return cfg

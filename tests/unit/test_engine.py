@@ -1,0 +1,174 @@
+"""End-to-end engine tests over a virtual 8-device data mesh (modeled on
+reference ``tests/unit/test_fp16.py`` / ``test_zero.py`` coverage)."""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu as deepspeed
+from deepspeed_tpu.parallel import make_mesh
+
+from .simple_model import SimpleModel, base_config, random_batches
+
+HIDDEN = 16
+
+
+def make_engine(config, cpu_devices, dp=8, nlayers=2):
+    mesh = make_mesh({"data": dp}, devices=cpu_devices[:dp])
+    model = SimpleModel(HIDDEN, nlayers=nlayers)
+    engine, opt, loader, sched = deepspeed.initialize(
+        model=model, config=config, mesh=mesh)
+    return engine
+
+
+def train_losses(engine, steps=5, seed=0):
+    gas = engine.gradient_accumulation_steps()
+    batches = random_batches(steps * gas,
+                             engine.train_micro_batch_size_per_gpu() * engine.dp_world_size,
+                             HIDDEN, seed=seed)
+    it = iter(batches)
+    losses = []
+    for _ in range(steps):
+        loss = engine.train_batch(it)
+        losses.append(float(np.asarray(loss)))
+    return losses
+
+
+@pytest.mark.parametrize("stage", [0, 1, 2, 3])
+def test_zero_stages_train(stage, cpu_devices):
+    config = base_config(zero_optimization={"stage": stage},
+                         bf16={"enabled": stage > 0})
+    engine = make_engine(config, cpu_devices)
+    losses = train_losses(engine, steps=6)
+    assert losses[-1] < losses[0], f"loss did not decrease: {losses}"
+    assert engine.global_steps == 6
+
+
+def test_zero_stage_parity(cpu_devices):
+    """All ZeRO stages must produce identical training trajectories (the
+    reference asserts ZeRO correctness against unsharded training,
+    ``test_zero.py:32``)."""
+    trajs = {}
+    for stage in [0, 1, 2, 3]:
+        config = base_config(zero_optimization={"stage": stage})
+        engine = make_engine(config, cpu_devices)
+        trajs[stage] = train_losses(engine, steps=4)
+    for stage in [1, 2, 3]:
+        np.testing.assert_allclose(trajs[stage], trajs[0], rtol=2e-5,
+                                   err_msg=f"stage {stage} diverged from stage 0")
+
+
+def test_gradient_accumulation(cpu_devices):
+    """grad_acc=2 with half micro-batch must match grad_acc=1 trajectories."""
+    cfg1 = base_config(train_batch_size=16, gradient_accumulation_steps=1)
+    cfg2 = base_config(train_batch_size=16, gradient_accumulation_steps=2)
+    e1 = make_engine(cfg1, cpu_devices)
+    e2 = make_engine(cfg2, cpu_devices)
+
+    batches = random_batches(8, 16, HIDDEN, seed=3)
+    l1 = []
+    for i in range(4):
+        l1.append(float(np.asarray(e1.train_batch(iter([batches[2 * i]])))))
+        # feed same data twice? no: grad-acc engine consumes two half batches
+    # Build half micro-batches for e2: split each full batch into two halves
+    # along batch dim scaled so the accumulated gradient matches.
+    l2 = []
+    for i in range(4):
+        x, y = batches[2 * i]
+        halves = [(x[:8], y[:8]), (x[8:], y[8:])]
+        l2.append(float(np.asarray(e2.train_batch(iter(halves)))))
+    # identical data split across micro batches: mean loss equal, updates equal
+    np.testing.assert_allclose(l2, l1, rtol=2e-5)
+
+
+def test_dataloader_and_train(cpu_devices):
+    from .simple_model import random_dataset
+
+    config = base_config()
+    mesh = make_mesh({"data": 8}, devices=cpu_devices)
+    model = SimpleModel(HIDDEN, nlayers=1)
+    engine, _, loader, _ = deepspeed.initialize(
+        model=model, config=config, mesh=mesh,
+        training_data=random_dataset(64, HIDDEN))
+    assert loader is not None
+    assert len(loader) == 4
+    loss = engine.train_batch()
+    assert np.isfinite(float(np.asarray(loss)))
+
+
+def test_fp16_dynamic_loss_scale_skips(cpu_devices):
+    """Overflow must skip the update, halve the scale, and count the skip
+    (reference ``test_dynamic_loss_scale.py`` semantics)."""
+    config = base_config(
+        fp16={"enabled": True, "initial_scale_power": 4, "loss_scale_window": 2,
+              "hysteresis": 1, "min_loss_scale": 0.25})
+    engine = make_engine(config, cpu_devices, nlayers=1)
+    assert engine.loss_scale == 2 ** 4
+
+    batches = random_batches(4, 16, HIDDEN, seed=1)
+    master_before = np.asarray(engine.get_master_params())
+
+    # Poison one batch to force inf grads.
+    x, y = batches[0]
+    x_bad = x.copy()
+    x_bad[0, 0] = np.float32(np.inf)
+    engine.train_batch(iter([(x_bad, y)]))
+    assert engine.skipped_steps == 1
+    assert engine.loss_scale == 2 ** 3
+    master_after = np.asarray(engine.get_master_params())
+    np.testing.assert_array_equal(master_before, master_after)
+
+    # A clean step applies normally.
+    engine.train_batch(iter([batches[1]]))
+    assert engine.skipped_steps == 1
+    assert not np.array_equal(np.asarray(engine.get_master_params()), master_before)
+
+
+def test_scale_window_growth(cpu_devices):
+    config = base_config(
+        fp16={"enabled": True, "initial_scale_power": 4, "loss_scale_window": 2,
+              "hysteresis": 1})
+    engine = make_engine(config, cpu_devices, nlayers=1)
+    batches = random_batches(4, 16, HIDDEN, seed=2)
+    for b in batches:
+        engine.train_batch(iter([b]))
+    # 4 good steps with window 2 → scale doubled twice
+    assert engine.loss_scale == 2 ** 6
+
+
+def test_lamb_optimizer(cpu_devices):
+    config = base_config(optimizer={"type": "Lamb", "params": {"lr": 0.01}},
+                         zero_optimization={"stage": 2}, bf16={"enabled": True})
+    engine = make_engine(config, cpu_devices)
+    losses = train_losses(engine, steps=5)
+    assert losses[-1] < losses[0]
+
+
+def test_warmup_lr_schedule(cpu_devices):
+    config = base_config(
+        scheduler={"type": "WarmupLR",
+                   "params": {"warmup_min_lr": 0.0, "warmup_max_lr": 0.01,
+                              "warmup_num_steps": 10}})
+    engine = make_engine(config, cpu_devices)
+    lrs = []
+    batches = random_batches(5, 16, HIDDEN)
+    for b in batches:
+        engine.train_batch(iter([b]))
+        lrs.append(engine.get_lr()[0])
+    assert lrs == sorted(lrs)
+    # log-warmup: first step lands at gamma=log(1)=0 → min_lr (reference
+    # WarmupLR._get_gamma, lr_schedules.py:745-748)
+    assert lrs[0] == 0.0
+    assert lrs[1] > 0.0
+    assert lrs[-1] < 0.01
+
+
+def test_eval_batch(cpu_devices):
+    from .simple_model import SimpleMLPWithLogits
+
+    config = base_config()
+    mesh = make_mesh({"data": 8}, devices=cpu_devices)
+    model = SimpleMLPWithLogits(HIDDEN, nlayers=1)
+    engine, _, _, _ = deepspeed.initialize(model=model, config=config, mesh=mesh)
+    x = np.random.default_rng(0).normal(size=(16, HIDDEN)).astype(np.float32)
+    out = engine.eval_batch((x, x))
+    assert out.shape == (16, HIDDEN)
