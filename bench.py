@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Benchmark: BERT-large pretraining throughput on one TPU chip.
+
+Mirrors the reference's headline single-GPU number — BERT-large seq128
+samples/sec (272 samples/s on V100-32GB, ``BASELINE.md``).  Runs the full
+DeepSpeed-TPU engine train step (fwd + bwd + fused Adam) in bf16 with flash
+attention on the available accelerator and prints ONE JSON line.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_SAMPLES_PER_SEC = 272.0  # V100-32GB, reference fastest-bert post
+SEQ = 128
+VOCAB = 30528
+
+
+def main():
+    import jax
+
+    import deepspeed_tpu as deepspeed
+    from deepspeed_tpu.models import BertConfig, BertForPreTrainingTPU
+    from deepspeed_tpu.parallel import make_mesh
+
+    batch = int(os.environ.get("BENCH_BATCH", "16"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "5"))
+
+    dev = jax.devices()[0]
+    mesh = make_mesh({"data": 1}, devices=[dev])
+
+    config = {
+        "train_batch_size": batch,
+        "steps_per_print": 10 ** 9,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+        "bf16": {"enabled": True},
+    }
+    model = BertForPreTrainingTPU(
+        BertConfig.bert_large(max_position_embeddings=512, vocab_size=VOCAB,
+                              hidden_dropout_prob=0.0,
+                              attention_probs_dropout_prob=0.0),
+        compute_dtype=None)
+    engine, *_ = deepspeed.initialize(model=model, config=config, mesh=mesh)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, VOCAB, size=(batch, SEQ)).astype(np.int32)
+    b = {
+        "input_ids": ids,
+        "attention_mask": np.ones((batch, SEQ), np.int32),
+        "token_type_ids": np.zeros((batch, SEQ), np.int32),
+        "masked_lm_labels": np.where(rng.random((batch, SEQ)) < 0.15, ids,
+                                     -100).astype(np.int32),
+        "next_sentence_labels": rng.integers(0, 2, size=(batch,)).astype(np.int32),
+    }
+
+    def one_step():
+        loss = engine.train_batch(iter([b]))
+        return loss
+
+    for _ in range(warmup):
+        loss = one_step()
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = one_step()
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = batch * steps / dt
+    print(json.dumps({
+        "metric": "bert_large_seq128_samples_per_sec_per_chip",
+        "value": round(samples_per_sec, 2),
+        "unit": "samples/s",
+        "vs_baseline": round(samples_per_sec / BASELINE_SAMPLES_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,3 @@
+from .bert import BertConfig, BertForPreTrainingTPU, BertModel
+from .gpt2 import GPT2Config, GPT2LMHeadTPU
+from .layers import TransformerLayer, cross_entropy_with_logits

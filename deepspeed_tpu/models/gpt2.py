@@ -1,0 +1,130 @@
+"""GPT-2 model family (flagship decoder model).
+
+Fills the role of the reference's Megatron-GPT2 integration tests and perf
+configs (``tests/model/Megatron_GPT2``; BASELINE configs #3/#4).  Decoder-
+only transformer with pre-layernorm blocks (GPT-2 convention), causal flash
+attention, weight-tied LM head, optional per-layer remat, and Megatron-style
+tensor-parallel partition specs.
+
+Batch contract: ``batch = {"input_ids"[, "labels"]}``; labels default to
+shifted input_ids; ``-100`` positions are ignored.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .layers import (TransformerLayer, cross_entropy_with_logits, dropout,
+                     embedding_init, layer_norm)
+
+
+class GPT2Config:
+    def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
+                 num_heads=12, max_position_embeddings=1024,
+                 embd_dropout=0.1, attn_dropout=0.1, resid_dropout=0.1,
+                 initializer_range=0.02, layer_norm_eps=1e-5, remat=False):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.max_position_embeddings = max_position_embeddings
+        self.embd_dropout = embd_dropout
+        self.attn_dropout = attn_dropout
+        self.resid_dropout = resid_dropout
+        self.initializer_range = initializer_range
+        self.layer_norm_eps = layer_norm_eps
+        self.remat = remat
+
+    @staticmethod
+    def gpt2_small(**kw):
+        return GPT2Config(hidden_size=768, num_layers=12, num_heads=12, **kw)
+
+    @staticmethod
+    def gpt2_medium(**kw):
+        """GPT-2 345M (BASELINE config #3)."""
+        return GPT2Config(hidden_size=1024, num_layers=24, num_heads=16, **kw)
+
+    @staticmethod
+    def gpt2_large(**kw):
+        return GPT2Config(hidden_size=1280, num_layers=36, num_heads=20, **kw)
+
+    @staticmethod
+    def gpt2_xl(**kw):
+        """GPT-2 1.5B (BASELINE config #4)."""
+        return GPT2Config(hidden_size=1600, num_layers=48, num_heads=25, **kw)
+
+
+class GPT2LMHeadTPU:
+    def __init__(self, config: GPT2Config, compute_dtype=None):
+        self.config = config
+        self.compute_dtype = compute_dtype
+        self.layer = TransformerLayer(
+            hidden_size=config.hidden_size, heads=config.num_heads,
+            causal=True, attn_dropout_ratio=config.attn_dropout,
+            hidden_dropout_ratio=config.resid_dropout, pre_layer_norm=True,
+            initializer_range=config.initializer_range,
+            layer_norm_eps=config.layer_norm_eps)
+
+    def init(self, rng):
+        c = self.config
+        keys = jax.random.split(rng, c.num_layers + 3)
+        return {
+            "wte": embedding_init(keys[0], c.vocab_size, c.hidden_size,
+                                  c.initializer_range),
+            "wpe": embedding_init(keys[1], c.max_position_embeddings,
+                                  c.hidden_size, c.initializer_range),
+            "blocks": {f"layer_{i}": self.layer.init(keys[2 + i])
+                       for i in range(c.num_layers)},
+            "ln_f": {"scale": jnp.ones((c.hidden_size,), jnp.float32),
+                     "bias": jnp.zeros((c.hidden_size,), jnp.float32)},
+        }
+
+    def partition_specs(self, mesh):
+        c = self.config
+        has_model = "model" in mesh.axis_names
+        layer_spec = TransformerLayer.partition_specs()
+        return {
+            "wte": P("model", None) if has_model else P(),
+            "wpe": P(),
+            "blocks": {f"layer_{i}": layer_spec for i in range(c.num_layers)},
+            "ln_f": {"scale": P(), "bias": P()},
+        }
+
+    def logits(self, params, input_ids, rng=None, deterministic=True):
+        c = self.config
+        b, s = input_ids.shape
+        x = jnp.take(params["wte"], input_ids, axis=0) + params["wpe"][None, :s]
+        if self.compute_dtype is not None:
+            x = x.astype(self.compute_dtype)
+        if rng is not None and not deterministic:
+            rng_e, rng = jax.random.split(rng)
+            x = dropout(rng_e, x, c.embd_dropout, deterministic)
+
+        def run_layer(layer_params, x, layer_rng):
+            return self.layer.apply(layer_params, x, rng=layer_rng,
+                                    deterministic=deterministic)
+
+        if c.remat:
+            run_layer = jax.checkpoint(run_layer)
+
+        for i in range(c.num_layers):
+            layer_rng = None
+            if rng is not None and not deterministic:
+                rng, layer_rng = jax.random.split(rng)
+            x = run_layer(params["blocks"][f"layer_{i}"], x, layer_rng)
+
+        x = layer_norm(params["ln_f"], x, c.layer_norm_eps)
+        return x @ params["wte"].T.astype(x.dtype)  # tied LM head
+
+    def apply(self, params, batch, rng=None, train=True, **kw):
+        input_ids = batch["input_ids"] if isinstance(batch, dict) else batch
+        logits = self.logits(params, input_ids, rng=rng, deterministic=not train)
+        if not train and not (isinstance(batch, dict) and "labels" in batch):
+            return logits
+        if isinstance(batch, dict) and "labels" in batch:
+            labels = batch["labels"]
+        else:
+            labels = jnp.concatenate(
+                [input_ids[:, 1:],
+                 jnp.full((input_ids.shape[0], 1), -100, input_ids.dtype)], axis=1)
+        return cross_entropy_with_logits(logits, labels, ignore_index=-100)

@@ -3,11 +3,17 @@
 The reference implements optimizers as chunked multi-tensor CUDA kernels
 (``csrc/adam/multi_tensor_adam.cu``, ``csrc/lamb/fused_lamb_cuda_kernel.cu``)
 to amortize launch overhead.  On TPU the analog is a *flat parameter space*:
-all parameters live in one 1-D fp32 buffer (padded to the data-parallel
-degree), the optimizer update is one fused elementwise XLA computation over
-it, and ZeRO sharding is a trivial even split of the buffer along the
-``data`` mesh axis.  Per-tensor structure (needed by LAMB trust ratios and
-checkpoint I/O) is carried by a static ``Segments`` descriptor.
+all parameters live in one fp32 buffer, the optimizer update is one fused
+elementwise XLA computation over it, and ZeRO sharding is an even split of
+the buffer along the ``data`` mesh axis.
+
+TPU layout note: the buffer is 2-D ``(rows, LANES=1024)``, **not** 1-D.
+XLA TPU factorizes large 1-D arrays into pathological 2-D layouts (e.g.
+``[N/2, 2]`` whose lane dim pads 2→128, a 64× memory blow-up observed with
+BERT-large); a 1024-lane 2-D buffer tiles natively.  Each tensor starts on
+a row boundary so per-tensor views are contiguous row ranges, and the row
+count is padded to the DP degree so shards split evenly — the analog of the
+reference's comm-interval alignment (``stage1.py:32-103``).
 """
 
 from typing import List, NamedTuple, Tuple
@@ -15,41 +21,61 @@ from typing import List, NamedTuple, Tuple
 import jax.numpy as jnp
 import numpy as np
 
+LANES = 1024
+
 
 class Segments(NamedTuple):
-    """Static map from flat-buffer offsets back to parameter tensors."""
+    """Static map from flat-buffer rows back to parameter tensors."""
 
-    offsets: Tuple[int, ...]   # start offset of each tensor
-    sizes: Tuple[int, ...]     # element count of each tensor
-    total: int                 # flat length including padding
+    row_offsets: Tuple[int, ...]  # first row of each tensor
+    row_counts: Tuple[int, ...]   # rows occupied by each tensor
+    sizes: Tuple[int, ...]        # true element count of each tensor
+    rows: int                     # total rows including padding
 
     @property
     def num_segments(self):
         return len(self.sizes)
 
+    @property
+    def total(self):
+        """Total element capacity of the buffer."""
+        return self.rows * LANES
+
+    @property
+    def shape(self):
+        return (self.rows, LANES)
+
     def segment_ids(self) -> np.ndarray:
-        """i32[total] mapping each flat element to its tensor index; padding
-        elements map to an extra trailing segment id."""
-        ids = np.full((self.total,), self.num_segments, dtype=np.int32)
-        for i, (o, n) in enumerate(zip(self.offsets, self.sizes)):
-            ids[o:o + n] = i
+        """i32[rows, LANES] mapping each element to its tensor index; padding
+        (inter-tensor row tails + trailing rows) maps to ``num_segments``."""
+        ids = np.full((self.rows, LANES), self.num_segments, dtype=np.int32)
+        flat = ids.reshape(-1)
+        for i, (ro, n) in enumerate(zip(self.row_offsets, self.sizes)):
+            start = ro * LANES
+            flat[start:start + n] = i
         return ids
 
 
 def build_segments(sizes: List[int], pad_to: int = 1) -> Segments:
-    offsets = []
-    off = 0
+    """Row-aligned segment layout; ``pad_to`` pads total rows to a multiple
+    (the DP shard count)."""
+    row_offsets = []
+    row_counts = []
+    row = 0
     for n in sizes:
-        offsets.append(off)
-        off += n
-    total = off
-    if pad_to > 1 and total % pad_to != 0:
-        total += pad_to - (total % pad_to)
-    return Segments(offsets=tuple(offsets), sizes=tuple(sizes), total=total)
+        rc = -(-n // LANES)
+        row_offsets.append(row)
+        row_counts.append(rc)
+        row += rc
+    if pad_to > 1 and row % pad_to != 0:
+        row += pad_to - (row % pad_to)
+    return Segments(row_offsets=tuple(row_offsets), row_counts=tuple(row_counts),
+                    sizes=tuple(sizes), rows=row)
 
 
 def segment_l2_norms(flat: jnp.ndarray, segment_ids: jnp.ndarray, num_segments: int):
-    """Per-tensor L2 norms of a flat buffer in one scatter-add pass."""
-    sq = jnp.asarray(flat, jnp.float32) ** 2
-    sums = jnp.zeros((num_segments + 1,), jnp.float32).at[segment_ids].add(sq)
+    """Per-tensor L2 norms of the (rows, LANES) buffer in one scatter-add."""
+    sq = (jnp.asarray(flat, jnp.float32) ** 2).reshape(-1)
+    ids = segment_ids.reshape(-1)
+    sums = jnp.zeros((num_segments + 1,), jnp.float32).at[ids].add(sq)
     return jnp.sqrt(sums[:num_segments])

@@ -1,0 +1,60 @@
+"""Attention dispatch: Pallas flash attention on TPU, jnp reference elsewhere.
+
+This is the TPU-native stand-in for the reference's fused attention kernel
+chain (strided-batch GEMMs + fused scale/mask softmax,
+``csrc/transformer/softmax_kernels.cu``, ``ds_transformer_cuda.cpp:145``).
+The Pallas path (``ops/transformer/flash_attention.py``) computes attention
+blockwise without materializing the [s, s] score matrix (flash-attention
+style), which is both the memory story (long sequences) and the HBM-
+bandwidth story on TPU.
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def _use_pallas(q):
+    try:
+        return (jax.default_backend() == "tpu" and q.shape[1] >= 128
+                and q.shape[1] % 128 == 0 and q.shape[-1] % 64 == 0)
+    except Exception:
+        return False
+
+
+def reference_attention(q, k, v, mask=None, causal=False, dropout_rate=0.0,
+                        dropout_rng=None, deterministic=True):
+    """jnp attention: [b, s, h, d] inputs, fp32 softmax accumulation."""
+    b, s, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        causal_mask = jnp.tril(jnp.ones((s, k.shape[1]), bool))
+        scores = jnp.where(causal_mask[None, None], scores, jnp.float32(-1e9))
+    if mask is not None:
+        scores = scores + mask.astype(jnp.float32)
+    probs = jax.nn.softmax(scores, axis=-1)
+    if not deterministic and dropout_rate > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
+    ctx = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    return ctx
+
+
+def dot_product_attention(q, k, v, mask=None, causal=False, dropout_rate=0.0,
+                          dropout_rng=None, deterministic=True):
+    """Multi-head attention on [batch, seq, heads, head_dim] tensors.
+
+    ``mask`` is an additive bias broadcastable to [b, h, q, k] (e.g. a
+    padding mask of -1e9 at masked keys), matching the reference layer's
+    attention-mask contract (``ops/transformer/transformer.py:155-244``).
+    """
+    if (_use_pallas(q) and dropout_rate == 0.0 and mask is None):
+        from .flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal)
+    return reference_attention(q, k, v, mask=mask, causal=causal,
+                               dropout_rate=dropout_rate, dropout_rng=dropout_rng,
+                               deterministic=deterministic)

@@ -331,7 +331,7 @@ class DeepSpeedEngine:
         sharding; scalars (step counters) replicate."""
         opt_shape = jax.eval_shape(
             self.optimizer.init_state,
-            jax.ShapeDtypeStruct((self.segments.total,), jnp.float32))
+            jax.ShapeDtypeStruct(self.segments.shape, jnp.float32))
         return jax.tree_util.tree_map(
             lambda l: self.flat.master_sharding if l.ndim > 0 else self.flat.replicated,
             opt_shape)
@@ -630,12 +630,16 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # checkpointing (reference engine.py:1275-1573; layout notes SURVEY §3.5)
     # ------------------------------------------------------------------
+    @staticmethod
+    def _path_key(path):
+        """Tree path → checkpoint key.  Save and load must agree byte-for-byte."""
+        return "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+
     def _params_to_host(self, tree):
         flat, _ = jax.tree_util.tree_flatten_with_path(tree)
         out = {}
         for path, leaf in flat:
-            key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
-            out[key] = np.asarray(jax.device_get(leaf))
+            out[self._path_key(path)] = np.asarray(jax.device_get(leaf))
         return out
 
     def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True):
@@ -657,7 +661,16 @@ class DeepSpeedEngine:
                     for k, v in self._params_to_host(params).items()})
 
         unpadded = self.flat.gather_master_unpadded(self.state["master"])
-        opt_host = self._params_to_host(self.state["opt"])
+        # flat-shaped optimizer-state leaves are saved unpadded too, so the
+        # whole optimizer checkpoint is DP-degree elastic
+        opt_host = {}
+        flat_opt, _ = jax.tree_util.tree_flatten_with_path(self.state["opt"])
+        for path, leaf in flat_opt:
+            key = self._path_key(path)
+            if leaf.shape == self.segments.shape:
+                opt_host[key] = self.flat.gather_master_unpadded(leaf)
+            else:
+                opt_host[key] = np.asarray(jax.device_get(leaf))
         np.savez(os.path.join(ckpt_dir, OPTIM_STATES_NPZ),
                  master=np.asarray(unpadded),
                  **{f"opt/{k}": v for k, v in opt_host.items()})
@@ -755,12 +768,12 @@ class DeepSpeedEngine:
         flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
         leaves = []
         for path, leaf in flat:
-            key = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+            key = self._path_key(path)
             src = host_dict.get(key)
             assert src is not None, f"checkpoint missing key {key}"
             arr = np.asarray(src)
-            if arr.shape != leaf.shape and arr.size == sum(self.segments.sizes):
-                # flat buffer saved unpadded under a different DP degree
+            if arr.ndim == 1 and leaf.shape == self.segments.shape:
+                # flat buffer saved unpadded (possibly different DP degree)
                 arr = self.flat.repad_unpadded(arr)
             sharding = getattr(leaf, "sharding", None)
             leaves.append(jax.device_put(arr.astype(leaf.dtype), sharding))

@@ -18,12 +18,13 @@ stage  optimizer state / fp32 master   gradients
                                        sharded master each step
 =====  ==============================  =========================================
 
-All parameters are flattened (in ``tree_leaves`` order) into one fp32 buffer
-padded to the DP degree, so shard boundaries never split unevenly — the
+All parameters are flattened (in ``tree_leaves`` order) into one fp32
+``(rows, 1024)`` buffer — 2-D for sane TPU tiling, see ``ops/op_common.py``
+— with each tensor row-aligned and total rows padded to the DP degree, the
 analog of the reference's comm-interval-aligned sub-partitions
-(``stage1.py:32-103``).  Checkpoints store the buffer *unpadded*, giving
-DP-degree-elastic restore (the reference's "remove padding before save"
-trick, ``stage1.py:848-883``) for free.
+(``stage1.py:32-103``).  Checkpoints store the buffer *unpadded* (1-D,
+true sizes), giving DP-degree-elastic restore (the reference's "remove
+padding before save" trick, ``stage1.py:848-883``) for free.
 
 ZeRO-Offload (``cpu_offload``): the master/optimizer shardings request
 ``pinned_host`` memory space, keeping fp32 state in host RAM; XLA streams
@@ -37,9 +38,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ...ops.op_common import build_segments
+from ...ops.op_common import LANES, build_segments
 from ...utils.logging import logger
-from ..utils import flatten_tree
 
 
 class FlatParamCoordinator:
@@ -73,44 +73,84 @@ class FlatParamCoordinator:
 
     # -- host-side (eager) --
     def flatten_to_master(self, params) -> jax.Array:
-        """Build the initial flat fp32 master from a params pytree."""
+        """Build the initial (rows, LANES) fp32 master from a params pytree."""
         with self.mesh:
-            flat = jax.jit(lambda t: self._flatten_traced(t),
+            flat = jax.jit(self._flatten_traced,
                            out_shardings=self.master_sharding)(params)
         return flat
 
     def gather_master_unpadded(self, master) -> np.ndarray:
-        n = sum(self.segments.sizes)
-        return np.asarray(jax.device_get(master))[:n]
+        """Concatenated true-sized 1-D host copy (checkpoint format)."""
+        host = np.asarray(jax.device_get(master)).reshape(-1)
+        parts = []
+        for ro, n in zip(self.segments.row_offsets, self.segments.sizes):
+            start = ro * LANES
+            parts.append(host[start:start + n])
+        return np.concatenate(parts) if parts else np.zeros((0,), np.float32)
 
     def repad_unpadded(self, arr: np.ndarray) -> np.ndarray:
-        out = np.zeros((self.segments.total,), np.float32)
-        out[:arr.size] = arr
-        return out
+        """1-D true-sized buffer → (rows, LANES) padded layout."""
+        arr = np.asarray(arr).reshape(-1)
+        out = np.zeros((self.segments.rows * LANES,), np.float32)
+        off = 0
+        for ro, n in zip(self.segments.row_offsets, self.segments.sizes):
+            out[ro * LANES:ro * LANES + n] = arr[off:off + n]
+            off += n
+        assert off == arr.size, (
+            f"checkpoint flat buffer has {arr.size} elements, expected {off}")
+        return out.reshape(self.segments.shape)
 
     def scatter_master_from_unpadded(self, arr: np.ndarray) -> jax.Array:
-        return jax.device_put(self.repad_unpadded(np.asarray(arr)),
-                              self.master_sharding)
+        return jax.device_put(self.repad_unpadded(arr), self.master_sharding)
 
     # -- traced (inside jit) --
     def _flatten_traced(self, tree, dtype=jnp.float32):
-        flat = flatten_tree(tree, dtype=dtype)
-        pad = self.segments.total - flat.shape[0]
-        if pad:
-            flat = jnp.concatenate([flat, jnp.zeros((pad,), dtype)])
-        return flat
+        """Pytree → (rows, LANES) buffer.  Each leaf is padded to a whole
+        number of rows and reshaped 2-D *before* concatenation, so no giant
+        1-D intermediate ever materializes."""
+        leaves = jax.tree_util.tree_leaves(tree)
+        assert len(leaves) == self.segments.num_segments, (
+            f"pytree has {len(leaves)} leaves but the coordinator was built "
+            f"for {self.segments.num_segments} (model changed after init?)")
+        blocks = []
+        for leaf, rc, n in zip(leaves, self.segments.row_counts, self.segments.sizes):
+            # Replicate each leaf before the concat: with model-parallel
+            # (tp-sharded) leaves, concatenating mixed shardings straight
+            # into a row-sharded output makes GSPMD fall back to
+            # "involuntary full rematerialization" of the whole buffer; a
+            # per-leaf all-gather is the clean form of the same transfer.
+            fl = jax.lax.with_sharding_constraint(
+                jnp.ravel(leaf).astype(dtype), self.replicated)
+            pad = rc * LANES - n
+            if pad:
+                fl = jnp.concatenate([fl, jnp.zeros((pad,), dtype)])
+            blocks.append(fl.reshape(rc, LANES))
+        tail = self.segments.rows - sum(self.segments.row_counts)
+        if tail:
+            blocks.append(jnp.zeros((tail, LANES), dtype))
+        if not blocks:
+            return jnp.zeros(self.segments.shape, dtype)
+        return jnp.concatenate(blocks, axis=0)
 
     def flatten_grads(self, grads):
         return self._flatten_traced(grads, jnp.float32)
 
     def unflatten_params(self, master, template, dtype):
-        """flat master → params pytree in compute dtype.  The replication
-        constraint first forces a single all-gather of the shard(s) instead
-        of per-leaf gathers (the reference's bucketed sequential all_gather,
-        ``stage2.py:1444-1477``, collapsed into one collective)."""
+        """(rows, LANES) master → params pytree in compute dtype.  The
+        replication constraint first forces a single all-gather of the
+        shard(s) instead of per-leaf gathers (the reference's bucketed
+        sequential all_gather, ``stage2.py:1444-1477``, collapsed into one
+        collective)."""
         flat = jax.lax.with_sharding_constraint(master, self.replicated)
         leaves, treedef = jax.tree_util.tree_flatten(template)
+        assert len(leaves) == self.segments.num_segments, (
+            f"template has {len(leaves)} leaves but the coordinator was built "
+            f"for {self.segments.num_segments} (model changed after init?)")
         out = []
-        for (o, n), leaf in zip(zip(self.segments.offsets, self.segments.sizes), leaves):
-            out.append(flat[o:o + n].reshape(leaf.shape).astype(dtype))
+        for ro, rc, n, leaf in zip(self.segments.row_offsets,
+                                   self.segments.row_counts,
+                                   self.segments.sizes, leaves):
+            rows = flat[ro:ro + rc]
+            vals = rows.reshape(-1)[:n]
+            out.append(vals.reshape(leaf.shape).astype(dtype))
         return jax.tree_util.tree_unflatten(treedef, out)

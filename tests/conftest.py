@@ -14,12 +14,20 @@ import os
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-# Prefer CPU for tests: compiles are fast and results deterministic.  (The
-# axon TPU plugin may still register; tests pin meshes to cpu devices.)
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 import jax  # noqa: E402
 import pytest  # noqa: E402
+
+# Prefer CPU for tests: compiles are fast and results deterministic.  A
+# site hook may have imported jax at interpreter startup with a TPU
+# platform forced (e.g. JAX_PLATFORMS=axon), in which case mutating
+# os.environ here is too late — jax.config.update is the only switch
+# that still takes effect, and it avoids initializing (and dialing) the
+# TPU backend at all.  An explicit non-axon JAX_PLATFORMS (e.g. a
+# developer running the suite on real hardware) is honored.
+if os.environ.get("JAX_PLATFORMS", "axon") in ("axon", "", "axon,cpu"):
+    jax.config.update("jax_platforms", "cpu")
+    os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses tests spawn
 
 
 @pytest.fixture(scope="session")
