@@ -63,7 +63,7 @@ def main():
         loss = engine.train_batch(iter([b]))
         return loss
 
-    for _ in range(warmup):
+    for _ in range(max(warmup, 1)):
         loss = one_step()
     jax.block_until_ready(loss)
 

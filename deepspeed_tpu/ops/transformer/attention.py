@@ -16,10 +16,11 @@ import jax
 import jax.numpy as jnp
 
 
-def _use_pallas(q):
+def _use_pallas(q, k):
     try:
         return (jax.default_backend() == "tpu" and q.shape[1] >= 128
-                and q.shape[1] % 128 == 0 and q.shape[-1] % 64 == 0)
+                and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0
+                and q.shape[-1] % 64 == 0)
     except Exception:
         return False
 
@@ -51,7 +52,8 @@ def dot_product_attention(q, k, v, mask=None, causal=False, dropout_rate=0.0,
     padding mask of -1e9 at masked keys), matching the reference layer's
     attention-mask contract (``ops/transformer/transformer.py:155-244``).
     """
-    if (_use_pallas(q) and dropout_rate == 0.0 and mask is None):
+    if (_use_pallas(q, k) and (deterministic or dropout_rate == 0.0)
+            and mask is None):
         from .flash_attention import flash_attention
 
         return flash_attention(q, k, v, causal=causal)

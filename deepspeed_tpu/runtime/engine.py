@@ -54,7 +54,7 @@ from .dataloader import DeepSpeedDataLoader, RepeatingLoader
 from .fp16.loss_scaler import DynamicScaleState, update_scale_state
 from .lr_schedules import SCHEDULE_CLASSES
 from .progressive_layer_drop import ProgressiveLayerDrop
-from .utils import flatten_tree, unflatten_like
+from .utils import flatten_tree, tree_path_key, unflatten_like
 
 MODEL_STATES_NPZ = "model_states.npz"
 OPTIM_STATES_NPZ = "zero_optim_states.npz"
@@ -382,7 +382,10 @@ class DeepSpeedEngine:
         master_sharding = self.flat.master_sharding
         param_shardings = jax.tree_util.tree_map(
             lambda spec: NamedSharding(mesh, spec), self._param_specs)
-        grad_acc = float(self.gradient_accumulation_steps())
+        # PipelineEngine sets _grad_divisor=1: its apply() already averages
+        # the loss over micro-batches inside the compiled schedule.
+        grad_acc = float(getattr(self, "_grad_divisor", None)
+                         or self.gradient_accumulation_steps())
         stage3 = self.zero_stage >= 3
         fp16 = self._config.fp16_enabled
         clip = float(self._config.gradient_clipping or 0.0)
@@ -633,7 +636,7 @@ class DeepSpeedEngine:
     @staticmethod
     def _path_key(path):
         """Tree path → checkpoint key.  Save and load must agree byte-for-byte."""
-        return "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        return tree_path_key(path)
 
     def _params_to_host(self, tree):
         flat, _ = jax.tree_util.tree_flatten_with_path(tree)

@@ -1,20 +1,46 @@
 """Pipeline module: layer-sequence model expression + stage partitioning.
 
 Re-design of ``deepspeed/runtime/pipe/module.py`` (LayerSpec ``:23``,
-TiedLayerSpec ``:71``, PipelineModule ``:85``).  Full implementation arrives
-with the pipeline engine; this module currently provides the specs and the
-partitioning logic, which are pure Python and independently testable.
+TiedLayerSpec ``:71``, PipelineModule ``:85``).  Differences from the
+reference driven by SPMD execution:
+
+- The reference builds *only the local stage's* layers per rank
+  (``module.py:197-290``); under single-program SPMD every process traces
+  the full layer sequence and the per-stage restriction is expressed in the
+  compiled program (``pipe/engine.py``), so ``PipelineModule`` builds all
+  layers and owns the whole parameter pytree.
+- Tied layers (``TiedLayerSpec``) store parameters once under a shared key;
+  every use site references the same leaf, so autodiff *sums* the
+  cotangents — the reference's ``allreduce_tied_weight_gradients``
+  (``module.py:405-418``) is implicit.
+- Per-layer checkpoint files (``layer_NN-model_states``; reference
+  ``ckpt_layer_path``, ``module.py:526-567``) are kept so checkpoints can be
+  re-partitioned across different stage counts.
+
+Layer contract: a built layer is either
+
+- an object with ``init(rng) -> params`` and ``apply(params, x, **kw) -> y``,
+- or a plain callable ``f(x) -> y`` (parameter-less, e.g. a reshape).
+
+The final ``loss_fn(outputs, labels)`` maps the last layer's output and the
+batch labels to a scalar loss.
 """
 
-from ...runtime.utils import partition_balanced, partition_uniform
+import os
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...runtime.utils import partition_balanced, partition_uniform, tree_path_key
 from ...utils.logging import logger
 
 
 class LayerSpec:
     """Delayed-construction layer description (reference ``module.py:23-69``).
 
-    ``typename(*module_args, **module_kwargs)`` builds the layer object; under
-    pipeline parallelism only the owning stage builds it.
+    ``typename(*module_args, **module_kwargs)`` builds the layer object.
     """
 
     def __init__(self, typename, *module_args, **module_kwargs):
@@ -47,28 +73,180 @@ class TiedLayerSpec(LayerSpec):
 
 class PipelineModule:
     """Sequence-of-layers model for pipeline execution (reference
-    ``module.py:85-575``).  See ``pipe/engine.py`` for the TPU execution
-    model; partitioning (`partition_method`: 'uniform' | 'parameters' |
-    'type:regex') mirrors ``_partition_layers`` (reference ``:348-403``)."""
+    ``module.py:85-575``).
+
+    Args:
+        layers: iterable of LayerSpec / TiedLayerSpec / layer objects /
+            callables.
+        num_stages: pipeline depth (defaults to the mesh's ``pipe`` axis).
+        loss_fn: ``loss_fn(outputs, labels) -> scalar``.
+        partition_method: 'uniform' | 'parameters' | 'type:regex'
+            (reference ``_partition_layers``, ``module.py:348-403``).
+        activation_checkpoint_interval: remat every N layers (reference
+            ``forward``, ``module.py:292-346``).
+    """
 
     def __init__(self, layers, num_stages=None, topology=None,
                  loss_fn=None, seed_layers=False, seed_fn=None, base_seed=1234,
                  partition_method="parameters",
                  activation_checkpoint_interval=0,
                  activation_checkpoint_func=None):
-        self.layer_specs = list(layers)
+        self.layer_specs = []
+        for layer in layers:
+            if isinstance(layer, LayerSpec):
+                self.layer_specs.append(layer)
+            elif isinstance(layer, type):
+                self.layer_specs.append(LayerSpec(layer))
+            else:
+                # pre-built layer object or plain callable
+                self.layer_specs.append(layer)
         self.num_stages = num_stages
         self.topology = topology
         self.loss_fn = loss_fn
         self.seed_layers = seed_layers
+        self.seed_fn = seed_fn
         self.base_seed = base_seed
         self.partition_method = partition_method
         self.activation_checkpoint_interval = activation_checkpoint_interval
         self.activation_checkpoint_func = activation_checkpoint_func
         self._parts = None
+        self._build()
 
+    # ------------------------------------------------------------------
+    # building (reference module.py:197-290)
+    # ------------------------------------------------------------------
+    def _build(self):
+        self.layers = []
+        self.tied_keys = {}  # key -> index of owning (first) layer
+        self._tied_key_of = {}  # layer idx -> key
+        self._forward_fns = {}  # layer idx -> forward_fn override
+        for idx, spec in enumerate(self.layer_specs):
+            if isinstance(spec, TiedLayerSpec):
+                layer = spec.build()
+                if spec.key not in self.tied_keys:
+                    self.tied_keys[spec.key] = idx
+                self._tied_key_of[idx] = spec.key
+                if spec.forward_fn is not None:
+                    self._forward_fns[idx] = spec.forward_fn
+                self.layers.append(layer)
+            elif isinstance(spec, LayerSpec):
+                self.layers.append(spec.build())
+            else:
+                self.layers.append(spec)
+
+    @property
+    def num_layers(self):
+        return len(self.layers)
+
+    def has_params(self, idx):
+        layer = self.layers[idx]
+        return hasattr(layer, "init") and hasattr(layer, "apply")
+
+    # ------------------------------------------------------------------
+    # parameters
+    # ------------------------------------------------------------------
+    def init(self, rng):
+        """Build the parameter pytree: ``{"layers": [...], "tied": {...}}``.
+
+        Tied layers' parameters live once under ``tied/<key>``; their slot in
+        ``layers`` is an empty dict.  With ``seed_layers`` each layer gets a
+        self-contained seed ``base_seed + idx`` independent of ``rng``
+        (optionally mapped through ``seed_fn``), mirroring the reference's
+        per-layer RNG seeding (``module.py:225-239``) so layer idx N
+        initializes identically regardless of the stage partitioning.
+        """
+        layer_params = []
+        tied = {}
+        for idx, layer in enumerate(self.layers):
+            if self.seed_layers:
+                seed = self.base_seed + idx
+                if self.seed_fn is not None:
+                    seed = self.seed_fn(seed)
+                key = jax.random.PRNGKey(int(seed))
+            else:
+                key = jax.random.fold_in(rng, idx)
+            if not self.has_params(idx):
+                layer_params.append({})
+                continue
+            tkey = self._tied_key_of.get(idx)
+            if tkey is not None:
+                if self.tied_keys[tkey] == idx:
+                    tied[tkey] = layer.init(key)
+                layer_params.append({})
+            else:
+                layer_params.append(layer.init(key))
+        return {"layers": tuple(layer_params), "tied": tied}
+
+    def layer_param_counts(self, params):
+        """Per-layer parameter counts for 'parameters' partitioning
+        (reference ``module.py:388-393``).  Tied layers count at their
+        owning (first) occurrence only, like the reference, which only
+        builds/owns them on the first stage that uses them."""
+        counts = []
+        for idx in range(self.num_layers):
+            tkey = self._tied_key_of.get(idx)
+            if tkey is not None and self.tied_keys[tkey] == idx:
+                leaves = jax.tree_util.tree_leaves(params["tied"][tkey])
+            else:
+                leaves = jax.tree_util.tree_leaves(params["layers"][idx])
+            counts.append(int(sum(np.prod(l.shape) for l in leaves)))
+        return counts
+
+    def _layer_params(self, params, idx):
+        tkey = self._tied_key_of.get(idx)
+        if tkey is not None:
+            return params["tied"][tkey]
+        return params["layers"][idx]
+
+    # ------------------------------------------------------------------
+    # forward
+    # ------------------------------------------------------------------
+    def apply_layer(self, params, idx, x, **kw):
+        layer = self.layers[idx]
+        if idx in self._forward_fns:
+            return self._forward_fns[idx](self._layer_params(params, idx), x)
+        if self.has_params(idx):
+            return layer.apply(self._layer_params(params, idx), x, **kw)
+        return layer(x)
+
+    def apply_range(self, params, start, stop, x, **kw):
+        """Apply layers [start, stop), rematerializing every
+        ``activation_checkpoint_interval`` layers (reference
+        ``module.py:292-346``)."""
+        interval = self.activation_checkpoint_interval
+        if interval <= 0:
+            for idx in range(start, stop):
+                x = self.apply_layer(params, idx, x, **kw)
+            return x
+
+        def chunk_fn(lo, hi):
+            def run(params, x):
+                for idx in range(lo, hi):
+                    x = self.apply_layer(params, idx, x, **kw)
+                return x
+            return run
+
+        lo = start
+        while lo < stop:
+            hi = min(lo + interval, stop)
+            x = jax.checkpoint(chunk_fn(lo, hi))(params, x)
+            lo = hi
+        return x
+
+    def sequential_apply(self, params, batch, rng=None, train=False, **kw):
+        """Non-pipelined reference execution: fold all layers, apply loss."""
+        inputs, labels = split_batch(batch)
+        x = self.apply_range(params, 0, self.num_layers, inputs)
+        if self.loss_fn is not None and labels is not None:
+            return self.loss_fn(x, labels)
+        return x
+
+    # ------------------------------------------------------------------
+    # partitioning (reference module.py:348-403)
+    # ------------------------------------------------------------------
     def partition_layers(self, num_stages, param_counts=None, method=None):
-        """Compute stage boundaries (reference ``module.py:348-403``)."""
+        """Compute stage boundaries; returns ``parts`` with
+        ``len(parts) == num_stages + 1``."""
         method = (method or self.partition_method).lower()
         n = len(self.layer_specs)
         if method == "uniform":
@@ -77,15 +255,91 @@ class PipelineModule:
             assert param_counts is not None, "parameters method needs param counts"
             parts = partition_balanced(weights=param_counts, num_parts=num_stages)
         elif method.startswith("type:"):
-            import re
-
             regex = method.split(":", 1)[1]
-            weights = [1 if re.search(regex, s.typename.__name__, re.IGNORECASE) else 0
-                       for s in self.layer_specs]
+            weights = [
+                1 if _spec_matches(s, regex) else 0
+                for s in self.layer_specs
+            ]
             parts = partition_balanced(weights=weights, num_parts=num_stages)
         elif method == "profile":
             raise NotImplementedError("Partitioning by profiling is not implemented.")
         else:
             raise NotImplementedError(f"Partitioning method {method} not implemented.")
         self._parts = parts
+        for stage in range(num_stages):
+            logger.info(f"stage={stage} layers={parts[stage + 1] - parts[stage]} "
+                        f"[{parts[stage]}, {parts[stage + 1]})")
         return parts
+
+    # ------------------------------------------------------------------
+    # per-layer checkpointing (reference module.py:510-567)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def ckpt_layer_path(ckpt_dir, local_layer_idx):
+        """``layer_NN-model_states.npz`` (reference ``module.py:526-534``;
+        the mp_rank infix is dropped — TP shards are a sharding, not files)."""
+        return os.path.join(ckpt_dir, f"layer_{local_layer_idx:02d}-model_states.npz")
+
+    def save_state_dict(self, params, save_dir):
+        """One file per layer + one for tied params, so a different stage
+        partitioning can re-load them (reference ``module.py:536-546``)."""
+        os.makedirs(save_dir, exist_ok=True)
+        for idx in range(self.num_layers):
+            if not self.has_params(idx) or idx in self._tied_key_of:
+                continue
+            flat = _tree_to_host_dict(params["layers"][idx])
+            np.savez(self.ckpt_layer_path(save_dir, idx), **flat)
+        for key, tp in params["tied"].items():
+            np.savez(os.path.join(save_dir, f"tied_{key}-model_states.npz"),
+                     **_tree_to_host_dict(tp))
+
+    def load_state_dir(self, params, load_dir):
+        """Load per-layer files into a params pytree (reference
+        ``module.py:548-567``); returns the new pytree."""
+        layer_params = list(params["layers"])
+        for idx in range(self.num_layers):
+            if not self.has_params(idx) or idx in self._tied_key_of:
+                continue
+            path = self.ckpt_layer_path(load_dir, idx)
+            layer_params[idx] = _host_dict_to_tree(
+                params["layers"][idx], np.load(path))
+        tied = {}
+        for key, tp in params["tied"].items():
+            path = os.path.join(load_dir, f"tied_{key}-model_states.npz")
+            tied[key] = _host_dict_to_tree(tp, np.load(path))
+        return {"layers": tuple(layer_params), "tied": tied}
+
+
+def split_batch(batch):
+    """Batch convention: ``(inputs, labels)`` tuple, or a dict with
+    ``inputs``/``labels`` keys, or bare inputs (labels=None)."""
+    if isinstance(batch, (tuple, list)) and len(batch) == 2:
+        return batch[0], batch[1]
+    if isinstance(batch, dict) and "inputs" in batch:
+        return batch["inputs"], batch.get("labels")
+    return batch, None
+
+
+def _spec_matches(spec, regex):
+    if isinstance(spec, LayerSpec):
+        name = spec.typename.__name__
+    else:
+        name = type(spec).__name__
+    return re.search(regex, name, re.IGNORECASE) is not None
+
+
+def _tree_to_host_dict(tree):
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = {}
+    for path, leaf in flat:
+        out[tree_path_key(path) or "_"] = np.asarray(jax.device_get(leaf))
+    return out
+
+
+def _host_dict_to_tree(template, npz):
+    flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, leaf in flat:
+        arr = npz[tree_path_key(path) or "_"]
+        leaves.append(jnp.asarray(arr, dtype=leaf.dtype))
+    return jax.tree_util.tree_unflatten(treedef, leaves)

@@ -22,6 +22,13 @@ def is_model_parallel_parameter(p) -> bool:
     return getattr(p, "model_parallel", False)
 
 
+def tree_path_key(path) -> str:
+    """Canonical checkpoint key for a tree_flatten_with_path path.  Every
+    checkpoint writer/reader (engine, pipeline module) must share this so
+    their file formats stay byte-compatible."""
+    return "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+
+
 # ---------------------------------------------------------------------------
 # Flatten / unflatten over pytrees (analog of _flatten_dense_tensors;
 # reference engine.py:200, stage2.py:125 load the C++ op for this)

@@ -1,0 +1,143 @@
+"""Instruction-stream schedule tests (model: reference
+``tests/unit/test_pipe_schedule.py``)."""
+
+import pytest
+
+from deepspeed_tpu.runtime import pipe as schedule
+
+
+def _count(cmds, cls):
+    return sum(1 for c in cmds if isinstance(c, cls))
+
+
+def test_pipe_inference_schedule_singlestage():
+    sched = schedule.InferenceSchedule(micro_batches=4, stages=1, stage_id=0)
+    assert sched.num_micro_batches == 4
+    full = list(iter(sched))
+    for idx, cmds in enumerate(full):
+        assert len(cmds) == 2
+        assert isinstance(cmds[0], schedule.LoadMicroBatch)
+        assert isinstance(cmds[1], schedule.ForwardPass)
+        assert cmds[0].buffer_id == cmds[1].buffer_id
+    assert len(full) == sched.num_micro_batches
+
+
+def test_pipe_train_schedule_singlestage():
+    sched = schedule.TrainSchedule(micro_batches=4, stages=1, stage_id=0)
+    full = list(iter(sched))
+    # forward and backward ticks alternate on one stage
+    for idx, cmds in enumerate(full):
+        if (idx % 2) != 0:
+            assert len(cmds) == 1 or (idx == len(full) - 1 and len(cmds) == 4)
+            assert isinstance(cmds[0], schedule.BackwardPass)
+        else:
+            assert len(cmds) == 2
+            assert isinstance(cmds[0], schedule.LoadMicroBatch)
+            assert isinstance(cmds[1], schedule.ForwardPass)
+    assert len(full) == 2 * sched.num_micro_batches
+
+
+@pytest.mark.parametrize("micro_batches", [1, 3, 8, 10])
+def test_pipe_inference_schedule_firststage(micro_batches, stages=3):
+    sched = schedule.InferenceSchedule(micro_batches=micro_batches,
+                                       stages=stages, stage_id=0)
+    full = list(iter(sched))
+    for idx, cmds in enumerate(full):
+        if idx < sched.num_micro_batches:
+            assert _count(cmds, schedule.LoadMicroBatch) == 1
+            assert _count(cmds, schedule.ForwardPass) == 1
+        else:
+            # draining: no compute on first stage
+            assert _count(cmds, schedule.ForwardPass) == 0
+        # first stage never receives
+        assert _count(cmds, schedule.RecvActivation) == 0
+    assert len(full) == micro_batches + stages - 1
+
+
+@pytest.mark.parametrize("micro_batches", [1, 3, 8, 10])
+def test_pipe_inference_schedule_laststage(micro_batches, stages=3):
+    sched = schedule.InferenceSchedule(micro_batches=micro_batches,
+                                       stages=stages, stage_id=stages - 1)
+    full = list(iter(sched))
+    for idx, cmds in enumerate(full):
+        if idx < sched.stage_id:  # still filling
+            assert _count(cmds, schedule.ForwardPass) == 0
+        else:
+            assert _count(cmds, schedule.LoadMicroBatch) == 1
+            assert _count(cmds, schedule.RecvActivation) == 1
+            assert _count(cmds, schedule.ForwardPass) == 1
+        assert _count(cmds, schedule.SendActivation) == 0
+    assert len(full) == micro_batches + stages - 1
+
+
+def test_pipe_schedule_firststage_train():
+    sched = schedule.TrainSchedule(micro_batches=8, stages=3, stage_id=0)
+    total_fwd = total_bwd = 0
+    for cmds in sched:
+        total_fwd += _count(cmds, schedule.ForwardPass)
+        total_bwd += _count(cmds, schedule.BackwardPass)
+        # first stage never exchanges with a previous stage
+        assert _count(cmds, schedule.RecvActivation) == 0
+        assert _count(cmds, schedule.SendGrad) == 0
+    assert total_fwd == 8
+    assert total_bwd == 8
+
+
+@pytest.mark.parametrize("stages", [2, 3, 4])
+@pytest.mark.parametrize("micro_batches", [2, 4, 8])
+def test_pipe_train_schedule_all_stages_balanced(micro_batches, stages):
+    """Every stage forwards and backwards each micro-batch exactly once, and
+    the final tick carries the reduce + step instructions."""
+    for stage_id in range(stages):
+        sched = schedule.TrainSchedule(micro_batches=micro_batches,
+                                       stages=stages, stage_id=stage_id)
+        full = list(iter(sched))
+        assert len(full) == 2 * (micro_batches + stages - 1)
+        fwd = sum(_count(c, schedule.ForwardPass) for c in full)
+        bwd = sum(_count(c, schedule.BackwardPass) for c in full)
+        assert fwd == micro_batches
+        assert bwd == micro_batches
+        last = full[-1]
+        assert _count(last, schedule.ReduceTiedGrads) == 1
+        assert _count(last, schedule.ReduceGrads) == 1
+        assert _count(last, schedule.OptimizerStep) == 1
+        # sends/recvs pair across all stages
+        if stage_id > 0:
+            assert sum(_count(c, schedule.RecvActivation) for c in full) == micro_batches
+        if stage_id < stages - 1:
+            assert sum(_count(c, schedule.SendActivation) for c in full) == micro_batches
+
+
+def test_pipe_train_schedule_buffers():
+    # steady-state buffer count shrinks toward the last stage
+    sched0 = schedule.TrainSchedule(micro_batches=8, stages=4, stage_id=0)
+    sched3 = schedule.TrainSchedule(micro_batches=8, stages=4, stage_id=3)
+    assert sched0.num_pipe_buffers() >= sched3.num_pipe_buffers()
+    assert sched3.num_pipe_buffers() == 2
+
+
+def test_send_recv_pairing():
+    """Stage s's SendActivation ticks must match stage s+1's RecvActivation
+    ticks (barrier-atomicity of steps)."""
+    stages, micro_batches = 3, 4
+    per_stage = [list(iter(schedule.TrainSchedule(micro_batches=micro_batches,
+                                                  stages=stages, stage_id=s)))
+                 for s in range(stages)]
+    for s in range(stages - 1):
+        sends = [i for i, cmds in enumerate(per_stage[s])
+                 if _count(cmds, schedule.SendActivation)]
+        recvs = [i for i, cmds in enumerate(per_stage[s + 1])
+                 if _count(cmds, schedule.RecvActivation)]
+        assert len(sends) == len(recvs) == micro_batches
+        # every send happens no later than the paired recv
+        for snd, rcv in zip(sends, recvs):
+            assert snd <= rcv
+
+
+def test_dataparallel_schedule():
+    sched = schedule.DataParallelSchedule(micro_batches=3, stages=1, stage_id=0)
+    full = list(iter(sched))
+    assert len(full) == 3
+    assert _count(full[-1], schedule.ReduceGrads) == 1
+    assert _count(full[-1], schedule.OptimizerStep) == 1
+    assert sched.num_pipe_buffers() == 1
