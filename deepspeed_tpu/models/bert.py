@@ -32,7 +32,8 @@ class BertConfig:
                  max_position_embeddings=512, type_vocab_size=2,
                  hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1,
                  initializer_range=0.02, pre_layer_norm=False,
-                 layer_norm_eps=1e-12, remat=False):
+                 layer_norm_eps=1e-12, remat=False,
+                 attn_impl="auto", sparsity_config=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_hidden_layers = num_hidden_layers
@@ -46,6 +47,8 @@ class BertConfig:
         self.pre_layer_norm = pre_layer_norm
         self.layer_norm_eps = layer_norm_eps
         self.remat = remat
+        self.attn_impl = attn_impl
+        self.sparsity_config = sparsity_config
 
     @staticmethod
     def bert_base(**kw):
@@ -70,7 +73,9 @@ class BertModel:
             hidden_dropout_ratio=config.hidden_dropout_prob,
             pre_layer_norm=config.pre_layer_norm,
             initializer_range=config.initializer_range,
-            layer_norm_eps=config.layer_norm_eps)
+            layer_norm_eps=config.layer_norm_eps,
+            attn_impl=config.attn_impl,
+            sparsity_config=config.sparsity_config)
 
     def init(self, rng):
         c = self.config

@@ -22,7 +22,8 @@ class GPT2Config:
     def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
                  num_heads=12, max_position_embeddings=1024,
                  embd_dropout=0.1, attn_dropout=0.1, resid_dropout=0.1,
-                 initializer_range=0.02, layer_norm_eps=1e-5, remat=False):
+                 initializer_range=0.02, layer_norm_eps=1e-5, remat=False,
+                 attn_impl="auto", sparsity_config=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -34,6 +35,8 @@ class GPT2Config:
         self.initializer_range = initializer_range
         self.layer_norm_eps = layer_norm_eps
         self.remat = remat
+        self.attn_impl = attn_impl
+        self.sparsity_config = sparsity_config
 
     @staticmethod
     def gpt2_small(**kw):
@@ -63,7 +66,9 @@ class GPT2LMHeadTPU:
             causal=True, attn_dropout_ratio=config.attn_dropout,
             hidden_dropout_ratio=config.resid_dropout, pre_layer_norm=True,
             initializer_range=config.initializer_range,
-            layer_norm_eps=config.layer_norm_eps)
+            layer_norm_eps=config.layer_norm_eps,
+            attn_impl=config.attn_impl,
+            sparsity_config=config.sparsity_config)
 
     def init(self, rng):
         c = self.config

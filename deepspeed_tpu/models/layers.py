@@ -76,7 +76,8 @@ class TransformerLayer:
 
     def __init__(self, hidden_size, heads, intermediate_size=None, causal=False,
                  attn_dropout_ratio=0.1, hidden_dropout_ratio=0.1,
-                 pre_layer_norm=False, initializer_range=0.02, layer_norm_eps=1e-12):
+                 pre_layer_norm=False, initializer_range=0.02, layer_norm_eps=1e-12,
+                 attn_impl="auto", sparsity_config=None):
         assert hidden_size % heads == 0
         self.hidden_size = hidden_size
         self.heads = heads
@@ -88,6 +89,27 @@ class TransformerLayer:
         self.pre_layer_norm = pre_layer_norm
         self.initializer_range = initializer_range
         self.layer_norm_eps = layer_norm_eps
+        # attention core selection:
+        #   'auto'   — flash kernel on TPU / jnp reference elsewhere
+        #   'ring'   — sequence-parallel ring attention over the 'seq' mesh
+        #              axis (long-context; SURVEY §5.7 upgrade)
+        #   'sparse' — block-sparse attention driven by sparsity_config
+        #              (reference ops/sparse_attention)
+        assert attn_impl in ("auto", "ring", "sparse")
+        self.attn_impl = attn_impl
+        self.sparsity_config = sparsity_config
+        self._layout_cache = {}  # seq_len -> layout (stable across traces)
+        if attn_impl == "sparse":
+            assert sparsity_config is not None, (
+                "attn_impl='sparse' requires a SparsityConfig")
+
+    def _sparse_layout(self, seq_len):
+        """Layout cached per sequence length: randomized configs (BigBird,
+        Variable) must yield the SAME pattern in every traced program
+        (train/eval/retrace), not a fresh sample per trace."""
+        if seq_len not in self._layout_cache:
+            self._layout_cache[seq_len] = self.sparsity_config.make_layout(seq_len)
+        return self._layout_cache[seq_len]
 
     def init(self, rng) -> Dict[str, Any]:
         ks = jax.random.split(rng, 4)
@@ -124,10 +146,33 @@ class TransformerLayer:
             qkv = dense(params["qkv"], y)  # [b, s, 3h] one fused GEMM
             qkv = qkv.reshape(b, s, 3, self.heads, self.head_dim)
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-            ctx = dot_product_attention(
-                q, k, v, mask=mask, causal=self.causal,
-                dropout_rate=self.attn_dropout_ratio, dropout_rng=r1,
-                deterministic=deterministic)
+            kpm = None
+            if mask is not None and self.attn_impl in ("ring", "sparse"):
+                # these cores take an additive [b, s] key-padding mask; the
+                # general additive [b, 1, 1, s] broadcast form collapses to it
+                assert mask.size == b * s, (
+                    f"attn_impl={self.attn_impl!r} supports key-padding masks "
+                    f"([b,1,1,s]), got mask shape {mask.shape}")
+                kpm = mask.reshape(b, s)
+            if self.attn_impl == "ring":
+                from ..ops.transformer.ring_attention import ring_attention
+
+                ctx = ring_attention(q, k, v, causal=self.causal,
+                                     key_padding_mask=kpm)
+            elif self.attn_impl == "sparse":
+                from ..ops.sparse_attention import block_sparse_attention
+
+                ctx = block_sparse_attention(
+                    q, k, v, self._sparse_layout(s),
+                    causal=self.causal or getattr(
+                        self.sparsity_config, "attention",
+                        "bidirectional") == "unidirectional",
+                    key_padding_mask=kpm, attn_mask=None)
+            else:
+                ctx = dot_product_attention(
+                    q, k, v, mask=mask, causal=self.causal,
+                    dropout_rate=self.attn_dropout_ratio, dropout_rng=r1,
+                    deterministic=deterministic)
             ctx = ctx.reshape(b, s, h)
             out = dense(params["attn_out"], ctx)
             return dropout(r2, out, self.hidden_dropout_ratio, deterministic)

@@ -1,0 +1,64 @@
+"""BertSparseSelfAttention: BERT's self-attention with a sparse core.
+
+Re-design of ``deepspeed/ops/sparse_attention/bert_sparse_self_attention.py``
+(reference ``:9-79``) in the framework's functional-module style
+(``init``/``apply`` over a param pytree): Q/K/V projections + a
+:class:`SparseSelfAttention` core, returning the merged-head context.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .sparse_self_attention import SparseSelfAttention
+from .sparsity_config import FixedSparsityConfig
+
+
+class BertSparseSelfAttention:
+    def __init__(self, config, sparsity_config=None):
+        """``config`` needs ``hidden_size`` and ``num_attention_heads``
+        (a BertConfig works)."""
+        if config.hidden_size % config.num_attention_heads != 0:
+            raise ValueError(
+                f"The hidden size ({config.hidden_size}) is not a multiple of "
+                f"the number of attention heads ({config.num_attention_heads})")
+        self.config = config
+        self.num_attention_heads = config.num_attention_heads
+        self.attention_head_size = config.hidden_size // config.num_attention_heads
+        self.all_head_size = self.num_attention_heads * self.attention_head_size
+        # 'mul' mode: apply()'s attention_mask contract is 1-keep/0-drop
+        self.sparse_self_attention = SparseSelfAttention(
+            sparsity_config or FixedSparsityConfig(
+                num_heads=config.num_attention_heads),
+            key_padding_mask_mode="mul")
+
+    def init(self, rng):
+        h = self.config.hidden_size
+        ks = jax.random.split(rng, 3)
+        init_range = getattr(self.config, "initializer_range", 0.02)
+
+        def dense(k):
+            return {"kernel": jax.random.normal(k, (h, self.all_head_size),
+                                                jnp.float32) * init_range,
+                    "bias": jnp.zeros((self.all_head_size,), jnp.float32)}
+
+        return {"query": dense(ks[0]), "key": dense(ks[1]), "value": dense(ks[2])}
+
+    def _split_heads(self, x):
+        b, s, _ = x.shape
+        return x.reshape(b, s, self.num_attention_heads,
+                         self.attention_head_size).transpose(0, 2, 1, 3)
+
+    def apply(self, params, hidden_states, attention_mask=None):
+        """hidden_states ``[b, s, hidden]``; attention_mask ``[b, s]``
+        multiplicative key-padding mask (1 keep / 0 drop)."""
+
+        def proj(p, x):
+            return x @ p["kernel"].astype(x.dtype) + p["bias"].astype(x.dtype)
+
+        q = self._split_heads(proj(params["query"], hidden_states))
+        k = self._split_heads(proj(params["key"], hidden_states))
+        v = self._split_heads(proj(params["value"], hidden_states))
+        ctx = self.sparse_self_attention(
+            q, k, v, key_padding_mask=attention_mask)
+        b, h, s, d = ctx.shape
+        return ctx.transpose(0, 2, 1, 3).reshape(b, s, self.all_head_size)

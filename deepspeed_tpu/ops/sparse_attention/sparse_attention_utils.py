@@ -1,0 +1,96 @@
+"""Helpers for adopting sparse attention in existing models.
+
+Re-design of ``deepspeed/ops/sparse_attention/sparse_attention_utils.py``
+(``SparseAttentionUtils``, reference ``:13-224``) for pytree-parameter
+models: sequence padding/unpadding to block multiples and position-embedding
+extension are tensor ops (ported); the HuggingFace-module surgery
+(``replace_model_self_attention_with_sparse_self_attention``, reference
+``:85-149``) maps to the framework's ``module_inject`` policy walker for
+our functional models.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class SparseAttentionUtils:
+    @staticmethod
+    def extend_position_embedding(position_embedding, max_position):
+        """Tile an existing ``[orig_max, hidden]`` position-embedding table
+        up to ``max_position`` (reference ``:19-66``, which mutates HF
+        model weights in place; here: returns the new table)."""
+        orig_max, hidden = position_embedding.shape
+        if max_position <= orig_max:
+            return position_embedding[:max_position]
+        reps = -(-max_position // orig_max)
+        out = jnp.tile(jnp.asarray(position_embedding), (reps, 1))[:max_position]
+        return out
+
+    @staticmethod
+    def update_tokenizer_model_max_length(tokenizer, max_position):
+        """Bump a tokenizer's max length (reference ``:68-83``)."""
+        tokenizer.model_max_length = max_position
+        if hasattr(tokenizer, "init_kwargs"):
+            tokenizer.init_kwargs["model_max_length"] = max_position
+        return tokenizer
+
+    @staticmethod
+    def pad_to_block_size(block_size, input_ids=None, attention_mask=None,
+                          token_type_ids=None, position_ids=None,
+                          inputs_embeds=None, pad_token_id=0,
+                          model_embeddings=None):
+        """Pad the sequence dimension to a multiple of ``block_size``
+        (reference ``:151-208``).  Returns ``(pad_len, input_ids,
+        attention_mask, token_type_ids, position_ids, inputs_embeds)``;
+        padded attention-mask positions are 0 (masked out)."""
+        if input_ids is not None:
+            seq_len = input_ids.shape[1]
+        else:
+            seq_len = inputs_embeds.shape[1]
+        pad_len = (block_size - seq_len % block_size) % block_size
+        if pad_len == 0:
+            return (pad_len, input_ids, attention_mask, token_type_ids,
+                    position_ids, inputs_embeds)
+
+        def pad2d(x, value):
+            if x is None:
+                return None
+            return jnp.pad(jnp.asarray(x), ((0, 0), (0, pad_len)),
+                           constant_values=value)
+
+        if inputs_embeds is not None:
+            batch = inputs_embeds.shape[0]
+            pad_ids = jnp.full((batch, pad_len), pad_token_id, jnp.int32)
+            assert model_embeddings is not None, (
+                "padding inputs_embeds requires model_embeddings")
+            pad_embeds = model_embeddings(pad_ids)
+            inputs_embeds = jnp.concatenate(
+                [jnp.asarray(inputs_embeds), pad_embeds], axis=1)
+        input_ids = pad2d(input_ids, pad_token_id)
+        position_ids = pad2d(position_ids, pad_token_id)
+        attention_mask = pad2d(attention_mask, 0)
+        token_type_ids = pad2d(token_type_ids, 0)
+        return (pad_len, input_ids, attention_mask, token_type_ids,
+                position_ids, inputs_embeds)
+
+    @staticmethod
+    def unpad_sequence_output(pad_len, sequence_output):
+        """Drop padding added by :meth:`pad_to_block_size` (reference
+        ``:210-224``)."""
+        if pad_len > 0:
+            return sequence_output[:, :-pad_len]
+        return sequence_output
+
+    @staticmethod
+    def replace_model_self_attention_with_sparse_self_attention(
+            model, max_position, sparsity_config=None):
+        """HF-module surgery is torch-specific; for this framework's
+        functional models use ``deepspeed_tpu.module_inject`` policies
+        (reference ``:85-149``)."""
+        raise NotImplementedError(
+            "use deepspeed_tpu.module_inject to swap attention cores in "
+            "functional models")
+
+
+def _np(x):
+    return np.asarray(x)

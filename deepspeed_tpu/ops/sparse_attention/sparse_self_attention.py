@@ -1,0 +1,89 @@
+"""SparseSelfAttention: layout-driven sparse softmax(QKᵀ)V module.
+
+Re-design of ``deepspeed/ops/sparse_attention/sparse_self_attention.py``
+(``SparseSelfAttention``, reference ``:14-152``).  Same contract: inputs
+``[batch, heads, seq, head_dim]``, optional additive/multiplicative key
+padding and attention masks, relative position embedding; output a dense
+context tensor.  The Triton SDD/softmax/DSD kernel chain (``get_ops``,
+reference ``:66-87``) is replaced by the gathered block-sparse computation
+in ``block_sparse.py``; layouts (and their gather LUTs) are cached per
+sequence length exactly like the reference's ``master_layout`` slicing
+(``:51-64``).
+"""
+
+import jax.numpy as jnp
+
+from .block_sparse import NEG_INF, block_sparse_attention
+from .sparsity_config import FixedSparsityConfig, SparsityConfig
+
+
+class SparseSelfAttention:
+    def __init__(self, sparsity_config=None, key_padding_mask_mode="add",
+                 attn_mask_mode="mul", max_seq_length=2048):
+        self.sparsity_config = sparsity_config or FixedSparsityConfig(num_heads=4)
+        assert isinstance(self.sparsity_config, SparsityConfig)
+        if key_padding_mask_mode not in ("add", "mul"):
+            raise ValueError(f"bad key_padding_mask_mode {key_padding_mask_mode}")
+        if attn_mask_mode not in ("add", "mul"):
+            raise ValueError(f"bad attn_mask_mode {attn_mask_mode}")
+        self.key_padding_mask_mode = key_padding_mask_mode
+        self.attn_mask_mode = attn_mask_mode
+        self.max_seq_length = max_seq_length
+        self._master_layout = None
+        self._layout_cache = {}
+
+    def get_layout(self, seq_len):
+        """Layout for ``seq_len``, sliced from a lazily-built master layout
+        (reference ``:51-64``)."""
+        if seq_len in self._layout_cache:
+            return self._layout_cache[seq_len]
+        if self._master_layout is None:
+            self._master_layout = self.sparsity_config.make_layout(
+                self.max_seq_length)
+        if seq_len % self.sparsity_config.block != 0:
+            raise ValueError(
+                f"Sequence length {seq_len} must be a multiple of block "
+                f"{self.sparsity_config.block}")
+        num_blocks = seq_len // self.sparsity_config.block
+        if num_blocks > self._master_layout.shape[1]:
+            raise ValueError(
+                f"seq_len {seq_len} exceeds max_seq_length {self.max_seq_length}")
+        layout = self._master_layout[:, :num_blocks, :num_blocks]
+        self._layout_cache[seq_len] = layout
+        return layout
+
+    def _additive(self, mask, mode):
+        """'mul' masks (1 keep / 0 drop) → additive -inf form."""
+        mask = jnp.asarray(mask)
+        if mode == "mul":
+            return jnp.where(mask != 0, 0.0, NEG_INF)
+        return mask.astype(jnp.float32)
+
+    def __call__(self, query, key, value, rpe=None, key_padding_mask=None,
+                 attn_mask=None):
+        """query/key/value: ``[batch, heads, seq, head_dim]`` (the
+        reference's post-``transpose_for_scores`` shape)."""
+        if query.shape != key.shape or key.shape != value.shape:
+            raise NotImplementedError("only self-attention is supported for now")
+        b, h, s, d = query.shape
+        layout = self.get_layout(s)
+
+        if key_padding_mask is not None:
+            key_padding_mask = jnp.asarray(key_padding_mask).reshape(b, s)
+            key_padding_mask = self._additive(key_padding_mask,
+                                              self.key_padding_mask_mode)
+        if attn_mask is not None:
+            attn_mask = jnp.asarray(attn_mask)
+            attn_mask = attn_mask.reshape(attn_mask.shape[-2:])
+            attn_mask = self._additive(attn_mask, self.attn_mask_mode)
+
+        causal = getattr(self.sparsity_config, "attention",
+                         "bidirectional") == "unidirectional"
+        # block_sparse_attention takes [b, s, h, d]
+        ctx = block_sparse_attention(
+            query.transpose(0, 2, 1, 3), key.transpose(0, 2, 1, 3),
+            value.transpose(0, 2, 1, 3), layout, causal=causal,
+            key_padding_mask=key_padding_mask, attn_mask=attn_mask, rpe=rpe)
+        return ctx.transpose(0, 2, 1, 3)
+
+    forward = __call__

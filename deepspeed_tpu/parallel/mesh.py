@@ -25,6 +25,22 @@ MODEL_AXIS = "model"
 
 CANONICAL_AXES = (PIPE_AXIS, DATA_AXIS, SEQ_AXIS, MODEL_AXIS)
 
+# Process-wide current mesh, set by the engine at init so mesh-aware ops
+# (ring attention's shard_map) can find it at trace time without plumbing a
+# mesh argument through every model layer.  Static trace-time state, not
+# runtime state.
+_CURRENT_MESH = None
+
+
+def set_current_mesh(mesh):
+    global _CURRENT_MESH
+    _CURRENT_MESH = mesh
+    return mesh
+
+
+def get_current_mesh():
+    return _CURRENT_MESH
+
 
 def available_devices(n_devices: Optional[int] = None, platform: Optional[str] = None):
     """Pick ``n_devices`` devices, preferring the default backend but falling

@@ -44,7 +44,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..ops.adam.fused_adam import FusedAdam
 from ..ops.lamb.fused_lamb import FusedLamb
 from ..ops.op_common import build_segments
-from ..parallel.mesh import DATA_AXIS, MeshGrid, make_mesh
+from ..parallel.mesh import DATA_AXIS, MeshGrid, make_mesh, set_current_mesh
 from ..utils.distributed import init_distributed
 from ..utils.logging import log_dist, logger
 from ..utils.timer import SynchronizedWallClockTimer, ThroughputTimer
@@ -134,6 +134,7 @@ class DeepSpeedEngine:
         else:
             self._config = DeepSpeedConfig(config, mpu)
             self.mesh = make_mesh(self._config.mesh_config)
+        set_current_mesh(self.mesh)
         shape = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
         self.dp_world_size = shape.get("data", 1)
         self.mp_world_size = shape.get("model", 1)
@@ -410,6 +411,9 @@ class DeepSpeedEngine:
                                        out_shardings=param_shardings)
 
         def fwd_bwd(params_or_master, batch, rng, cur_scale, extra):
+            # trace-time: mesh-aware ops (ring attention) resolve THIS
+            # engine's mesh even when several engines coexist in-process
+            set_current_mesh(mesh)
             if stage3:
                 params = cast_params(params_or_master)
             else:
@@ -470,6 +474,7 @@ class DeepSpeedEngine:
                            None, None, None, None))
 
         def eval_fwd(params_or_master, batch, rng, extra):
+            set_current_mesh(mesh)
             params = cast_params(params_or_master) if stage3 else params_or_master
             return self._loss_fn(params, batch, rng=rng, train=False, **extra)
 

@@ -89,11 +89,14 @@ class _PipelinedModel:
         if stages == 1:
             # Degenerate pipeline = gradient accumulation: mean of the
             # micro-batch losses (reference DataParallelSchedule).
-            def one(mb):
-                mb_in, mb_lab = mb
-                return module.sequential_apply(params, (mb_in, mb_lab))
+            def one(args):
+                (mb_in, mb_lab), i = args
+                r = jax.random.fold_in(rng, i) if rng is not None else None
+                return module.sequential_apply(params, (mb_in, mb_lab),
+                                               rng=r, train=train)
 
-            losses = jax.lax.map(one, (inputs, labels))
+            losses = jax.lax.map(one, ((inputs, labels),
+                                       jnp.arange(mb_count)))
             return jnp.mean(losses)
 
         parts = self._ensure_parts(params)
@@ -116,9 +119,13 @@ class _PipelinedModel:
         def branch_fn(s):
             first, last = s == 0, s == stages - 1
 
-            def branch(params, x_in, mb_inputs, mb_labels, valid):
+            def branch(params, x_in, mb_inputs, mb_labels, valid, tick_rng):
                 x = mb_inputs if first else x_in
-                y = module.apply_range(params, parts[s], parts[s + 1], x)
+                layer_kw = {"deterministic": not train}
+                if tick_rng is not None:
+                    layer_kw["rng"] = tick_rng
+                y = module.apply_range(params, parts[s], parts[s + 1], x,
+                                       **layer_kw)
                 if last:
                     loss = module.loss_fn(y, mb_labels)
                     loss = jnp.where(valid, loss.astype(jnp.float32), 0.0)
@@ -131,7 +138,7 @@ class _PipelinedModel:
         perm = [(i, (i + 1) % stages) for i in range(stages)]
         ticks = mb_count + stages - 1
 
-        def per_pipe(params, inputs, labels):
+        def per_pipe(params, inputs, labels, rng):
             s = jax.lax.axis_index(PIPE_AXIS)
 
             def tick(carry, t):
@@ -148,8 +155,12 @@ class _PipelinedModel:
                     lambda a: jax.lax.dynamic_index_in_dim(a, lab_idx, 0,
                                                            keepdims=False),
                     labels)
+                # per-(micro-batch, stage) dropout rng, like the reference's
+                # per-buffer RNG state
+                tick_rng = (jax.random.fold_in(jax.random.fold_in(rng, my_mb), s)
+                            if rng is not None else None)
                 y, loss = jax.lax.switch(s, branches, params, x_state,
-                                         mb_inputs, mb_labels, valid)
+                                         mb_inputs, mb_labels, valid, tick_rng)
                 x_next = jax.lax.ppermute(y, PIPE_AXIS, perm)
                 return (x_next, loss_sum + loss), None
 
@@ -160,11 +171,18 @@ class _PipelinedModel:
             # broadcast down the pipe group == psum here (others hold 0)
             return jax.lax.psum(loss_sum, PIPE_AXIS) / mb_count
 
+        if rng is None:
+            pipelined = jax.shard_map(
+                lambda p, i, l: per_pipe(p, i, l, None),
+                mesh=self.engine.mesh,
+                in_specs=(P(), P(), P()), out_specs=P(),
+                axis_names={PIPE_AXIS}, check_vma=False)
+            return pipelined(params, inputs, labels)
         pipelined = jax.shard_map(
             per_pipe, mesh=self.engine.mesh,
-            in_specs=(P(), P(), P()), out_specs=P(),
+            in_specs=(P(), P(), P(), P()), out_specs=P(),
             axis_names={PIPE_AXIS}, check_vma=False)
-        return pipelined(params, inputs, labels)
+        return pipelined(params, inputs, labels, rng)
 
 
 class PipelineEngine(DeepSpeedEngine):

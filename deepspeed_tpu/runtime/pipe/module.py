@@ -26,6 +26,7 @@ The final ``loss_fn(outputs, labels)`` maps the last layer's output and the
 batch labels to a scalar loss.
 """
 
+import inspect
 import os
 import re
 
@@ -201,13 +202,41 @@ class PipelineModule:
     # ------------------------------------------------------------------
     # forward
     # ------------------------------------------------------------------
+    def _accepted_kwargs(self, idx, kw):
+        """Filter kw down to what layer idx's apply() accepts, so optional
+        context (rng, train/deterministic) reaches dropout-bearing layers
+        without breaking plain ``apply(params, x)`` layers."""
+        if not kw:
+            return kw
+        cache = getattr(self, "_sig_cache", None)
+        if cache is None:
+            cache = self._sig_cache = {}
+        if idx not in cache:
+            fn = (self._forward_fns.get(idx)
+                  or (self.layers[idx].apply if self.has_params(idx)
+                      else self.layers[idx]))
+            try:
+                sig = inspect.signature(fn)
+                if any(p.kind == inspect.Parameter.VAR_KEYWORD
+                       for p in sig.parameters.values()):
+                    cache[idx] = None  # **kw: accepts everything
+                else:
+                    cache[idx] = set(sig.parameters)
+            except (TypeError, ValueError):
+                cache[idx] = set()
+        allowed = cache[idx]
+        if allowed is None:
+            return kw
+        return {k: v for k, v in kw.items() if k in allowed}
+
     def apply_layer(self, params, idx, x, **kw):
         layer = self.layers[idx]
+        kw = self._accepted_kwargs(idx, kw)
         if idx in self._forward_fns:
-            return self._forward_fns[idx](self._layer_params(params, idx), x)
+            return self._forward_fns[idx](self._layer_params(params, idx), x, **kw)
         if self.has_params(idx):
             return layer.apply(self._layer_params(params, idx), x, **kw)
-        return layer(x)
+        return layer(x, **kw)
 
     def apply_range(self, params, start, stop, x, **kw):
         """Apply layers [start, stop), rematerializing every
@@ -234,9 +263,14 @@ class PipelineModule:
         return x
 
     def sequential_apply(self, params, batch, rng=None, train=False, **kw):
-        """Non-pipelined reference execution: fold all layers, apply loss."""
+        """Non-pipelined reference execution: fold all layers, apply loss.
+        rng/deterministic reach layers whose apply() accepts them."""
         inputs, labels = split_batch(batch)
-        x = self.apply_range(params, 0, self.num_layers, inputs)
+        layer_kw = dict(kw)
+        if rng is not None:
+            layer_kw["rng"] = rng
+        layer_kw["deterministic"] = not train
+        x = self.apply_range(params, 0, self.num_layers, inputs, **layer_kw)
         if self.loss_fn is not None and labels is not None:
             return self.loss_fn(x, labels)
         return x

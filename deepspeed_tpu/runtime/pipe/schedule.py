@@ -36,6 +36,9 @@ class PipeInstruction:
     def __eq__(self, other):
         return type(self) is type(other) and self.kwargs == other.kwargs
 
+    def __hash__(self):
+        return hash((type(self), tuple(sorted(self.kwargs.items()))))
+
 
 class OptimizerStep(PipeInstruction):
     """Apply the optimizer and zero gradients (after Reduce*Grads)."""
