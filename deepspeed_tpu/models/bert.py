@@ -125,14 +125,13 @@ class BertModel:
             rng_e, rng = jax.random.split(rng)
             x = dropout(rng_e, x, c.hidden_dropout_prob, deterministic)
 
-        mask = None
-        if attention_mask is not None:
-            # additive mask: 0 at visible keys, -1e9 at padding
-            mask = (1.0 - attention_mask[:, None, None, :].astype(jnp.float32)) * -1e9
+        # Key-padding form (1 = visible), so the flash kernel can fuse the
+        # mask into its softmax instead of falling back to O(s²) attention.
+        kpm = attention_mask
 
         def run_layer(layer_params, x, layer_rng):
-            return self.layer.apply(layer_params, x, mask=mask, rng=layer_rng,
-                                    deterministic=deterministic)
+            return self.layer.apply(layer_params, x, key_padding_mask=kpm,
+                                    rng=layer_rng, deterministic=deterministic)
 
         if c.remat:
             run_layer = jax.checkpoint(run_layer)

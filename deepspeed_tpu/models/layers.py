@@ -26,7 +26,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..ops.transformer.attention import dot_product_attention
+from ..ops.transformer.attention import (dot_product_attention,
+                                         key_padding_to_additive)
 
 
 def _dense_init(rng, in_dim, out_dim, initializer_range=0.02):
@@ -135,9 +136,14 @@ class TransformerLayer:
         return {"qkv": col, "attn_out": row, "fc1": col, "fc2": row,
                 "ln_attn": ln, "ln_mlp": ln}
 
-    def apply(self, params, x, mask=None, rng=None, deterministic=True):
-        """x: [batch, seq, hidden]; mask: [batch, 1, 1, seq] additive or None."""
+    def apply(self, params, x, mask=None, key_padding_mask=None, rng=None,
+              deterministic=True):
+        """x: [batch, seq, hidden]; mask: [batch, 1, 1, seq] additive or None;
+        key_padding_mask: [batch, seq] with 1 at visible tokens (routed to the
+        fused flash kernel's mask operand on TPU)."""
         b, s, h = x.shape
+        assert mask is None or key_padding_mask is None, (
+            "pass either an additive mask or a key_padding_mask, not both")
         r1 = r2 = r3 = None
         if rng is not None and not deterministic:
             r1, r2, r3 = jax.random.split(rng, 3)
@@ -146,19 +152,21 @@ class TransformerLayer:
             qkv = dense(params["qkv"], y)  # [b, s, 3h] one fused GEMM
             qkv = qkv.reshape(b, s, 3, self.heads, self.head_dim)
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-            kpm = None
-            if mask is not None and self.attn_impl in ("ring", "sparse"):
-                # these cores take an additive [b, s] key-padding mask; the
-                # general additive [b, 1, 1, s] broadcast form collapses to it
-                assert mask.size == b * s, (
-                    f"attn_impl={self.attn_impl!r} supports key-padding masks "
-                    f"([b,1,1,s]), got mask shape {mask.shape}")
-                kpm = mask.reshape(b, s)
+            kpm_add = None  # additive [b, s] form for ring/sparse cores
+            if self.attn_impl in ("ring", "sparse"):
+                if key_padding_mask is not None:
+                    kpm_add = key_padding_to_additive(key_padding_mask)
+                elif mask is not None:
+                    # the general additive [b, 1, 1, s] broadcast collapses
+                    assert mask.size == b * s, (
+                        f"attn_impl={self.attn_impl!r} supports key-padding "
+                        f"masks ([b,1,1,s]), got mask shape {mask.shape}")
+                    kpm_add = mask.reshape(b, s)
             if self.attn_impl == "ring":
                 from ..ops.transformer.ring_attention import ring_attention
 
                 ctx = ring_attention(q, k, v, causal=self.causal,
-                                     key_padding_mask=kpm)
+                                     key_padding_mask=kpm_add)
             elif self.attn_impl == "sparse":
                 from ..ops.sparse_attention import block_sparse_attention
 
@@ -167,12 +175,19 @@ class TransformerLayer:
                     causal=self.causal or getattr(
                         self.sparsity_config, "attention",
                         "bidirectional") == "unidirectional",
-                    key_padding_mask=kpm, attn_mask=None)
+                    key_padding_mask=kpm_add, attn_mask=None)
             else:
                 ctx = dot_product_attention(
-                    q, k, v, mask=mask, causal=self.causal,
+                    q, k, v, mask=mask, key_padding_mask=key_padding_mask,
+                    causal=self.causal,
                     dropout_rate=self.attn_dropout_ratio, dropout_rng=r1,
                     deterministic=deterministic)
+            if self.attn_impl in ("ring", "sparse") and r1 is not None \
+                    and self.attn_dropout_ratio > 0.0:
+                # ring/sparse cores have no in-core dropout; apply it to the
+                # attention output so attn_dropout_ratio is honored rather
+                # than silently ignored.
+                ctx = dropout(r1, ctx, self.attn_dropout_ratio, deterministic)
             ctx = ctx.reshape(b, s, h)
             out = dense(params["attn_out"], ctx)
             return dropout(r2, out, self.hidden_dropout_ratio, deterministic)

@@ -25,6 +25,11 @@ def _use_pallas(q, k):
         return False
 
 
+def key_padding_to_additive(key_padding_mask):
+    """[b, s] 1/0 key-padding mask -> additive [b, s] bias (0 / -1e9)."""
+    return (1.0 - key_padding_mask.astype(jnp.float32)) * -1e9
+
+
 def reference_attention(q, k, v, mask=None, causal=False, dropout_rate=0.0,
                         dropout_rng=None, deterministic=True):
     """jnp attention: [b, s, h, d] inputs, fp32 softmax accumulation."""
@@ -44,19 +49,28 @@ def reference_attention(q, k, v, mask=None, causal=False, dropout_rate=0.0,
     return ctx
 
 
-def dot_product_attention(q, k, v, mask=None, causal=False, dropout_rate=0.0,
+def dot_product_attention(q, k, v, mask=None, key_padding_mask=None,
+                          causal=False, dropout_rate=0.0,
                           dropout_rng=None, deterministic=True):
     """Multi-head attention on [batch, seq, heads, head_dim] tensors.
 
     ``mask`` is an additive bias broadcastable to [b, h, q, k] (e.g. a
     padding mask of -1e9 at masked keys), matching the reference layer's
     attention-mask contract (``ops/transformer/transformer.py:155-244``).
+    ``key_padding_mask`` is the structured special case the flash kernel
+    fuses (reference: fused scale+mask softmax,
+    ``csrc/transformer/softmax_kernels.cu``): [b, kv_len] with 1 at visible
+    keys, 0 at padding.  Pass one or the other, not both.
     """
+    assert mask is None or key_padding_mask is None, (
+        "pass either an additive mask or a key_padding_mask, not both")
     if (_use_pallas(q, k) and (deterministic or dropout_rate == 0.0)
             and mask is None):
         from .flash_attention import flash_attention
 
-        return flash_attention(q, k, v, causal=causal)
+        return flash_attention(q, k, v, kv_mask=key_padding_mask, causal=causal)
+    if key_padding_mask is not None:
+        mask = key_padding_to_additive(key_padding_mask)[:, None, None, :]
     return reference_attention(q, k, v, mask=mask, causal=causal,
                                dropout_rate=dropout_rate, dropout_rng=dropout_rng,
                                deterministic=deterministic)

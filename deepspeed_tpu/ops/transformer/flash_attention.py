@@ -38,9 +38,17 @@ except Exception:  # pragma: no cover
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 NEG_INF = -1e30
+# Running-max floor: keeps exp(NEG_INF - m) == 0 even for rows where every
+# key is masked out (m would otherwise be NEG_INF and exp(0) = 1).
+MAX_FLOOR = -1e20
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, block_k, masked):
+    if masked:
+        kvm_ref, o_ref, lse_ref = rest
+    else:
+        kvm_ref = None
+        o_ref, lse_ref = rest
     qb = q_ref.shape[1]
     d = q_ref.shape[2]
     kv_len = k_ref.shape[1]
@@ -63,7 +71,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k):
             q_idx = j * qb + jax.lax.broadcasted_iota(jnp.int32, (qb, block_k), 0)
             k_idx = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (qb, block_k), 1)
             s = jnp.where(q_idx >= k_idx, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        if masked:
+            kvm = kvm_ref[0, 0, pl.ds(kb * block_k, block_k)]  # [Bk] fp32 0/1
+            s = jnp.where(kvm[None, :] > 0.0, s, NEG_INF)
+        m_new = jnp.maximum(jnp.maximum(m, jnp.max(s, axis=1, keepdims=True)),
+                            MAX_FLOOR)
         p = jnp.exp(s - m_new)
         corr = jnp.exp(m - m_new)
         l_new = l * corr + jnp.sum(p, axis=1, keepdims=True)
@@ -78,11 +90,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_k):
 
     l_safe = jnp.where(l == 0.0, 1.0, l)
     o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l_safe))[:, 0]
+    lse_ref[0, 0] = (m + jnp.log(l_safe))[:, 0]
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-                   scale, causal, block_k):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                   scale, causal, block_k, masked):
+    if masked:
+        kvm_ref, dq_ref = rest
+    else:
+        kvm_ref = None
+        (dq_ref,) = rest
     qb = q_ref.shape[1]
     d = q_ref.shape[2]
     kv_len = k_ref.shape[1]
@@ -90,8 +107,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
 
     q = q_ref[0].astype(jnp.float32) * scale
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, None]
-    delta = delta_ref[0][:, None]
+    lse = lse_ref[0, 0][:, None]
+    delta = delta_ref[0, 0][:, None]
 
     num_kb = pl.cdiv(kv_len, block_k)
     if causal:
@@ -106,6 +123,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
             q_idx = j * qb + jax.lax.broadcasted_iota(jnp.int32, (qb, block_k), 0)
             k_idx = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, (qb, block_k), 1)
             s = jnp.where(q_idx >= k_idx, s, NEG_INF)
+        if masked:
+            kvm = kvm_ref[0, 0, pl.ds(kb * block_k, block_k)]
+            s = jnp.where(kvm[None, :] > 0.0, s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -117,8 +137,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, scale, causal, block_q):
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                    scale, causal, block_q, masked):
+    if masked:
+        kvm_ref, dk_ref, dv_ref = rest
+    else:
+        kvm_ref = None
+        dk_ref, dv_ref = rest
     kb_size = k_ref.shape[1]
     d = k_ref.shape[2]
     q_len = q_ref.shape[1]
@@ -137,8 +162,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk, dv = carry
         q_blk = q_ref[0, pl.ds(qb_i * block_q, block_q), :].astype(jnp.float32) * scale
         do_blk = do_ref[0, pl.ds(qb_i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(qb_i * block_q, block_q)][:, None]
-        delta = delta_ref[0, pl.ds(qb_i * block_q, block_q)][:, None]
+        lse = lse_ref[0, 0, pl.ds(qb_i * block_q, block_q)][:, None]
+        delta = delta_ref[0, 0, pl.ds(qb_i * block_q, block_q)][:, None]
         s = jax.lax.dot_general(q_blk, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal:
@@ -147,6 +172,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             k_idx = kb * kb_size + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, kb_size), 1)
             s = jnp.where(q_idx >= k_idx, s, NEG_INF)
+        if masked:
+            kvm = kvm_ref[0, 0]  # [Bk] fp32 0/1, this kernel's whole k block
+            s = jnp.where(kvm[None, :] > 0.0, s, NEG_INF)
         p = jnp.exp(s - lse)  # [Bq, Bk]
         dv_new = dv + jax.lax.dot_general(p, do_blk, (((0,), (0,)), ((), ())),
                                           preferred_element_type=jnp.float32)
@@ -175,15 +203,30 @@ def _unflatten_heads(x, b, h):
     return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def flash_attention(q, k, v, causal=False, block_q=DEFAULT_BLOCK_Q,
-                    block_k=DEFAULT_BLOCK_K, interpret=False):
-    """Flash attention on [b, s, h, d]; returns [b, s, h, d]."""
-    out, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def flash_attention(q, k, v, kv_mask=None, causal=False,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                    interpret=False):
+    """Flash attention on [b, s, h, d]; returns [b, s, h, d].
+
+    ``kv_mask`` is an optional key-padding mask [b, kv_len] with 1 at
+    visible keys and 0 at padding (BERT's ``attention_mask`` contract —
+    the reference fuses this into its softmax kernel,
+    ``csrc/transformer/softmax_kernels.cu``).  Rows with every key masked
+    produce zero output and zero gradients.
+    """
+    out, _ = _flash_fwd(q, k, v, kv_mask, causal, block_q, block_k, interpret)
     return out
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+def _mask_spec(h, kv_len):
+    # one [1, 1, kv_len] mask row per (batch·head) program: batch = i // h.
+    # The singleton middle axis keeps the block's trailing-two dims at
+    # (1, kv_len) == the array dims, which Mosaic's tiling rules accept.
+    return pl.BlockSpec((1, 1, kv_len), lambda i, j: (i // h, 0, 0))
+
+
+def _flash_fwd(q, k, v, kv_mask, causal, block_q, block_k, interpret):
     b, s, h, d = q.shape
     kv_len = k.shape[1]
     # The kernels index K/V in whole blocks; a ragged tail would silently
@@ -193,13 +236,21 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
         raise ValueError(
             f"flash_attention requires seq divisible by block sizes: "
             f"q_len={s} % block_q={block_q}, kv_len={kv_len} % block_k={block_k}")
+    masked = kv_mask is not None
     scale = 1.0 / math.sqrt(d)
     qf, kf, vf = _flatten_heads(q), _flatten_heads(k), _flatten_heads(v)
     bh = b * h
     n_qb = pl.cdiv(s, block_q)
 
+    mask_ops, mask_specs = (), ()
+    if masked:
+        assert kv_mask.shape == (b, kv_len), (
+            f"kv_mask must be [batch, kv_len]={b, kv_len}, got {kv_mask.shape}")
+        mask_ops = (kv_mask.astype(jnp.float32)[:, None, :],)
+        mask_specs = (_mask_spec(h, kv_len),)
+
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               block_k=block_k)
+                               block_k=block_k, masked=masked)
     out, lse = pl.pallas_call(
         kernel,
         grid=(bh, n_qb),
@@ -207,68 +258,80 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, kv_len, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, kv_len, d), lambda i, j: (i, 0, 0)),
+            *mask_specs,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, s), jnp.float32),
         ],
         interpret=interpret,
-    )(qf, kf, vf)
-    return _unflatten_heads(out, b, h), (q, k, v, _unflatten_heads(out, b, h), lse)
+    )(qf, kf, vf, *mask_ops)
+    outh = _unflatten_heads(out, b, h)
+    return outh, (q, k, v, kv_mask, outh, lse)
 
 
-def _flash_fwd_rule(q, k, v, causal, block_q, block_k, interpret):
-    out, res = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+def _flash_fwd_rule(q, k, v, kv_mask, causal, block_q, block_k, interpret):
+    out, res = _flash_fwd(q, k, v, kv_mask, causal, block_q, block_k, interpret)
     return out, res
 
 
 def _flash_bwd_rule(causal, block_q, block_k, interpret, res, g):
-    q, k, v, out, lse = res
+    q, k, v, kv_mask, out, lse = res
     b, s, h, d = q.shape
     kv_len = k.shape[1]
+    masked = kv_mask is not None
     scale = 1.0 / math.sqrt(d)
     bh = b * h
 
     qf, kf, vf = _flatten_heads(q), _flatten_heads(k), _flatten_heads(v)
     dof = _flatten_heads(g)
     of = _flatten_heads(out)
-    delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32), axis=-1)
+    delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32), axis=-1,
+                    keepdims=True).transpose(0, 2, 1)  # [bh, 1, s]
 
     n_qb = pl.cdiv(s, block_q)
     n_kb = pl.cdiv(kv_len, block_k)
 
+    mask_ops, mask_specs = (), ()
+    if masked:
+        mask_ops = (kv_mask.astype(jnp.float32)[:, None, :],)
+        mask_specs = (_mask_spec(h, kv_len),)
+
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_k=block_k),
+                          block_k=block_k, masked=masked),
         grid=(bh, n_qb),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, kv_len, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, kv_len, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((1, 1, block_q), lambda i, j: (i, 0, j)),
+            *mask_specs,
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
         interpret=interpret,
-    )(qf, kf, vf, dof, lse, delta)
+    )(qf, kf, vf, dof, lse, delta, *mask_ops)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q),
+                          block_q=block_q, masked=masked),
         grid=(bh, n_kb),
         in_specs=[
             pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, s, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, s), lambda i, j: (i, 0)),
-            pl.BlockSpec((1, s), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, 1, s), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, 1, s), lambda i, j: (i, 0, 0)),
+            *((pl.BlockSpec((1, 1, block_k), lambda i, j: (i // h, 0, j)),)
+              if masked else ()),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
@@ -279,10 +342,11 @@ def _flash_bwd_rule(causal, block_q, block_k, interpret, res, g):
             jax.ShapeDtypeStruct((bh, kv_len, d), v.dtype),
         ],
         interpret=interpret,
-    )(qf, kf, vf, dof, lse, delta)
+    )(qf, kf, vf, dof, lse, delta, *mask_ops)
 
-    return (_unflatten_heads(dq, b, h), _unflatten_heads(dk, b, h),
-            _unflatten_heads(dv, b, h))
+    dqh = (_unflatten_heads(dq, b, h), _unflatten_heads(dk, b, h),
+           _unflatten_heads(dv, b, h))
+    return dqh + ((jnp.zeros_like(kv_mask),) if masked else (None,))
 
 
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)

@@ -17,12 +17,23 @@ def rand_qkv(b, s, h, d, seed=0, dtype=jnp.float32):
     return tuple(jax.random.normal(k, shape, dtype) for k in ks)
 
 
+def padding_masks(b, s, lengths):
+    """(kv_mask [b,s] 1/0, additive [b,1,1,s]) for per-row visible lengths."""
+    kvm = np.zeros((b, s), np.float32)
+    for i, n in enumerate(lengths):
+        kvm[i, :n] = 1.0
+    kvm = jnp.asarray(kvm)
+    additive = (1.0 - kvm[:, None, None, :]) * -1e9
+    return kvm, additive
+
+
 @pytest.mark.parametrize("causal", [False, True])
 @pytest.mark.parametrize("s", [256, 384])
 def test_flash_forward_matches_reference(causal, s):
     q, k, v = rand_qkv(2, s, 4, 64)
     out_ref = reference_attention(q, k, v, causal=causal)
-    out = flash_attention(q, k, v, causal, 128, 128, True)  # interpret mode
+    out = flash_attention(q, k, v, causal=causal, block_q=128, block_k=128,
+                          interpret=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(out_ref),
                                atol=2e-5, rtol=2e-5)
 
@@ -32,7 +43,8 @@ def test_flash_backward_matches_reference(causal):
     q, k, v = rand_qkv(1, 256, 2, 64, seed=3)
 
     def loss_flash(q, k, v):
-        return jnp.sum(flash_attention(q, k, v, causal, 128, 128, True) ** 2)
+        return jnp.sum(flash_attention(q, k, v, causal=causal, block_q=128,
+                                       block_k=128, interpret=True) ** 2)
 
     def loss_ref(q, k, v):
         return jnp.sum(reference_attention(q, k, v, causal=causal) ** 2)
@@ -43,3 +55,67 @@ def test_flash_backward_matches_reference(causal):
         np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
                                    atol=5e-4, rtol=5e-4,
                                    err_msg=f"d{name} mismatch")
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_key_padding_mask_forward(causal):
+    b, s = 2, 256
+    q, k, v = rand_qkv(b, s, 4, 64, seed=5)
+    kvm, additive = padding_masks(b, s, [200, 131])
+    out_ref = reference_attention(q, k, v, mask=additive, causal=causal)
+    out = flash_attention(q, k, v, kv_mask=kvm, causal=causal, block_q=128,
+                          block_k=128, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out_ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_key_padding_mask_backward(causal):
+    b, s = 2, 256
+    q, k, v = rand_qkv(b, s, 2, 64, seed=7)
+    kvm, additive = padding_masks(b, s, [256, 77])
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, kv_mask=kvm, causal=causal,
+                                       block_q=128, block_k=128,
+                                       interpret=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, mask=additive,
+                                           causal=causal) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   atol=5e-4, rtol=5e-4,
+                                   err_msg=f"d{name} mismatch")
+    # masked keys must receive exactly zero dK/dV
+    for g, name in zip(g_flash[1:], "kv"):
+        masked_part = np.asarray(g)[1, 77:]
+        np.testing.assert_array_equal(masked_part, 0.0,
+                                      err_msg=f"d{name} leak into padding")
+
+
+def test_flash_fully_masked_row_is_zero():
+    """A sequence whose every key is padded out must yield zero output and
+    zero gradients (not NaN/garbage from an all-NEG_INF softmax)."""
+    b, s = 2, 256
+    q, k, v = rand_qkv(b, s, 2, 64, seed=9)
+    kvm, _ = padding_masks(b, s, [128, 0])
+    out = flash_attention(q, k, v, kv_mask=kvm, block_q=128, block_k=128,
+                          interpret=True)
+    out = np.asarray(out)
+    assert np.all(np.isfinite(out))
+    np.testing.assert_array_equal(out[1], 0.0)
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, kv_mask=kvm, block_q=128,
+                                       block_k=128, interpret=True) ** 2)
+
+    grads = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for g, name in zip(grads, "qkv"):
+        g = np.asarray(g)
+        assert np.all(np.isfinite(g)), f"d{name} not finite"
+        np.testing.assert_array_equal(g[1], 0.0,
+                                      err_msg=f"d{name} on masked batch row")
