@@ -3,11 +3,21 @@
 
 Mirrors the reference's headline single-GPU number — BERT-large seq128
 samples/sec (272 samples/s on V100-32GB, ``BASELINE.md``).  Runs the full
-DeepSpeed-TPU engine train step (fwd + bwd + fused Adam) in bf16 with flash
-attention on the available accelerator and prints ONE JSON line.
+DeepSpeed-TPU engine train step (fwd + bwd + fused Adam) in bf16 with the
+Pallas flash-attention kernel on the available accelerator and prints ONE
+JSON line.
+
+Timing discipline: on this platform ``jax.block_until_ready`` has been
+observed not to fence remote execution, so every timing boundary is a host
+round-trip — ``jax.device_get`` of the loss scalar — which cannot complete
+until the whole step has executed.  The run is sanity-checked against the
+chip's physical peak: model-FLOPs utilisation (MFU) above 100% means the
+harness measured nothing, and the benchmark hard-fails rather than report
+an impossible number.
 """
 
 import json
+import math
 import os
 import sys
 import time
@@ -20,6 +30,43 @@ BASELINE_SAMPLES_PER_SEC = 272.0  # V100-32GB, reference fastest-bert post
 SEQ = 128
 VOCAB = 30528
 
+# bf16 peak TFLOP/s per chip, by device_kind substring (conservative defaults).
+PEAK_TFLOPS = {
+    "v5 lite": 197.0,  # TPU v5e
+    "v5e": 197.0,
+    "v4": 275.0,
+    "v5p": 459.0,
+    "v6": 918.0,  # Trillium
+}
+# Unknown accelerators assume the fastest plausible chip so the MFU>1
+# no-sync guard never false-fails a legitimately fast device.
+DEFAULT_PEAK_TFLOPS = 990.0
+
+
+def bert_model_flops_per_sample(cfg, seq):
+    """Analytic fwd+bwd model FLOPs per sample (2x for matmul, 3x total with
+    backward), mirroring the accounting of the reference flops profiler
+    (``deepspeed/profiling/flops_profiler/profiler.py``)."""
+    h, i, L, v = (cfg.hidden_size, cfg.intermediate_size,
+                  cfg.num_hidden_layers, cfg.vocab_size)
+    per_layer = (
+        2 * seq * h * 3 * h        # QKV
+        + 2 * seq * seq * h * 2    # scores + context
+        + 2 * seq * h * h          # attn out
+        + 2 * seq * h * i * 2      # FC1 + FC2
+    )
+    head = 2 * seq * h * h + 2 * seq * h * v  # MLM transform + vocab proj
+    fwd = L * per_layer + head
+    return 3 * fwd  # bwd ~= 2x fwd
+
+
+def chip_peak_tflops(device):
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in PEAK_TFLOPS.items():
+        if key in kind:
+            return val
+    return DEFAULT_PEAK_TFLOPS
+
 
 def main():
     import jax
@@ -28,8 +75,8 @@ def main():
     from deepspeed_tpu.models import BertConfig, BertForPreTrainingTPU
     from deepspeed_tpu.parallel import make_mesh
 
-    batch = int(os.environ.get("BENCH_BATCH", "16"))
-    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    batch = int(os.environ.get("BENCH_BATCH", "128"))
+    steps = int(os.environ.get("BENCH_STEPS", "30"))
     warmup = int(os.environ.get("BENCH_WARMUP", "5"))
 
     dev = jax.devices()[0]
@@ -41,11 +88,10 @@ def main():
         "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
         "bf16": {"enabled": True},
     }
-    model = BertForPreTrainingTPU(
-        BertConfig.bert_large(max_position_embeddings=512, vocab_size=VOCAB,
-                              hidden_dropout_prob=0.0,
-                              attention_probs_dropout_prob=0.0),
-        compute_dtype=None)
+    bert_cfg = BertConfig.bert_large(max_position_embeddings=512, vocab_size=VOCAB,
+                                     hidden_dropout_prob=0.0,
+                                     attention_probs_dropout_prob=0.0)
+    model = BertForPreTrainingTPU(bert_cfg, compute_dtype=None)
     engine, *_ = deepspeed.initialize(model=model, config=config, mesh=mesh)
 
     rng = np.random.default_rng(0)
@@ -60,25 +106,49 @@ def main():
     }
 
     def one_step():
-        loss = engine.train_batch(iter([b]))
-        return loss
+        return engine.train_batch(iter([b]))
 
     for _ in range(max(warmup, 1)):
         loss = one_step()
-    jax.block_until_ready(loss)
+    # Host round-trip: guarantees all queued work has finished.
+    float(jax.device_get(loss))
 
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = one_step()
-    jax.block_until_ready(loss)
+    final_loss = float(jax.device_get(loss))
     dt = time.perf_counter() - t0
 
     samples_per_sec = batch * steps / dt
+    model_flops = bert_model_flops_per_sample(bert_cfg, SEQ)
+    tflops = samples_per_sec * model_flops / 1e12
+    peak = chip_peak_tflops(dev)
+    mfu = tflops / peak
+
+    if not math.isfinite(final_loss):
+        print(json.dumps({"metric": "bert_large_seq128_samples_per_sec_per_chip",
+                          "value": 0.0, "unit": "samples/s", "vs_baseline": 0.0,
+                          "error": f"non-finite loss {final_loss}"}))
+        sys.exit(1)
+    if mfu > 1.0:
+        print(json.dumps({"metric": "bert_large_seq128_samples_per_sec_per_chip",
+                          "value": 0.0, "unit": "samples/s", "vs_baseline": 0.0,
+                          "error": (f"measured {tflops:.0f} TFLOP/s exceeds chip "
+                                    f"peak {peak:.0f} — timing harness did not "
+                                    f"synchronize; result discarded")}))
+        sys.exit(1)
+
     print(json.dumps({
         "metric": "bert_large_seq128_samples_per_sec_per_chip",
         "value": round(samples_per_sec, 2),
         "unit": "samples/s",
         "vs_baseline": round(samples_per_sec / BASELINE_SAMPLES_PER_SEC, 3),
+        "model_tflops_per_sec": round(tflops, 1),
+        "mfu": round(mfu, 4),
+        "chip_peak_tflops": peak,
+        "loss": round(final_loss, 4),
+        "batch": batch,
+        "device": getattr(dev, "device_kind", str(dev)),
     }))
 
 

@@ -56,6 +56,50 @@ from .lr_schedules import SCHEDULE_CLASSES
 from .progressive_layer_drop import ProgressiveLayerDrop
 from .utils import flatten_tree, tree_path_key, unflatten_like
 
+def _pack_batches(micro_batches):
+    """Stack ``grad_acc`` micro-batch pytrees and pack all leaves into ONE
+    host array per dtype, laid out ``[acc, batch, columns]``.
+
+    On remote-attached accelerators every host→device transfer pays a full
+    round-trip, so a batch pytree of N leaves costs N latencies per step.
+    Packing collapses it to one transfer per dtype (usually one total);
+    the jitted step unpacks with free slices/reshapes.  Returns
+    ``(packed: {dtype_str: np.ndarray}, spec)`` where ``spec`` is hashable
+    and passed as a static arg.
+    """
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: np.stack([np.asarray(x) for x in xs]), *micro_batches)
+    leaves, treedef = jax.tree_util.tree_flatten(stacked)
+    assert leaves, "empty batch"
+    bsz = leaves[0].shape[1]
+    cols = {}
+    entries = []
+    for leaf in leaves:
+        assert leaf.ndim >= 2 and leaf.shape[1] == bsz, (
+            f"batch leaves must be [batch, ...] with a common batch dim; "
+            f"got stacked shape {leaf.shape} vs batch {bsz}")
+        key = str(leaf.dtype)
+        tail = leaf.shape[2:]
+        ncols = int(np.prod(tail)) if tail else 1
+        parts = cols.setdefault(key, [])
+        off = sum(p.shape[2] for p in parts)
+        parts.append(leaf.reshape(leaf.shape[0], bsz, ncols))
+        entries.append((key, off, ncols, tuple(tail)))
+    packed = {k: np.concatenate(v, axis=2) for k, v in cols.items()}
+    spec = (treedef, tuple(entries), bsz)
+    return packed, spec
+
+
+def _unpack_batches(packed, spec):
+    """Inverse of :func:`_pack_batches`, traced inside the fused step."""
+    treedef, entries, bsz = spec
+    leaves = []
+    for key, off, ncols, tail in entries:
+        arr = packed[key][:, :, off:off + ncols]
+        leaves.append(arr.reshape((arr.shape[0], bsz) + tail))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
 MODEL_STATES_NPZ = "model_states.npz"
 OPTIM_STATES_NPZ = "zero_optim_states.npz"
 META_JSON = "meta.json"
@@ -218,6 +262,11 @@ class DeepSpeedEngine:
             "opt": opt0,
             "scale": scale0,
             "skipped": jnp.asarray(0, jnp.int32),
+            # device-resident step counter: the fused train step derives its
+            # dropout/rng stream from it on-device, so no per-step host
+            # scalar transfer is needed (transfer latency dominates on
+            # remote-tunneled platforms)
+            "ustep": jnp.asarray(0, jnp.uint32),
         }
 
         # cached module-dtype params (stage<=2 keeps them resident;
@@ -410,15 +459,7 @@ class DeepSpeedEngine:
         self._cast_params_fn = jax.jit(cast_params,
                                        out_shardings=param_shardings)
 
-        def fwd_bwd(params_or_master, batch, rng, cur_scale, extra):
-            # trace-time: mesh-aware ops (ring attention) resolve THIS
-            # engine's mesh even when several engines coexist in-process
-            set_current_mesh(mesh)
-            if stage3:
-                params = cast_params(params_or_master)
-            else:
-                params = params_or_master
-
+        def loss_and_flat_grads(params, batch, rng, cur_scale, extra):
             def scaled_loss(p):
                 loss = self._loss_fn(p, batch, rng=rng, train=True, **extra)
                 return (loss.astype(jnp.float32) * cur_scale) / grad_acc
@@ -428,6 +469,13 @@ class DeepSpeedEngine:
             flat_g = jax.lax.with_sharding_constraint(flat_g, grad_sharding)
             loss = sloss * grad_acc / cur_scale
             return loss, flat_g
+
+        def fwd_bwd(params_or_master, batch, rng, cur_scale, extra):
+            # trace-time: mesh-aware ops (ring attention) resolve THIS
+            # engine's mesh even when several engines coexist in-process
+            set_current_mesh(mesh)
+            params = cast_params(params_or_master) if stage3 else params_or_master
+            return loss_and_flat_grads(params, batch, rng, cur_scale, extra)
 
         self._fwd_bwd_fn = jax.jit(fwd_bwd, out_shardings=(None, grad_sharding))
 
@@ -480,6 +528,63 @@ class DeepSpeedEngine:
 
         self._eval_fn = jax.jit(eval_fwd)
 
+        # -- fully fused train step -------------------------------------
+        # One compiled program per optimizer step: micro-batch scan
+        # (fwd+bwd+grad accumulation) → unscale/clip → optimizer update →
+        # bf16 param cast.  This is the latency-critical path: a single
+        # dispatch instead of 2+grad_acc, with master/opt/param buffers
+        # donated.  The reference pays the same cost as per-instruction
+        # kernel launches + stream sync (engine.py:796-1076); under XLA the
+        # whole step schedules as one program.  The rng stream derives from
+        # the on-device ``ustep`` counter so no host scalar crosses the wire
+        # per step; the batch arrives packed (one array per dtype, see
+        # ``_pack_batches``) to pay H2D transfer latency once.
+        acc_steps = int(getattr(self, "_grad_divisor", None)
+                        or self.gradient_accumulation_steps())
+        base_rng = self._rng
+
+        def train_step(master, opt_state, scale_state, skipped, ustep, params,
+                       packed, unpack_spec, hp, segment_ids, extra):
+            set_current_mesh(mesh)
+            cur_scale = scale_state.cur_scale
+            fwd_params = cast_params(master) if stage3 else params
+            batches = _unpack_batches(packed, unpack_spec)
+            rng = jax.random.fold_in(base_rng,
+                                     ustep * jnp.uint32(acc_steps))
+
+            def micro(carry, xs):
+                acc, i = carry
+                batch_i = xs
+                loss, flat_g = loss_and_flat_grads(
+                    fwd_params, batch_i, jax.random.fold_in(rng, i), cur_scale,
+                    extra)
+                return (acc + flat_g, i + 1), loss
+
+            if acc_steps == 1:
+                one = jax.tree_util.tree_map(lambda x: x[0], batches)
+                loss, flat_g = loss_and_flat_grads(fwd_params, one, rng,
+                                                   cur_scale, extra)
+                losses = loss[None]
+            else:
+                (flat_g, _), losses = jax.lax.scan(
+                    micro, (jnp.zeros(segments.shape, jnp.float32),
+                            jnp.asarray(0, jnp.int32)), batches)
+
+            (master, opt_state, scale_state, skipped, overflow,
+             gnorm) = apply_update(master, opt_state, scale_state, skipped,
+                                   flat_g, hp, segment_ids)
+            new_params = None if stage3 else cast_params(master)
+            return (jnp.mean(losses), master, opt_state, scale_state, skipped,
+                    ustep + jnp.uint32(1), overflow, gnorm, new_params)
+
+        self._train_step_fn = jax.jit(
+            train_step,
+            static_argnums=(7,),
+            donate_argnums=(0, 1, 5),
+            out_shardings=(None, master_sharding, self._opt_shardings, None,
+                           None, None, None, None,
+                           None if stage3 else param_shardings))
+
     def _refresh_module_params(self):
         if self.zero_stage >= 3:
             self._module_params = None
@@ -498,6 +603,21 @@ class DeepSpeedEngine:
             return jax.device_put(x, sharding)
 
         return jax.tree_util.tree_map(put, batch)
+
+    def _device_hyperparams(self):
+        """Device-resident optimizer hyperparams, refreshed only when the
+        host-side values change (LR schedules).  Avoids re-transferring a
+        handful of scalars — each a full host→device round-trip on
+        remote-attached platforms — every step."""
+        groups = getattr(self.optimizer, "param_groups", None) or [{}]
+        key = repr(sorted((k, v) for k, v in groups[0].items()
+                          if isinstance(v, (int, float, tuple, list, str, bool))))
+        cached = getattr(self, "_hp_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        hp = self.optimizer.hyperparams()
+        self._hp_cache = (key, hp)
+        return hp
 
     def _extra_kwargs(self):
         kwargs = {}
@@ -561,7 +681,7 @@ class DeepSpeedEngine:
             return
         if self.wall_clock_breakdown():
             self.timers("step").start(sync=False)
-        hp = self.optimizer.hyperparams()
+        hp = self._device_hyperparams()
         with self.mesh:
             (self.state["master"], self.state["opt"], self.state["scale"],
              self.state["skipped"], overflow, gnorm) = self._apply_fn(
@@ -601,22 +721,72 @@ class DeepSpeedEngine:
     def train_batch(self, data_iter=None):
         """One full training batch = grad_acc micro steps + update
         (mirrors the pipeline engine's ``train_batch``, reference
-        ``pipe/engine.py:244``)."""
+        ``pipe/engine.py:244``).
+
+        Runs the fully fused train-step program: one XLA dispatch per
+        optimizer step (micro-batch scan + update + param cast), with the
+        master/optimizer/param buffers donated.  The step-wise
+        ``forward()``/``backward()``/``step()`` API remains for clients that
+        drive micro-batches themselves."""
         if data_iter is None:
             assert self.training_dataloader is not None
             if not hasattr(self, "_train_iter"):
                 self._train_iter = iter(RepeatingLoader(self.training_dataloader))
             data_iter = self._train_iter
+        assert getattr(self, "_pending_grads", None) is None and \
+            self._acc_grads is None, (
+                "train_batch() cannot run with un-stepped forward()/backward() "
+                "micro-batches pending")
         self.tput_timer.start()
-        losses = []
-        for _ in range(self.gradient_accumulation_steps()):
-            batch = next(data_iter)
-            loss = self.forward(batch)
-            self.backward(loss)
-            losses.append(loss)
-        self.step()
+        if self.wall_clock_breakdown():
+            self.timers("train_batch").start(sync=False)
+        acc = self.gradient_accumulation_steps()
+        micro_batches = [next(data_iter) for _ in range(acc)]
+        packed_host, spec = _pack_batches(micro_batches)
+        sharding = NamedSharding(self.mesh, P(None, DATA_AXIS, None))
+        packed = {k: jax.device_put(v, sharding) for k, v in packed_host.items()}
+
+        hp = self._device_hyperparams()
+        with self.mesh:
+            (loss, self.state["master"], self.state["opt"], self.state["scale"],
+             self.state["skipped"], self.state["ustep"], overflow, gnorm,
+             new_params) = \
+                self._train_step_fn(self.state["master"], self.state["opt"],
+                                    self.state["scale"], self.state["skipped"],
+                                    self.state["ustep"], self._module_params,
+                                    packed, spec, hp,
+                                    self._segment_ids, self._extra_kwargs())
+        if self.zero_stage < 3:
+            self._module_params = new_params
+
+        self.micro_steps += acc
+        self.global_samples += acc * self.train_micro_batch_size_per_gpu() \
+            * self.dp_world_size
+        self.global_steps += 1
+
+        if self._config.fp16_enabled:
+            self._overflow = bool(jax.device_get(overflow))
+        else:
+            self._overflow = False
+        if self.lr_scheduler is not None and not self._overflow:
+            self.lr_scheduler.step()
+        if self.progressive_layer_drop:
+            self.progressive_layer_drop.update_state(self.global_steps)
+
+        if self.global_steps % self.steps_per_print() == 0:
+            lr = self.get_lr()[0] if self.optimizer.param_groups else 0.0
+            log_dist(
+                f"step={self.global_steps}, skipped={self.skipped_steps}, "
+                f"lr={lr:.6g}, loss={float(jax.device_get(loss)):.5f}, "
+                f"loss_scale={self.loss_scale if self._config.fp16_enabled else 1.0}",
+                ranks=[0])
+        if self.wall_clock_breakdown():
+            # the fused program has no forward/step boundary to time
+            # separately; report the whole fused step
+            self.timers("train_batch").stop(sync=True)
+            self.timers.log(["train_batch"])
         self.tput_timer.stop()
-        return jnp.mean(jnp.stack(losses))
+        return loss
 
     def eval_batch(self, batch):
         batch = self._shard_batch(batch)
@@ -696,6 +866,7 @@ class DeepSpeedEngine:
                 "cur_hysteresis": int(jax.device_get(
                     self.state["scale"].cur_hysteresis)),
             },
+            "ustep": int(jax.device_get(self.state["ustep"])),
             "lr_scheduler": (self.lr_scheduler.state_dict()
                              if self.lr_scheduler is not None else None),
             "dp_world_size": self.dp_world_size,
@@ -754,6 +925,10 @@ class DeepSpeedEngine:
             last_overflow_iter=jnp.asarray(ss["last_overflow_iter"], jnp.int32),
             cur_hysteresis=jnp.asarray(ss["cur_hysteresis"], jnp.int32))
         self.state["skipped"] = jnp.asarray(meta["skipped_steps"], jnp.int32)
+        # rng-stream counter for the fused path; old checkpoints predate it —
+        # fall back to global_steps (same cadence: one bump per update)
+        self.state["ustep"] = jnp.asarray(
+            meta.get("ustep", meta["global_steps"]), jnp.uint32)
         self.global_steps = meta["global_steps"]
         self.micro_steps = meta["micro_steps"]
         self.global_samples = meta["global_samples"]
