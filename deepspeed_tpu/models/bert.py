@@ -140,7 +140,8 @@ class BertModel:
             layer_rng = None
             if rng is not None and not deterministic:
                 rng, layer_rng = jax.random.split(rng)
-            y = run_layer(params["encoder"][f"layer_{i}"], x, layer_rng)
+            with jax.named_scope(f"layer_{i}"):
+                y = run_layer(params["encoder"][f"layer_{i}"], x, layer_rng)
             if pld_theta is not None and not deterministic and layer_rng is not None:
                 # Progressive Layer Drop: keep layer with prob θ; residual
                 # pass-through otherwise (reference PLD wiring

@@ -116,7 +116,8 @@ class GPT2LMHeadTPU:
             layer_rng = None
             if rng is not None and not deterministic:
                 rng, layer_rng = jax.random.split(rng)
-            x = run_layer(params["blocks"][f"layer_{i}"], x, layer_rng)
+            with jax.named_scope(f"layer_{i}"):
+                x = run_layer(params["blocks"][f"layer_{i}"], x, layer_rng)
 
         x = layer_norm(params["ln_f"], x, c.layer_norm_eps)
         return x @ params["wte"].T.astype(x.dtype)  # tied LM head

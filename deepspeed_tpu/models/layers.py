@@ -148,6 +148,7 @@ class TransformerLayer:
         if rng is not None and not deterministic:
             r1, r2, r3 = jax.random.split(rng, 3)
 
+        @jax.named_scope("attention")
         def attention_block(params, y):
             qkv = dense(params["qkv"], y)  # [b, s, 3h] one fused GEMM
             qkv = qkv.reshape(b, s, 3, self.heads, self.head_dim)
@@ -192,6 +193,7 @@ class TransformerLayer:
             out = dense(params["attn_out"], ctx)
             return dropout(r2, out, self.hidden_dropout_ratio, deterministic)
 
+        @jax.named_scope("mlp")
         def mlp_block(params, y):
             z = gelu(dense(params["fc1"], y))
             z = dense(params["fc2"], z)

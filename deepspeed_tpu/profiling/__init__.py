@@ -1,0 +1,5 @@
+from .config import DeepSpeedFlopsProfilerConfig
+from .flops_profiler import (FlopsProfiler, count_fn_flops, get_model_profile)
+
+__all__ = ["DeepSpeedFlopsProfilerConfig", "FlopsProfiler", "count_fn_flops",
+           "get_model_profile"]

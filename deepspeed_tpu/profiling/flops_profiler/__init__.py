@@ -1,0 +1,7 @@
+from .profiler import (FlopsProfile, FlopsProfiler, backend_cost_analysis,
+                       count_fn_flops, count_jaxpr_flops, get_model_profile,
+                       params_count)
+
+__all__ = ["FlopsProfile", "FlopsProfiler", "backend_cost_analysis",
+           "count_fn_flops", "count_jaxpr_flops", "get_model_profile",
+           "params_count"]

@@ -91,12 +91,14 @@ def _pack_batches(micro_batches):
 
 
 def _unpack_batches(packed, spec):
-    """Inverse of :func:`_pack_batches`, traced inside the fused step."""
-    treedef, entries, bsz = spec
+    """Inverse of :func:`_pack_batches`, traced inside the fused step.
+    The batch dim is taken from the array, not the spec: inside shard_map
+    the caller sees only its local 1/dp slice of the batch."""
+    treedef, entries, _ = spec
     leaves = []
     for key, off, ncols, tail in entries:
         arr = packed[key][:, :, off:off + ncols]
-        leaves.append(arr.reshape((arr.shape[0], bsz) + tail))
+        leaves.append(arr.reshape((arr.shape[0], arr.shape[1]) + tail))
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
@@ -247,9 +249,23 @@ class DeepSpeedEngine:
         self.client_optimizer = optimizer
         self.optimizer = self._configure_basic_optimizer(optimizer)
         self._opt_shardings = self._make_opt_shardings()
+        # offload mode: 'injit' (TPU — programs stream host<->device
+        # themselves) or 'eager' (state parked in pinned host between steps)
+        self._offload = self.flat.cpu_offload
+        self._offload_eager = self._offload and not self.flat.injit_placement
+        if self._offload:
+            self._opt_shardings_device = jax.tree_util.tree_map(
+                lambda s: s.with_memory_kind("device"), self._opt_shardings)
+        else:
+            self._opt_shardings_device = self._opt_shardings
         with self.mesh:
+            master0_dev = (jax.device_put(master0, self.flat.master_device_sharding)
+                           if self._offload else master0)
             opt0 = jax.jit(self.optimizer.init_state,
-                           out_shardings=self._opt_shardings)(master0)
+                           out_shardings=self._opt_shardings_device)(master0_dev)
+            if self._offload:
+                opt0 = jax.device_put(opt0, self._opt_shardings)
+                del master0_dev
 
         scale0 = DynamicScaleState.create(
             init_scale=(self._config.initial_dynamic_scale
@@ -272,6 +288,7 @@ class DeepSpeedEngine:
         # cached module-dtype params (stage<=2 keeps them resident;
         # stage 3 materializes them inside fwd_bwd from the sharded master)
         self._module_params = None
+        self._train_step_compressed_fn = None
 
         # -- schedules / aux --
         self.lr_scheduler = self._configure_lr_scheduler(lr_scheduler)
@@ -280,6 +297,11 @@ class DeepSpeedEngine:
             gamma=self._config.pld_params["gamma"])
             if self._config.pld_enabled else None)
 
+        from ..profiling.flops_profiler import FlopsProfiler
+
+        self.flops_profiler = (FlopsProfiler(self)
+                               if self._config.flops_profiler_config.enabled
+                               else None)
         self.timers = SynchronizedWallClockTimer()
         self.tput_timer = ThroughputTimer(
             batch_size=self.train_micro_batch_size_per_gpu() * self.dp_world_size,
@@ -378,7 +400,11 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     def _make_opt_shardings(self):
         """Optimizer-state shardings: flat buffers follow the master's
-        sharding; scalars (step counters) replicate."""
+        sharding; scalars (step counters) replicate.  Optimizers with
+        per-rank state (1-bit Adam error feedback) declare their own."""
+        if hasattr(self.optimizer, "state_shardings"):
+            return self.optimizer.state_shardings(
+                self.mesh, self.flat.master_sharding, self.flat.replicated)
         opt_shape = jax.eval_shape(
             self.optimizer.init_state,
             jax.ShapeDtypeStruct(self.segments.shape, jnp.float32))
@@ -449,8 +475,27 @@ class DeepSpeedEngine:
             self._segment_ids = jax.device_put(
                 segments.segment_ids(), self.flat.master_sharding)
 
+        # ZeRO-Offload: master/optimizer flat buffers live in pinned host
+        # memory; on TPU the compiled programs stream them to device
+        # explicitly (XLA requires uniform memory spaces per op) and the
+        # out_shardings pin results back to host.  On backends without
+        # in-jit placement the engine parks state eagerly between steps.
+        # Reference analog: CPU-resident fp32 master + DeepSpeedCPUAdam
+        # with async GPU copies (stage2.py:326-342, csrc/adam/cpu_adam.cpp).
+        offload = self._offload and not self._offload_eager  # in-jit mode
+        dev_sharding = self.flat.master_device_sharding
+        master_out_sharding = (self.flat.master_sharding
+                               if not self._offload_eager
+                               else dev_sharding)
+        opt_out_shardings = (self._opt_shardings if not self._offload_eager
+                             else self._opt_shardings_device)
+
+        def to_device(flat_buf):
+            return jax.device_put(flat_buf, dev_sharding) if offload else flat_buf
+
         def cast_params(master):
-            params = self.flat.unflatten_params(master, self._param_template,
+            params = self.flat.unflatten_params(to_device(master),
+                                                self._param_template,
                                                 self.compute_dtype)
             return jax.tree_util.tree_map(
                 lambda x, s: jax.lax.with_sharding_constraint(x, s),
@@ -487,6 +532,10 @@ class DeepSpeedEngine:
 
         def apply_update(master, opt_state, scale_state, skipped, flat_g, hp,
                          segment_ids):
+            master = to_device(master)
+            opt_state = jax.tree_util.tree_map(
+                lambda l: to_device(l) if getattr(l, "shape", ()) == segments.shape
+                else l, opt_state)
             inv = 1.0 / scale_state.cur_scale
             g = flat_g * inv
             if fp16:
@@ -518,7 +567,7 @@ class DeepSpeedEngine:
         self._apply_fn = jax.jit(
             apply_update,
             donate_argnums=(0, 1, 4),
-            out_shardings=(master_sharding, self._opt_shardings,
+            out_shardings=(master_out_sharding, opt_out_shardings,
                            None, None, None, None))
 
         def eval_fwd(params_or_master, batch, rng, extra):
@@ -581,18 +630,60 @@ class DeepSpeedEngine:
             train_step,
             static_argnums=(7,),
             donate_argnums=(0, 1, 5),
-            out_shardings=(None, master_sharding, self._opt_shardings, None,
+            out_shardings=(None, master_out_sharding, opt_out_shardings, None,
                            None, None, None, None,
                            None if stage3 else param_shardings))
+
+        # 1-bit Adam compressed phase: a second program with NO dense
+        # gradient allreduce (host-side phase switch at freeze_step — the
+        # analog of the reference's enable_backward_allreduce=False hook,
+        # onebit_adam.py:372)
+        from .fp16.onebit_adam import OnebitAdam
+
+        self._train_step_compressed_fn = None
+        if isinstance(optimizer, OnebitAdam):
+            assert not offload, (
+                "OneBitAdam does not compose with cpu_offload: its per-rank "
+                "error-feedback state must stay device-resident for the "
+                "compressed collective")
+            assert not (fp16 and dynamic), (
+                "OneBitAdam's compressed phase does not support fp16 dynamic "
+                "loss scaling; use bf16 (TPU-native) or a static scale")
+            self._train_step_compressed_fn = optimizer.build_compressed_step(
+                mesh=mesh, loss_fn=self._loss_fn, flat_coordinator=self.flat,
+                param_template=self._param_template,
+                compute_dtype=self.compute_dtype,
+                param_shardings=param_shardings, unpack_fn=_unpack_batches,
+                acc_steps=acc_steps, base_rng=base_rng,
+                master_sharding=master_sharding,
+                opt_shardings=self._opt_shardings)
+
+    def _state_memory(self, kind):
+        """Eager-offload mode: move master + flat optimizer leaves between
+        pinned host ('park') and device memory around compiled steps."""
+        target_m = (self.flat.master_sharding if kind == "pinned_host"
+                    else self.flat.master_device_sharding)
+        target_o = (self._opt_shardings if kind == "pinned_host"
+                    else self._opt_shardings_device)
+        self.state["master"] = jax.device_put(self.state["master"], target_m)
+        self.state["opt"] = jax.device_put(self.state["opt"], target_o)
 
     def _refresh_module_params(self):
         if self.zero_stage >= 3:
             self._module_params = None
         else:
-            self._module_params = self._cast_params_fn(self.state["master"])
+            m = self.state["master"]
+            if self._offload_eager and m.sharding.memory_kind == "pinned_host":
+                m = jax.device_put(m, self.flat.master_device_sharding)
+            self._module_params = self._cast_params_fn(m)
 
     def _forward_params(self):
-        return self.state["master"] if self.zero_stage >= 3 else self._module_params
+        if self.zero_stage >= 3:
+            m = self.state["master"]
+            if self._offload_eager and m.sharding.memory_kind == "pinned_host":
+                m = jax.device_put(m, self.flat.master_device_sharding)
+            return m
+        return self._module_params
 
     def _shard_batch(self, batch):
         """Lay a host batch onto the mesh, sharded over the data axis."""
@@ -609,9 +700,16 @@ class DeepSpeedEngine:
         host-side values change (LR schedules).  Avoids re-transferring a
         handful of scalars — each a full host→device round-trip on
         remote-attached platforms — every step."""
+        def coerce(v):
+            try:
+                return float(v)  # also catches np/jnp scalars
+            except (TypeError, ValueError):
+                if isinstance(v, (tuple, list)):
+                    return tuple(coerce(x) for x in v)
+                return repr(v)
+
         groups = getattr(self.optimizer, "param_groups", None) or [{}]
-        key = repr(sorted((k, v) for k, v in groups[0].items()
-                          if isinstance(v, (int, float, tuple, list, str, bool))))
+        key = repr(sorted((k, coerce(v)) for k, v in groups[0].items()))
         cached = getattr(self, "_hp_cache", None)
         if cached is not None and cached[0] == key:
             return cached[1]
@@ -682,12 +780,16 @@ class DeepSpeedEngine:
         if self.wall_clock_breakdown():
             self.timers("step").start(sync=False)
         hp = self._device_hyperparams()
+        if self._offload_eager:
+            self._state_memory("device")
         with self.mesh:
             (self.state["master"], self.state["opt"], self.state["scale"],
              self.state["skipped"], overflow, gnorm) = self._apply_fn(
                 self.state["master"], self.state["opt"], self.state["scale"],
                 self.state["skipped"], self._acc_grads, hp, self._segment_ids)
             self._refresh_module_params()
+        if self._offload_eager:
+            self._state_memory("pinned_host")
         self._acc_grads = None
         self.global_steps += 1
 
@@ -742,22 +844,36 @@ class DeepSpeedEngine:
             self.timers("train_batch").start(sync=False)
         acc = self.gradient_accumulation_steps()
         micro_batches = [next(data_iter) for _ in range(acc)]
-        packed_host, spec = _pack_batches(micro_batches)
+        try:
+            packed_host, spec = _pack_batches(micro_batches)
+        except (ValueError, AssertionError):
+            # ragged micro-batches (e.g. a short final batch) cannot be
+            # stacked into the fused program; fall back to the step-wise
+            # path, which handles them at the cost of a retrace
+            return self._train_batch_stepwise(micro_batches)
         sharding = NamedSharding(self.mesh, P(None, DATA_AXIS, None))
         packed = {k: jax.device_put(v, sharding) for k, v in packed_host.items()}
 
         hp = self._device_hyperparams()
+        step_fn = self._train_step_fn
+        if (self._train_step_compressed_fn is not None
+                and self.global_steps >= self.optimizer.freeze_step):
+            step_fn = self._train_step_compressed_fn
+        if self._offload_eager:
+            self._state_memory("device")
         with self.mesh:
             (loss, self.state["master"], self.state["opt"], self.state["scale"],
              self.state["skipped"], self.state["ustep"], overflow, gnorm,
              new_params) = \
-                self._train_step_fn(self.state["master"], self.state["opt"],
-                                    self.state["scale"], self.state["skipped"],
-                                    self.state["ustep"], self._module_params,
-                                    packed, spec, hp,
-                                    self._segment_ids, self._extra_kwargs())
+                step_fn(self.state["master"], self.state["opt"],
+                        self.state["scale"], self.state["skipped"],
+                        self.state["ustep"], self._module_params,
+                        packed, spec, hp,
+                        self._segment_ids, self._extra_kwargs())
         if self.zero_stage < 3:
             self._module_params = new_params
+        if self._offload_eager:
+            self._state_memory("pinned_host")
 
         self.micro_steps += acc
         self.global_samples += acc * self.train_micro_batch_size_per_gpu() \
@@ -773,6 +889,12 @@ class DeepSpeedEngine:
         if self.progressive_layer_drop:
             self.progressive_layer_drop.update_state(self.global_steps)
 
+        if (self.flops_profiler is not None and self.global_steps ==
+                self._config.flops_profiler_config.profile_step):
+            prof = self.flops_profiler.profile_train_step(micro_batches[0])
+            prof.print(
+                top_modules=self._config.flops_profiler_config.top_modules)
+
         if self.global_steps % self.steps_per_print() == 0:
             lr = self.get_lr()[0] if self.optimizer.param_groups else 0.0
             log_dist(
@@ -787,6 +909,18 @@ class DeepSpeedEngine:
             self.timers.log(["train_batch"])
         self.tput_timer.stop()
         return loss
+
+    def _train_batch_stepwise(self, micro_batches):
+        """Per-micro-batch path for batches the fused program cannot take
+        (ragged shapes); same semantics, more dispatches."""
+        losses = []
+        for batch in micro_batches:
+            loss = self.forward(batch)
+            self.backward(loss)
+            losses.append(loss)
+        self.step()
+        self.tput_timer.stop()
+        return jnp.mean(jnp.stack(losses))
 
     def eval_batch(self, batch):
         batch = self._shard_batch(batch)
@@ -958,6 +1092,18 @@ class DeepSpeedEngine:
             if arr.ndim == 1 and leaf.shape == self.segments.shape:
                 # flat buffer saved unpadded (possibly different DP degree)
                 arr = self.flat.repad_unpadded(arr)
+            elif arr.shape != leaf.shape:
+                # dp-geometry-dependent state (e.g. 1-bit Adam error
+                # buffers) restored into a different DP degree: reset to
+                # zeros — error feedback re-accumulates within a few steps
+                logger.warning(
+                    f"optimizer state {key}: checkpoint shape {arr.shape} != "
+                    f"current {leaf.shape} (DP degree changed); resetting to "
+                    f"zeros")
+                leaves.append(jax.device_put(
+                    np.zeros(leaf.shape, leaf.dtype),
+                    getattr(leaf, "sharding", None)))
+                continue
             sharding = getattr(leaf, "sharding", None)
             leaves.append(jax.device_put(arr.astype(leaf.dtype), sharding))
         return jax.tree_util.tree_unflatten(treedef, leaves)

@@ -55,28 +55,43 @@ class FlatParamCoordinator:
 
         master_spec = P("data") if stage >= 1 else P()
         grad_spec = P("data") if stage >= 2 else P()
-        mem_kind = None
+        self.cpu_offload = bool(cpu_offload)
+        # in-jit memory-space streaming (annotate_device_placement) is a
+        # TPU-backend feature; elsewhere the engine parks state in host
+        # memory eagerly between steps
+        self.injit_placement = mesh.devices.flat[0].platform == "tpu"
         if cpu_offload:
             try:
                 mesh.devices.flat[0].memory("pinned_host")
-                mem_kind = "pinned_host"
-            except Exception:
-                logger.warning(
-                    "cpu_offload requested but this backend has no pinned_host "
-                    "memory space; keeping optimizer state on device")
-        if mem_kind:
-            self.master_sharding = NamedSharding(mesh, master_spec, memory_kind=mem_kind)
+            except Exception as e:
+                # loud by design: a silent on-device fallback would claim the
+                # reference's "10x bigger models" capability
+                # (ZeRO-Offload, stage2.py:326-342) without delivering it
+                raise RuntimeError(
+                    "zero_optimization.cpu_offload=true but this backend has "
+                    "no pinned_host memory space") from e
+            self.master_sharding = NamedSharding(mesh, master_spec,
+                                                 memory_kind="pinned_host")
         else:
             self.master_sharding = NamedSharding(mesh, master_spec)
+        # same layout, device memory: the in-program stream-in target for
+        # offloaded buffers
+        self.master_device_sharding = NamedSharding(mesh, master_spec,
+                                                    memory_kind="device")
         self.grad_sharding = NamedSharding(mesh, grad_spec)
         self.replicated = NamedSharding(mesh, P())
 
     # -- host-side (eager) --
     def flatten_to_master(self, params) -> jax.Array:
-        """Build the initial (rows, LANES) fp32 master from a params pytree."""
+        """Build the initial (rows, LANES) fp32 master from a params pytree.
+        Under offload the flatten runs on device and the result is parked in
+        pinned host memory eagerly (in-jit placement is not universally
+        supported at trace time on all backends)."""
         with self.mesh:
             flat = jax.jit(self._flatten_traced,
-                           out_shardings=self.master_sharding)(params)
+                           out_shardings=self.master_device_sharding)(params)
+        if self.cpu_offload:
+            flat = jax.device_put(flat, self.master_sharding)
         return flat
 
     def gather_master_unpadded(self, master) -> np.ndarray:
@@ -135,13 +150,15 @@ class FlatParamCoordinator:
     def flatten_grads(self, grads):
         return self._flatten_traced(grads, jnp.float32)
 
-    def unflatten_params(self, master, template, dtype):
+    def unflatten_params(self, master, template, dtype, constrain=True):
         """(rows, LANES) master → params pytree in compute dtype.  The
         replication constraint first forces a single all-gather of the
         shard(s) instead of per-leaf gathers (the reference's bucketed
         sequential all_gather, ``stage2.py:1444-1477``, collapsed into one
-        collective)."""
-        flat = jax.lax.with_sharding_constraint(master, self.replicated)
+        collective).  ``constrain=False`` skips it for callers already in a
+        manual (shard_map) context."""
+        flat = (jax.lax.with_sharding_constraint(master, self.replicated)
+                if constrain else master)
         leaves, treedef = jax.tree_util.tree_flatten(template)
         assert len(leaves) == self.segments.num_segments, (
             f"template has {len(leaves)} leaves but the coordinator was built "

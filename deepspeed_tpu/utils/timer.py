@@ -16,14 +16,19 @@ from .logging import logger
 
 
 def device_fence():
-    """Block until previously dispatched device computations complete."""
+    """Block until previously dispatched device computations complete.
+
+    A host round-trip (``device_get`` of a freshly dispatched computation)
+    rather than ``block_until_ready``: on remote-attached platforms the
+    latter has been observed to return before remote execution finishes,
+    while a fetched result cannot exist until everything queued before it
+    (per-device dispatch is in order) has run.
+    """
     try:
         import jax
+        import jax.numpy as jnp
 
-        # Effectively a barrier on the default device's execution stream:
-        # jax dispatches in order per device, so blocking on a fresh trivial
-        # computation flushes the queue.
-        jax.block_until_ready(jax.device_put(0))
+        jax.device_get(jnp.zeros(()) + 0)
     except Exception:
         pass
 
@@ -126,11 +131,17 @@ class ThroughputTimer:
     def _init_timer(self):
         self.initialized = True
 
+    def _fence_due(self):
+        # fencing is a host round-trip; pay it only on steps whose duration
+        # is actually reported, so instrumented steps can still pipeline
+        return (self.global_step_count + 1) % self.steps_per_output == 0
+
     def start(self):
         self._init_timer()
         self.started = True
         if self.global_step_count >= self.start_step:
-            device_fence()
+            if self._fence_due():
+                device_fence()
             self.start_time = time.time()
 
     def stop(self, report_speed=True):
@@ -140,7 +151,8 @@ class ThroughputTimer:
         self.micro_step_count += 1
         self.global_step_count += 1
         if self.start_time > 0:
-            device_fence()
+            if self.global_step_count % self.steps_per_output == 0:
+                device_fence()
             self.end_time = time.time()
             duration = self.end_time - self.start_time
             self.total_elapsed_time += duration

@@ -1,0 +1,104 @@
+"""Flops profiler: exact counts on known-FLOPs modules, control-flow
+handling, per-scope attribution, engine integration (reference
+``tests/unit/test_flops_profiler.py``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu as deepspeed
+from deepspeed_tpu.parallel import make_mesh
+from deepspeed_tpu.profiling.flops_profiler import (count_fn_flops,
+                                                    get_model_profile,
+                                                    params_count)
+
+from .simple_model import SimpleModel, base_config, random_batches
+
+HIDDEN = 16
+
+
+def test_matmul_exact_count():
+    B, K, N = 8, 32, 64
+    x = jnp.ones((B, K))
+    w = jnp.ones((K, N))
+    flops, _ = count_fn_flops(lambda a, b: a @ b, x, w)
+    assert flops == 2 * B * K * N
+
+
+def test_grad_counts_backward_too():
+    """Training FLOPs come from the traced backward, not a 3x heuristic:
+    d(xW) needs two more matmuls (dx = gW^T, dW = x^T g)."""
+    B, K, N = 4, 8, 16
+    x = jnp.ones((B, K))
+    w = jnp.ones((K, N))
+
+    def loss(w):
+        return jnp.sum(x @ w)
+
+    fwd, _ = count_fn_flops(loss, w)
+    bwd, _ = count_fn_flops(jax.grad(loss), w)
+    assert bwd >= fwd + 2 * B * K * N - 2 * B * N  # two extra matmuls
+
+
+def test_scan_multiplies_by_length():
+    K = 16
+    w = jnp.ones((K, K))
+
+    def scanned(x):
+        def body(c, _):
+            return c @ w, None
+        out, _ = jax.lax.scan(body, x, None, length=10)
+        return out
+
+    one, _ = count_fn_flops(lambda x: x @ w, jnp.ones((2, K)))
+    ten, _ = count_fn_flops(scanned, jnp.ones((2, K)))
+    assert ten == 10 * one
+
+
+def test_named_scope_attribution():
+    K = 32
+    w1 = jnp.ones((K, K))
+    w2 = jnp.ones((K, 2 * K))
+
+    def fn(x):
+        with jax.named_scope("small"):
+            a = x @ w1
+        with jax.named_scope("big"):
+            b = a @ w2
+        return jnp.sum(b)
+
+    flops, by_scope = count_fn_flops(fn, jnp.ones((4, K)))
+    small = sum(v for k, v in by_scope.items() if "small" in k)
+    big = sum(v for k, v in by_scope.items() if "big" in k)
+    assert small == 2 * 4 * K * K
+    assert big == 2 * 4 * K * 2 * K
+
+
+def test_get_model_profile_simple_model():
+    model = SimpleModel(HIDDEN, nlayers=2)
+    batch = random_batches(1, 8, HIDDEN, seed=0)[0]
+    params = model.init(jax.random.PRNGKey(0))
+    flops, macs, n_params = get_model_profile(model=model, batch=batch,
+                                              params=params,
+                                              print_profile=False)
+    assert n_params == params_count(params)
+    assert flops > 0 and macs == flops // 2
+    ftrain, _, _ = get_model_profile(model=model, batch=batch, params=params,
+                                     train=True, print_profile=False)
+    assert ftrain > flops  # backward included
+
+
+def test_engine_profiler_wiring(cpu_devices):
+    config = base_config(flops_profiler={"enabled": True, "profile_step": 2})
+    mesh = make_mesh({"data": 8}, devices=cpu_devices[:8])
+    engine, *_ = deepspeed.initialize(model=SimpleModel(HIDDEN, nlayers=2),
+                                      config=config, mesh=mesh)
+    assert engine.flops_profiler is not None
+    batch = random_batches(1, engine.train_micro_batch_size_per_gpu() * 8,
+                           HIDDEN, seed=0)[0]
+    for _ in range(3):
+        engine.train_batch(iter([batch]))
+    prof = engine.flops_profiler.profile
+    assert prof is not None, "profiler did not run at profile_step"
+    assert prof.flops > 0
+    assert prof.params == params_count(engine._param_template)
