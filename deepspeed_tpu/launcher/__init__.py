@@ -1,0 +1,8 @@
+"""Launcher package: hostfile-driven multi-node job start
+(reference ``deepspeed/launcher/``)."""
+
+from .runner import (decode_world_info, encode_world_info, fetch_hostfile,
+                     filter_resources)
+
+__all__ = ["decode_world_info", "encode_world_info", "fetch_hostfile",
+           "filter_resources"]

@@ -1,0 +1,17 @@
+"""Launcher constants (reference ``deepspeed/launcher/constants.py``)."""
+
+PDSH_LAUNCHER = "pdsh"
+SSH_LAUNCHER = "ssh"
+OPENMPI_LAUNCHER = "openmpi"
+
+DEFAULT_HOSTFILE = "/job/hostfile"
+DEFAULT_MASTER_PORT = 29500
+DEFAULT_PROCS_PER_NODE = 1  # one JAX process drives all local chips
+
+# env contract consumed by utils/distributed.init_distributed (the analog
+# of the reference's MASTER_ADDR/MASTER_PORT/WORLD_SIZE/RANK dance)
+ENV_COORDINATOR = "DS_COORDINATOR"
+ENV_NUM_PROCESSES = "DS_NUM_PROCESSES"
+ENV_PROCESS_ID = "DS_PROCESS_ID"
+ENV_LOCAL_RANK = "DS_LOCAL_RANK"
+ENV_WORLD_INFO = "DS_WORLD_INFO"

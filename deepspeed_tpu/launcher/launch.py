@@ -1,0 +1,115 @@
+"""Per-node process spawner.
+
+TPU-native analog of the reference ``deepspeed/launcher/launch.py:67-167``:
+decodes the world info, computes each local process's global id, sets the
+``DS_*`` rendezvous env consumed by ``utils/distributed.init_distributed``
+(which feeds ``jax.distributed.initialize``), spawns one Python process per
+local slot, monitors them, and tears the node down if any child dies.
+SIGINT/SIGTERM are forwarded to the children (reference ``:131-146``).
+"""
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+from ..utils.logging import logger
+from .constants import (ENV_COORDINATOR, ENV_LOCAL_RANK, ENV_NUM_PROCESSES,
+                        ENV_PROCESS_ID)
+from .runner import decode_world_info
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(description="DeepSpeed-TPU node spawner")
+    parser.add_argument("--world_info", type=str, required=True)
+    parser.add_argument("--node_rank", type=str, default="0",
+                        help="this node's index, or 'auto' (match hostname)")
+    parser.add_argument("--master_addr", type=str, required=True)
+    parser.add_argument("--master_port", type=int, required=True)
+    parser.add_argument("training_script", type=str)
+    parser.add_argument("script_args", nargs=argparse.REMAINDER)
+    ns = parser.parse_args(args)
+    # tolerate the '--' separator the runner inserts
+    if ns.training_script == "--" and ns.script_args:
+        ns.training_script = ns.script_args[0]
+        ns.script_args = ns.script_args[1:]
+    return ns
+
+
+def resolve_node_rank(node_rank, world):
+    if node_rank != "auto":
+        return int(node_rank)
+    hostname = socket.gethostname()
+    hosts = list(world.keys())
+    for cand in (hostname, hostname.split(".")[0], "localhost"):
+        if cand in hosts:
+            return hosts.index(cand)
+    raise RuntimeError(
+        f"cannot resolve node rank: hostname {hostname!r} not in {hosts}")
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    world = decode_world_info(args.world_info)
+    node_rank = resolve_node_rank(args.node_rank, world)
+    hosts = list(world.keys())
+    assert 0 <= node_rank < len(hosts), f"node_rank {node_rank} vs {hosts}"
+
+    # global process ids: hostfile order, then slot order
+    first_id = sum(len(world[h]) for h in hosts[:node_rank])
+    local_slots = world[hosts[node_rank]]
+    total = sum(len(v) for v in world.values())
+
+    procs = []
+    for local_rank, slot in enumerate(local_slots):
+        env = os.environ.copy()
+        env[ENV_COORDINATOR] = f"{args.master_addr}:{args.master_port}"
+        env[ENV_NUM_PROCESSES] = str(total)
+        env[ENV_PROCESS_ID] = str(first_id + local_rank)
+        # the SLOT id from the (include/exclude-filtered) hostfile, so slot
+        # filtering reaches the process; device binding from it is
+        # platform-specific (e.g. TPU_VISIBLE_CHIPS), left to the script
+        env[ENV_LOCAL_RANK] = str(slot)
+        cmd = [sys.executable, "-u", args.training_script, *args.script_args]
+        logger.info(f"launching process {first_id + local_rank}/{total}: "
+                    f"{' '.join(cmd)}")
+        procs.append(subprocess.Popen(cmd, env=env))
+
+    def terminate_all(sig=signal.SIGTERM):
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.send_signal(sig)
+                except ProcessLookupError:
+                    pass
+
+    def forward_signal(signum, _frame):
+        terminate_all(signum)
+        sys.exit(128 + signum)
+
+    signal.signal(signal.SIGINT, forward_signal)
+    signal.signal(signal.SIGTERM, forward_signal)
+
+    # monitor: any child failure tears down the node (reference :151-167)
+    alive = list(procs)
+    rc = 0
+    while alive:
+        time.sleep(1)
+        for p in list(alive):
+            ret = p.poll()
+            if ret is None:
+                continue
+            alive.remove(p)
+            if ret != 0:
+                logger.error(f"process {p.pid} exited with code {ret}; "
+                             "terminating remaining processes")
+                terminate_all()
+                rc = ret
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,230 @@
+"""Multi-node launcher front-end.
+
+TPU-native analog of the reference ``deepspeed/launcher/runner.py:254-330``:
+reads a hostfile, applies ``--include``/``--exclude`` node/slot filters,
+encodes the resource map, and either execs the per-node spawner directly
+(single node) or fans out over pdsh/ssh (multi node).  The per-process env
+contract it establishes (``DS_COORDINATOR``/``DS_NUM_PROCESSES``/
+``DS_PROCESS_ID``) is what ``utils/distributed.init_distributed`` feeds to
+``jax.distributed.initialize`` — coordinator-based rendezvous instead of
+the reference's MASTER_ADDR process groups.
+
+Usage::
+
+    deepspeed [--hostfile H] [--include w1@w2:0,1] [--num_nodes N]
+              [--num_procs P] your_script.py --your-args
+"""
+
+import argparse
+import base64
+import json
+import os
+import shlex
+import subprocess
+import sys
+
+from ..utils.logging import logger
+from .constants import (DEFAULT_HOSTFILE, DEFAULT_MASTER_PORT,
+                        DEFAULT_PROCS_PER_NODE, ENV_WORLD_INFO,
+                        PDSH_LAUNCHER, SSH_LAUNCHER)
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        description="DeepSpeed-TPU multi-node launcher",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("-H", "--hostfile", type=str, default=DEFAULT_HOSTFILE,
+                        help="hostfile of 'hostname slots=N' lines")
+    parser.add_argument("-i", "--include", type=str, default="",
+                        help="nodes/slots to include, e.g. "
+                             "'worker-0@worker-1:0,2'")
+    parser.add_argument("-e", "--exclude", type=str, default="",
+                        help="nodes/slots to exclude, e.g. 'worker-1:0'")
+    parser.add_argument("--num_nodes", type=int, default=-1,
+                        help="cap on node count (first N of the hostfile)")
+    parser.add_argument("--num_procs", type=int, default=-1,
+                        help="processes per node (default: hostfile slots, "
+                             f"or {DEFAULT_PROCS_PER_NODE})")
+    parser.add_argument("--master_addr", type=str, default="",
+                        help="coordinator address (default: first node)")
+    parser.add_argument("--master_port", type=int, default=DEFAULT_MASTER_PORT)
+    parser.add_argument("--launcher", type=str, default=PDSH_LAUNCHER,
+                        choices=[PDSH_LAUNCHER, SSH_LAUNCHER])
+    parser.add_argument("--force_multi", action="store_true",
+                        help="treat as multi-node even for one host")
+    parser.add_argument("user_script", type=str)
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args)
+
+
+def fetch_hostfile(path):
+    """Parse 'hostname slots=N' lines (reference ``runner.py:115-143``).
+    Returns an ordered {hostname: slots} dict; {} when the file is absent
+    (single-node fallback)."""
+    if not os.path.isfile(path):
+        return {}
+    pool = {}
+    with open(path) as f:
+        for line in f:
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            try:
+                host, slots = line.split()
+                key, n = slots.split("=")
+                assert key == "slots"
+                n = int(n)
+            except Exception as e:
+                raise ValueError(f"malformed hostfile line: {line!r}") from e
+            if host in pool:
+                raise ValueError(f"duplicate host {host!r} in hostfile")
+            pool[host] = n
+    return pool
+
+
+def _parse_filter(spec):
+    """'w0@w1:0,2' -> {'w0': None, 'w1': [0, 2]} (None = every slot)."""
+    out = {}
+    for part in spec.split("@"):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            host, slots = part.split(":")
+            out[host.strip()] = sorted(int(s) for s in slots.split(","))
+        else:
+            out[part] = None
+    return out
+
+
+def filter_resources(pool, include="", exclude=""):
+    """Apply include/exclude filters (reference ``runner.py:146-245``).
+    Returns ordered {host: [slot ids]}."""
+    assert not (include and exclude), "--include and --exclude are exclusive"
+    active = {h: list(range(n)) for h, n in pool.items()}
+    if include:
+        spec = _parse_filter(include)
+        unknown = set(spec) - set(active)
+        assert not unknown, f"include references unknown hosts {sorted(unknown)}"
+        active = {h: (spec[h] if spec[h] is not None else active[h])
+                  for h in active if h in spec}
+        for h, slots in active.items():
+            bad = set(slots) - set(range(pool[h]))
+            assert not bad, f"include slots {sorted(bad)} out of range on {h}"
+    elif exclude:
+        spec = _parse_filter(exclude)
+        unknown = set(spec) - set(active)
+        assert not unknown, f"exclude references unknown hosts {sorted(unknown)}"
+        for h, slots in spec.items():
+            if slots is None:
+                active.pop(h, None)
+            else:
+                bad = set(slots) - set(range(pool[h]))
+                assert not bad, f"exclude slots {sorted(bad)} out of range on {h}"
+                active[h] = [s for s in active[h] if s not in slots]
+                if not active[h]:
+                    active.pop(h)
+    return active
+
+
+def encode_world_info(active):
+    return base64.urlsafe_b64encode(
+        json.dumps(active).encode()).decode()
+
+
+def decode_world_info(encoded):
+    return json.loads(base64.urlsafe_b64decode(encoded.encode()).decode())
+
+
+def build_launch_cmd(args, active, node_rank, master_addr):
+    """The per-node spawner command (runs on each host)."""
+    return [
+        sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
+        f"--world_info={encode_world_info(active)}",
+        f"--node_rank={node_rank}",
+        f"--master_addr={master_addr}",
+        f"--master_port={args.master_port}",
+        "--", args.user_script, *args.user_args,
+    ]
+
+
+class MultiNodeRunner:
+    """Base for remote fan-out backends (reference
+    ``multinode_runner.py:47-75``)."""
+
+    def __init__(self, args, active, master_addr):
+        self.args = args
+        self.active = active
+        self.master_addr = master_addr
+
+    def commands(self):
+        raise NotImplementedError
+
+
+class PDSHRunner(MultiNodeRunner):
+    name = PDSH_LAUNCHER
+
+    def commands(self):
+        hosts = ",".join(self.active.keys())
+        # pdsh broadcasts one identical command line; each node passes
+        # node_rank=auto and the spawner resolves its rank by matching its
+        # hostname against the world info
+        cmd = build_launch_cmd(self.args, self.active, "auto", self.master_addr)
+        return [["pdsh", "-S", "-f", "1024", "-w", hosts,
+                 "cd {}; {}".format(shlex.quote(os.getcwd()),
+                                    " ".join(shlex.quote(c) for c in cmd))]]
+
+
+class SSHRunner(MultiNodeRunner):
+    name = SSH_LAUNCHER
+
+    def commands(self):
+        cmds = []
+        for rank, host in enumerate(self.active):
+            cmd = build_launch_cmd(self.args, self.active, rank,
+                                   self.master_addr)
+            cmds.append(["ssh", host,
+                         "cd {}; {}".format(
+                             shlex.quote(os.getcwd()),
+                             " ".join(shlex.quote(c) for c in cmd))])
+        return cmds
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    pool = fetch_hostfile(args.hostfile)
+    if not pool:
+        assert not (args.include or args.exclude), (
+            f"no hostfile at {args.hostfile}; include/exclude need one")
+        import socket
+
+        nprocs = args.num_procs if args.num_procs > 0 else DEFAULT_PROCS_PER_NODE
+        pool = {socket.gethostname(): nprocs}
+    if args.num_nodes > 0:
+        pool = dict(list(pool.items())[:args.num_nodes])
+    if args.num_procs > 0:
+        pool = {h: args.num_procs for h in pool}
+    active = filter_resources(pool, args.include, args.exclude)
+    assert active, "no hosts left after include/exclude filtering"
+    master_addr = args.master_addr or next(iter(active))
+    logger.info(f"launching on {active} (coordinator {master_addr}:"
+                f"{args.master_port})")
+
+    if len(active) == 1 and not args.force_multi:
+        cmd = build_launch_cmd(args, active, 0, master_addr)
+        os.environ[ENV_WORLD_INFO] = encode_world_info(active)
+        result = subprocess.call(cmd)
+        sys.exit(result)
+
+    runner = (PDSHRunner if args.launcher == PDSH_LAUNCHER else SSHRunner)(
+        args, active, master_addr)
+    procs = [subprocess.Popen(c) for c in runner.commands()]
+    rc = 0
+    for p in procs:
+        p.wait()
+        rc = rc or p.returncode
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

@@ -133,15 +133,24 @@ class BertModel:
             return self.layer.apply(layer_params, x, key_padding_mask=kpm,
                                     rng=layer_rng, deterministic=deterministic)
 
+        ck_layer = None
         if c.remat:
-            run_layer = jax.checkpoint(run_layer)
+            from ..runtime.activation_checkpointing import checkpointing as ds_ckpt
+
+            ck_layer = ds_ckpt.checkpoint_wrapper(run_layer)
 
         for i in range(c.num_hidden_layers):
             layer_rng = None
             if rng is not None and not deterministic:
                 rng, layer_rng = jax.random.split(rng)
+            fn = run_layer
+            if ck_layer is not None:
+                from ..runtime.activation_checkpointing import checkpointing as ds_ckpt
+
+                if ds_ckpt.should_checkpoint_layer(i, c.num_hidden_layers):
+                    fn = ck_layer
             with jax.named_scope(f"layer_{i}"):
-                y = run_layer(params["encoder"][f"layer_{i}"], x, layer_rng)
+                y = fn(params["encoder"][f"layer_{i}"], x, layer_rng)
             if pld_theta is not None and not deterministic and layer_rng is not None:
                 # Progressive Layer Drop: keep layer with prob θ; residual
                 # pass-through otherwise (reference PLD wiring

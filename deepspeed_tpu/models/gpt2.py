@@ -109,15 +109,24 @@ class GPT2LMHeadTPU:
             return self.layer.apply(layer_params, x, rng=layer_rng,
                                     deterministic=deterministic)
 
+        ck_layer = None
         if c.remat:
-            run_layer = jax.checkpoint(run_layer)
+            from ..runtime.activation_checkpointing import checkpointing as ds_ckpt
+
+            ck_layer = ds_ckpt.checkpoint_wrapper(run_layer)
 
         for i in range(c.num_layers):
             layer_rng = None
             if rng is not None and not deterministic:
                 rng, layer_rng = jax.random.split(rng)
+            fn = run_layer
+            if ck_layer is not None:
+                from ..runtime.activation_checkpointing import checkpointing as ds_ckpt
+
+                if ds_ckpt.should_checkpoint_layer(i, c.num_layers):
+                    fn = ck_layer
             with jax.named_scope(f"layer_{i}"):
-                x = run_layer(params["blocks"][f"layer_{i}"], x, layer_rng)
+                x = fn(params["blocks"][f"layer_{i}"], x, layer_rng)
 
         x = layer_norm(params["ln_f"], x, c.layer_norm_eps)
         return x @ params["wte"].T.astype(x.dtype)  # tied LM head

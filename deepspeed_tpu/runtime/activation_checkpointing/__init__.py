@@ -1,0 +1,4 @@
+from . import checkpointing
+from .config import DeepSpeedActivationCheckpointingConfig
+
+__all__ = ["checkpointing", "DeepSpeedActivationCheckpointingConfig"]

@@ -6,14 +6,17 @@ direct port (reference ``:9-30``); ``DeepSpeedDataLoader`` (reference
 instead of a per-rank ``DistributedSampler``, the loader yields *global*
 micro-batches (micro_batch_per_device × data_parallel_size) as numpy/host
 arrays, and the engine lays each batch onto the mesh with a
-``NamedSharding`` over the ``data`` axis.  Multi-host: each process loads
-its ``jax.process_index()``-th slice of the global batch
-(``data_sharding_process_slice``).
+``NamedSharding`` over the ``data`` axis.  Multi-host: each process keeps
+its ``jax.process_index()``-th slice of every global batch
+(``_process_slice``) and the engine reassembles the global device array
+with ``jax.make_array_from_process_local_data``.
 """
 
 import itertools
 
 import numpy as np
+
+from ..utils.logging import logger
 
 
 class RepeatingLoader:
@@ -65,6 +68,18 @@ class DeepSpeedDataLoader:
         self.drop_last = drop_last
         self.tput_timer = tput_timer
         self.epoch = 0
+        # multi-host slicing: every process iterates the dataset in the same
+        # (seeded) order and keeps its own contiguous 1/world slice of each
+        # global batch — the analog of the reference's DistributedSampler
+        # (``dataloader.py:53-61``), expressed batch-wise so the engine can
+        # reassemble the global array from per-process shards.
+        assert 0 <= data_parallel_rank < max(data_parallel_world_size, 1)
+        assert batch_size % max(data_parallel_world_size, 1) == 0, (
+            f"global batch {batch_size} not divisible by "
+            f"{data_parallel_world_size} processes")
+        self.world = max(data_parallel_world_size, 1)
+        self.rank = data_parallel_rank
+        self.local_batch = batch_size // self.world
         try:
             n = len(dataset)
             self.len = n // batch_size if drop_last else -(-n // batch_size)
@@ -90,6 +105,13 @@ class DeepSpeedDataLoader:
         for i in order:
             yield self.dataset[int(i)]
 
+    def _process_slice(self, samples):
+        """This process's contiguous slice of one global batch's samples."""
+        if self.world == 1:
+            return samples
+        per = len(samples) // self.world
+        return samples[self.rank * per:(self.rank + 1) * per]
+
     def __iter__(self):
         self.epoch += 1
         samples = []
@@ -98,7 +120,21 @@ class DeepSpeedDataLoader:
         for s in self._sample_iter():
             samples.append(s)
             if len(samples) == self.batch_size:
-                yield self.collate_fn(samples)
+                yield self.collate_fn(self._process_slice(samples))
                 samples = []
         if samples and not self.drop_last:
-            yield self.collate_fn(samples)
+            if self.world > 1 and len(samples) % self.world != 0:
+                # a ragged tail cannot split evenly across processes and
+                # would break the global-array shape contract; trim to the
+                # largest common multiple (or drop the tail entirely)
+                keep = (len(samples) // self.world) * self.world
+                if keep == 0:
+                    logger.warning(
+                        f"dropping final partial batch of {len(samples)} "
+                        f"samples (< {self.world} processes)")
+                    return
+                logger.warning(
+                    f"final partial batch trimmed {len(samples)} -> {keep} "
+                    f"samples to split across {self.world} processes")
+                samples = samples[:keep]
+            yield self.collate_fn(self._process_slice(samples))

@@ -203,6 +203,20 @@ class DeepSpeedEngine:
                                   if self._config.fp16_enabled and self._config.loss_scale != 0
                                   else 1.0)
 
+        # -- activation checkpointing (reference checkpointing.configure;
+        # VERDICT: config must drive remat, not per-model flags) --
+        from .activation_checkpointing import checkpointing as ds_checkpointing
+        from .activation_checkpointing.config import ACT_CHKPT
+
+        if ACT_CHKPT in self._config._param_dict:
+            ds_checkpointing.configure(
+                act_config=self._config.activation_checkpointing_config)
+            mcfg = getattr(model, "config", None)
+            if hasattr(mcfg, "remat") and not mcfg.remat:
+                mcfg.remat = True
+                log_dist("activation checkpointing enabled from config",
+                         ranks=[0])
+
         # -- model / loss function --
         self.module = model
         if hasattr(model, "apply"):
@@ -642,7 +656,7 @@ class DeepSpeedEngine:
 
         self._train_step_compressed_fn = None
         if isinstance(optimizer, OnebitAdam):
-            assert not offload, (
+            assert not self._offload, (
                 "OneBitAdam does not compose with cpu_offload: its per-rank "
                 "error-feedback state must stay device-resident for the "
                 "compressed collective")
@@ -686,11 +700,17 @@ class DeepSpeedEngine:
         return self._module_params
 
     def _shard_batch(self, batch):
-        """Lay a host batch onto the mesh, sharded over the data axis."""
+        """Lay a host batch onto the mesh, sharded over the data axis.
+        Multi-host: ``batch`` is this process's slice (the dataloader's
+        ``_process_slice`` contract) and the global array is assembled from
+        the per-process shards."""
         sharding = NamedSharding(self.mesh, P(DATA_AXIS))
+        multihost = jax.process_count() > 1
 
         def put(x):
             x = np.asarray(x)
+            if multihost:
+                return jax.make_array_from_process_local_data(sharding, x)
             return jax.device_put(x, sharding)
 
         return jax.tree_util.tree_map(put, batch)
@@ -850,9 +870,16 @@ class DeepSpeedEngine:
             # ragged micro-batches (e.g. a short final batch) cannot be
             # stacked into the fused program; fall back to the step-wise
             # path, which handles them at the cost of a retrace
+            if self.wall_clock_breakdown():
+                self.timers("train_batch").stop(sync=False)
             return self._train_batch_stepwise(micro_batches)
         sharding = NamedSharding(self.mesh, P(None, DATA_AXIS, None))
-        packed = {k: jax.device_put(v, sharding) for k, v in packed_host.items()}
+        if jax.process_count() > 1:
+            packed = {k: jax.make_array_from_process_local_data(sharding, v)
+                      for k, v in packed_host.items()}
+        else:
+            packed = {k: jax.device_put(v, sharding)
+                      for k, v in packed_host.items()}
 
         hp = self._device_hyperparams()
         step_fn = self._train_step_fn
@@ -935,9 +962,11 @@ class DeepSpeedEngine:
                      data_sampler=None, collate_fn=None, num_local_io_workers=None):
         batch_size = batch_size or (self.train_micro_batch_size_per_gpu()
                                     * self.dp_world_size)
-        return DeepSpeedDataLoader(dataset, batch_size=batch_size,
-                                   collate_fn=collate_fn,
-                                   tput_timer=self.tput_timer)
+        return DeepSpeedDataLoader(
+            dataset, batch_size=batch_size, collate_fn=collate_fn,
+            tput_timer=self.tput_timer,
+            data_parallel_world_size=jax.process_count(),
+            data_parallel_rank=jax.process_index())
 
     # ------------------------------------------------------------------
     # checkpointing (reference engine.py:1275-1573; layout notes SURVEY §3.5)

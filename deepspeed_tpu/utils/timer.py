@@ -119,6 +119,9 @@ class ThroughputTimer:
         self.micro_step_count = 0
         self.global_step_count = 0
         self.total_elapsed_time = 0
+        self.counted_steps = 0
+        self._window_anchor = None
+        self._window_anchor_step = 0
         self.steps_per_output = steps_per_output
         self.monitor_memory = monitor_memory
         self.logging = logging_fn or logger.info
@@ -131,20 +134,17 @@ class ThroughputTimer:
     def _init_timer(self):
         self.initialized = True
 
-    def _fence_due(self):
-        # fencing is a host round-trip; pay it only on steps whose duration
-        # is actually reported, so instrumented steps can still pipeline
-        return (self.global_step_count + 1) % self.steps_per_output == 0
-
     def start(self):
         self._init_timer()
         self.started = True
         if self.global_step_count >= self.start_step:
-            if self._fence_due():
-                device_fence()
             self.start_time = time.time()
 
     def stop(self, report_speed=True):
+        """Fencing is a host round-trip, so it happens only on reporting
+        steps; durations are measured over whole fenced *windows* (time
+        between consecutive fenced stops ÷ steps in between) — unfenced
+        per-step times would only measure async dispatch."""
         if not self.started:
             return
         self.started = False
@@ -153,20 +153,29 @@ class ThroughputTimer:
         if self.start_time > 0:
             if self.global_step_count % self.steps_per_output == 0:
                 device_fence()
-            self.end_time = time.time()
-            duration = self.end_time - self.start_time
-            self.total_elapsed_time += duration
-            if self.global_step_count % self.steps_per_output == 0 and report_speed:
-                self.logging(
-                    f"{self.__class__.__name__}: epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
-                    f"global_step={self.global_step_count}, RunningAvgSamplesPerSec={self.avg_samples_per_sec():.2f}, "
-                    f"CurrSamplesPerSec={(self.batch_size * self.num_workers) / duration:.2f}"
-                )
+                now = time.time()
+                if self._window_anchor is not None:
+                    self.total_elapsed_time += now - self._window_anchor
+                    self.counted_steps += (self.global_step_count
+                                           - self._window_anchor_step)
+                window_steps = self.global_step_count - (
+                    self._window_anchor_step if self._window_anchor is not None
+                    else self.start_step)
+                window_time = now - (self._window_anchor or self.start_time)
+                self._window_anchor = now
+                self._window_anchor_step = self.global_step_count
+                if report_speed and window_steps > 0 and window_time > 0:
+                    self.logging(
+                        f"{self.__class__.__name__}: epoch={self.epoch_count}/"
+                        f"micro_step={self.micro_step_count}/"
+                        f"global_step={self.global_step_count}, "
+                        f"RunningAvgSamplesPerSec={self.avg_samples_per_sec():.2f}, "
+                        f"CurrSamplesPerSec={self.batch_size * self.num_workers * window_steps / window_time:.2f}"
+                    )
 
     def avg_samples_per_sec(self):
-        if self.global_step_count > 0 and self.total_elapsed_time > 0:
+        if self.counted_steps > 0 and self.total_elapsed_time > 0:
             samples_per_step = self.batch_size * self.num_workers
-            total_step_offset = self.global_step_count - self.start_step
-            avg_time_per_step = self.total_elapsed_time / max(total_step_offset, 1)
+            avg_time_per_step = self.total_elapsed_time / self.counted_steps
             return samples_per_step / avg_time_per_step
         return float("-inf")

@@ -1,0 +1,54 @@
+"""Training script run by the launcher smoke test: 2 processes x 1 CPU
+device, global data mesh, real multi-host rendezvous + sliced dataloader."""
+
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import numpy as np  # noqa: E402
+
+import deepspeed_tpu as deepspeed  # noqa: E402
+from deepspeed_tpu.parallel import make_mesh  # noqa: E402
+from deepspeed_tpu.utils.distributed import init_distributed  # noqa: E402
+from unit.simple_model import SimpleModel, base_config  # noqa: E402
+
+HIDDEN = 16
+
+
+def main():
+    out_dir = sys.argv[1]
+    init_distributed()
+    assert jax.process_count() == 2, f"expected 2 processes, got {jax.process_count()}"
+    devices = jax.devices()
+    assert len(devices) == 2, f"expected 2 global devices, got {devices}"
+
+    mesh = make_mesh({"data": 2}, devices=devices)
+    rng = np.random.default_rng(0)
+    n, bs = 32, 8
+    data = [(rng.normal(size=(HIDDEN,)).astype(np.float32),
+             rng.normal(size=(HIDDEN,)).astype(np.float32)) for _ in range(n)]
+    config = base_config(train_batch_size=bs)
+    engine, _, loader, _ = deepspeed.initialize(
+        model=SimpleModel(HIDDEN, nlayers=2), config=config, mesh=mesh,
+        training_data=data)
+    assert loader.local_batch == bs // 2, loader.local_batch
+
+    losses = [float(np.asarray(jax.device_get(engine.train_batch())))
+              for _ in range(3)]
+    assert all(np.isfinite(losses)), losses
+
+    with open(os.path.join(out_dir, f"rank{jax.process_index()}.ok"), "w") as f:
+        f.write(repr(losses))
+    print(f"rank {jax.process_index()} done: {losses}")
+
+
+if __name__ == "__main__":
+    main()
