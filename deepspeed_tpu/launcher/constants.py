@@ -14,4 +14,3 @@ ENV_COORDINATOR = "DS_COORDINATOR"
 ENV_NUM_PROCESSES = "DS_NUM_PROCESSES"
 ENV_PROCESS_ID = "DS_PROCESS_ID"
 ENV_LOCAL_RANK = "DS_LOCAL_RANK"
-ENV_WORLD_INFO = "DS_WORLD_INFO"

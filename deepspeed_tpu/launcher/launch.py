@@ -107,7 +107,8 @@ def main(argv=None):
                 logger.error(f"process {p.pid} exited with code {ret}; "
                              "terminating remaining processes")
                 terminate_all()
-                rc = ret
+                if rc == 0:  # keep the FIRST failure, not siblings' SIGTERM
+                    rc = ret
     sys.exit(rc)
 
 

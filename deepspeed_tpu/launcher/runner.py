@@ -25,8 +25,7 @@ import sys
 
 from ..utils.logging import logger
 from .constants import (DEFAULT_HOSTFILE, DEFAULT_MASTER_PORT,
-                        DEFAULT_PROCS_PER_NODE, ENV_WORLD_INFO,
-                        PDSH_LAUNCHER, SSH_LAUNCHER)
+                        DEFAULT_PROCS_PER_NODE, PDSH_LAUNCHER, SSH_LAUNCHER)
 
 
 def parse_args(args=None):
@@ -212,7 +211,6 @@ def main(argv=None):
 
     if len(active) == 1 and not args.force_multi:
         cmd = build_launch_cmd(args, active, 0, master_addr)
-        os.environ[ENV_WORLD_INFO] = encode_world_info(active)
         result = subprocess.call(cmd)
         sys.exit(result)
 

@@ -188,6 +188,11 @@ class BertForPreTrainingTPU:
         }
         return params
 
+    def sparse_gradient_paths(self):
+        """Embedding leaves with row-sparse gradients (the reference's
+        nn.Embedding auto-detect, ``engine.py:180-185``)."""
+        return ("bert/embeddings/word", "bert/embeddings/token_type")
+
     def partition_specs(self, mesh):
         has_model = "model" in mesh.axis_names
         return {

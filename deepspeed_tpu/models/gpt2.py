@@ -84,6 +84,11 @@ class GPT2LMHeadTPU:
                      "bias": jnp.zeros((c.hidden_size,), jnp.float32)},
         }
 
+    def sparse_gradient_paths(self):
+        """Embedding leaves with row-sparse gradients (the reference's
+        nn.Embedding auto-detect, ``engine.py:180-185``)."""
+        return ("wte", "wpe")
+
     def partition_specs(self, mesh):
         c = self.config
         has_model = "model" in mesh.axis_names

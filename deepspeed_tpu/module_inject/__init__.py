@@ -1,0 +1,7 @@
+"""Kernel/module injection (reference ``deepspeed/module_inject/``)."""
+
+from .replace_module import (inject_bert_layer, replace_module,
+                             replace_transformer_layer, revert_bert_layer)
+
+__all__ = ["inject_bert_layer", "replace_module",
+           "replace_transformer_layer", "revert_bert_layer"]

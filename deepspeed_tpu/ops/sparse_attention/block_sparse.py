@@ -58,9 +58,12 @@ def block_sparse_attention(q, k, v, layout, causal=False,
         causal: additionally mask within-block upper triangles
             ('unidirectional' layouts; the reference's Triton softmax does
             this via the layout plus per-block masking).
-        key_padding_mask: additive ``[batch, seq]`` (-inf at masked keys).
+        key_padding_mask: additive ``[batch, seq]``; masked keys must use a
+            large-but-FINITE negative (e.g. ``NEG_INF = -1e9``) — true
+            ``-inf`` turns the softmax into NaN before the fully-masked-row
+            guard can zero it.
         attn_mask: additive ``[seq, seq]`` (reference 'mul'/'add' modes
-            collapse to additive -inf masks here).
+            collapse to additive finite -1e9 masks here).
         rpe: additive relative-position bias ``[heads, seq, seq]``.
         scale: defaults to 1/sqrt(head_dim).
     """
@@ -120,10 +123,16 @@ def block_sparse_attention(q, k, v, layout, causal=False,
         scores = scores + rp[hh, jnp.asarray(qpos)[None, :, :, None, None],
                              kpos_j[:, :, None]]
 
-    # softmax over all active key elements (kmax*blk), fp32
+    # softmax over all active key elements (kmax*blk), fp32.  Rows with no
+    # visible key (every entry at ~NEG_INF — fully-masked query, e.g. a
+    # padding row) yield zero output instead of uniform-over-garbage; for
+    # that detection to work, additive masks must be finite (use -1e9, not
+    # -inf).
     flat = scores.reshape(b, h, nb, blk, kmax * blk)
     m = jnp.max(flat, axis=-1, keepdims=True)
-    e = jnp.exp(flat - jax.lax.stop_gradient(m))
+    all_masked = m <= NEG_INF * 0.5
+    e = jnp.exp(flat - jax.lax.stop_gradient(jnp.where(all_masked, 0.0, m)))
+    e = jnp.where(all_masked, 0.0, e)
     denom = jnp.sum(e, axis=-1, keepdims=True)
     probs = (e / jnp.maximum(denom, 1e-20)).reshape(scores.shape)
 

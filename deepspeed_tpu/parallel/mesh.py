@@ -20,6 +20,40 @@ from .topology import ProcessTopology
 
 PIPE_AXIS = "pipe"
 DATA_AXIS = "data"
+
+
+def data_parallel_process_info(mesh):
+    """(world, rank) for per-process batch slicing: how many process groups
+    the ``data`` mesh axis spans, and which group this process is in.
+
+    If the data axis does not cross process boundaries (e.g. multi-host
+    model/pipe parallelism with a local data axis), every process must feed
+    the SAME global batch — world is 1.  Otherwise processes own contiguous
+    equal blocks of data coordinates (the standard mesh layout).
+    """
+    import jax
+
+    axes = list(mesh.axis_names)
+    if DATA_AXIS not in axes:
+        return 1, 0
+    di = axes.index(DATA_AXIS)
+    devs = mesh.devices
+    ncoord = devs.shape[di]
+    if ncoord <= 1:
+        return 1, 0
+    me = jax.process_index()
+    mine = sorted({i for i in range(ncoord)
+                   if any(d.process_index == me
+                          for d in np.take(devs, i, axis=di).flat)})
+    if not mine or len(mine) == ncoord:
+        # this process sees every data coordinate (or none — not a
+        # participant): feed the full batch
+        return 1, 0
+    assert ncoord % len(mine) == 0 and mine == list(
+        range(mine[0], mine[0] + len(mine))), (
+        f"data axis coords owned by process {me} are not a contiguous "
+        f"equal block: {mine} of {ncoord}")
+    return ncoord // len(mine), mine[0] // len(mine)
 SEQ_AXIS = "seq"
 MODEL_AXIS = "model"
 

@@ -82,14 +82,13 @@ def make_remat_policy(cfg=None):
 
 
 def should_checkpoint_layer(index, num_layers, cfg=None):
-    """``number_checkpoints`` spreads k checkpoints evenly over the stack
-    (reference ``num_checkpoints``); default: every layer."""
+    """``number_checkpoints`` spreads exactly k checkpoints evenly over the
+    stack (reference ``num_checkpoints``); default: every layer."""
     cfg = cfg or _config
     k = cfg.number_checkpoints
     if not k or k >= num_layers:
         return True
-    # layer i is a checkpoint iff it starts one of k even chunks
-    return index % -(-num_layers // k) == 0
+    return index in {round(j * num_layers / k) for j in range(k)}
 
 
 def _annotate(x, cfg):

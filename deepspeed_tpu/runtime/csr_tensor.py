@@ -1,0 +1,78 @@
+"""Row-sparse (CSR-like) gradient representation.
+
+Analog of the reference ``deepspeed/runtime/csr_tensor.py:11-58``
+(``CSRTensor``, torch's IndexedSlices equivalent) used for sparse embedding
+gradients.  On TPU, XLA computes embedding gradients as dense scatter-adds
+and the data-parallel reduction rides ICI, so the dense path is the fast
+default; the CSR form exists for the reference's use case — shrinking
+gradient exchange for huge, sparsely-touched embeddings over slow (DCN)
+links — via :func:`deepspeed_tpu.comm.sparse_allreduce`.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class CSRTensor(NamedTuple):
+    """Row-sparse view of a [rows, cols] tensor: ``indices[i]`` is the row
+    id of ``values[i]``.  ``indices`` may contain duplicates (they add) and
+    padding entries marked with ``rows`` (out of range ⇒ dropped)."""
+
+    indices: jnp.ndarray  # i32[nnz]
+    values: jnp.ndarray   # f32[nnz, cols]
+    dense_shape: tuple    # (rows, cols)
+
+    @classmethod
+    def from_dense(cls, dense, max_rows=None):
+        """Compress a dense [rows, cols] tensor with few non-zero rows.
+        ``max_rows`` fixes the nnz budget for jit-static shapes (defaults
+        to all rows — no compression, still valid)."""
+        rows, cols = dense.shape
+        k = max_rows or rows
+        norms = jnp.sum(jnp.abs(dense), axis=1)
+        # top-k by row mass; rows beyond the true support get zero values
+        _, idx = jax.lax.top_k(norms, k)
+        vals = jnp.take(dense, idx, axis=0)
+        # mark all-zero rows as padding so duplicates of row 0 don't arise
+        pad = jnp.where(jnp.sum(jnp.abs(vals), axis=1) > 0, idx.astype(jnp.int32),
+                        jnp.int32(rows))
+        return cls(indices=pad, values=vals, dense_shape=(rows, cols))
+
+    def to_dense(self):
+        rows, cols = self.dense_shape
+        out = jnp.zeros((rows + 1, cols), self.values.dtype)
+        out = out.at[jnp.clip(self.indices, 0, rows)].add(self.values)
+        return out[:rows]
+
+    @property
+    def nnz(self):
+        return self.indices.shape[0]
+
+    def sparsity(self):
+        rows, _ = self.dense_shape
+        return 1.0 - self.nnz / max(rows, 1)
+
+
+def csr_allreduce(csr: CSRTensor, axis_name: str) -> jnp.ndarray:
+    """Sum a row-sparse gradient across ``axis_name`` inside shard_map and
+    return the DENSE result (identical on all ranks).
+
+    Transport mirrors the reference's padded ``all_gather`` of (indices,
+    values) pairs (``engine.py:1203-1241``): each rank contributes its nnz
+    rows; the union scatter-adds into the dense buffer.  Wire bytes are
+    ``nnz x cols`` per rank instead of ``rows x cols``.
+    """
+    all_idx = jax.lax.all_gather(csr.indices, axis_name)   # [w, nnz]
+    all_val = jax.lax.all_gather(csr.values, axis_name)    # [w, nnz, cols]
+    merged = CSRTensor(indices=all_idx.reshape(-1),
+                       values=all_val.reshape(-1, csr.values.shape[-1]),
+                       dense_shape=csr.dense_shape)
+    return merged.to_dense()
+
+
+def csr_allreduce_reference(csrs):
+    """Host ground truth: dense sum of per-rank CSR tensors."""
+    return np.sum([np.asarray(c.to_dense()) for c in csrs], axis=0)

@@ -217,6 +217,26 @@ class DeepSpeedEngine:
                 log_dist("activation checkpointing enabled from config",
                          ranks=[0])
 
+        # -- sparse (row-sparse/CSR) embedding gradients --
+        # reference auto-detects nn.Embedding modules (engine.py:180-185)
+        # and exchanges their grads as CSR pairs; models here declare their
+        # embedding leaves.  ZeRO shards the flat space and cannot carry a
+        # row-sparse exchange (same incompatibility as the reference's
+        # CSR-under-ZeRO).
+        self._sparse_grad_paths = ()
+        if self._config.sparse_gradients_enabled:
+            assert self._config.zero_optimization_stage == 0, (
+                "sparse_gradients are not supported with ZeRO (the flat "
+                "parameter space is sharded; reference has the same limit)")
+            if hasattr(model, "sparse_gradient_paths"):
+                self._sparse_grad_paths = tuple(model.sparse_gradient_paths())
+            log_dist(
+                f"sparse_gradients: embedding leaves "
+                f"{self._sparse_grad_paths or '(none declared)'} — NOTE: the "
+                f"in-engine reduction stays dense (XLA scatter-add on ICI is "
+                f"the fast path); csr_allreduce is the building block for "
+                f"custom DCN-bound exchanges", ranks=[0])
+
         # -- model / loss function --
         self.module = model
         if hasattr(model, "apply"):
@@ -312,10 +332,16 @@ class DeepSpeedEngine:
             if self._config.pld_enabled else None)
 
         from ..profiling.flops_profiler import FlopsProfiler
+        from ..utils.monitor import TrainingMonitor
 
         self.flops_profiler = (FlopsProfiler(self)
                                if self._config.flops_profiler_config.enabled
                                else None)
+        self.monitor = TrainingMonitor(
+            self._config.tensorboard_enabled,
+            self._config.tensorboard_output_path,
+            self._config.tensorboard_job_name,
+            rank=jax.process_index())
         self.timers = SynchronizedWallClockTimer()
         self.tput_timer = ThroughputTimer(
             batch_size=self.train_micro_batch_size_per_gpu() * self.dp_world_size,
@@ -384,6 +410,12 @@ class DeepSpeedEngine:
 
     def sparse_gradients_enabled(self):
         return self._config.sparse_gradients_enabled
+
+    def sparse_gradient_paths(self):
+        """Embedding leaves declared row-sparse by the model (for tooling
+        and custom DCN exchanges via ``runtime.csr_tensor.csr_allreduce``;
+        the in-engine reduction on ICI is dense scatter-add either way)."""
+        return self._sparse_grad_paths
 
     def progressive_layer_drop_enabled(self):
         return self._config.pld_enabled
@@ -508,9 +540,15 @@ class DeepSpeedEngine:
             return jax.device_put(flat_buf, dev_sharding) if offload else flat_buf
 
         def cast_params(master):
+            # stage 3 skips the up-front full replication: each leaf's row
+            # slice gathers lazily from the sharded master, so XLA can
+            # schedule per-layer gathers and free them after last use
+            # instead of materializing a replicated copy of every
+            # parameter for the whole step (stage-3's memory win)
             params = self.flat.unflatten_params(to_device(master),
                                                 self._param_template,
-                                                self.compute_dtype)
+                                                self.compute_dtype,
+                                                constrain=not stage3)
             return jax.tree_util.tree_map(
                 lambda x, s: jax.lax.with_sharding_constraint(x, s),
                 params, param_shardings)
@@ -753,7 +791,15 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     def forward(self, batch):
         """Compute loss and gradients for one micro-batch (reference
-        ``engine.py:796``).  Returns the (async) scalar loss."""
+        ``engine.py:796``).  Returns the (async) scalar loss.
+
+        API compatibility note: the reference's ``forward`` returns model
+        *outputs* and ``backward(loss)`` runs autodiff.  Under XLA the
+        fused fwd+bwd program is the efficient unit, so ``forward`` already
+        produces gradients (held until :meth:`backward` accumulates them)
+        and the return value is the scalar loss, not intermediate outputs.
+        Clients that need raw model outputs should call
+        :meth:`eval_batch` / ``module.apply`` directly."""
         if self.wall_clock_breakdown():
             self.timers("forward").start(sync=False)
         batch = self._shard_batch(batch)
@@ -830,11 +876,16 @@ class DeepSpeedEngine:
             mean_loss = float(np.mean([np.asarray(jax.device_get(l))
                                        for l in self._losses])) if self._losses else 0.0
             lr = self.get_lr()[0] if self.optimizer.param_groups else 0.0
+            scale = self.loss_scale if self._config.fp16_enabled else 1.0
             log_dist(
                 f"step={self.global_steps}, skipped={self.skipped_steps}, "
-                f"lr={lr:.6g}, loss={mean_loss:.5f}, "
-                f"loss_scale={self.loss_scale if self._config.fp16_enabled else 1.0}",
+                f"lr={lr:.6g}, loss={mean_loss:.5f}, loss_scale={scale}",
                 ranks=[0])
+            self.monitor.write_scalars(self.global_samples, {
+                "Train/Samples/train_loss": mean_loss,
+                "Train/Samples/lr": lr,
+                "Train/Samples/loss_scale": scale,
+            })
         self._losses = []
         if self.wall_clock_breakdown():
             self.timers("step").stop(sync=False)
@@ -923,12 +974,22 @@ class DeepSpeedEngine:
                 top_modules=self._config.flops_profiler_config.top_modules)
 
         if self.global_steps % self.steps_per_print() == 0:
+            # monitor scalars share the steps_per_print cadence: fetching
+            # the loss is a host sync, so it must stay off the per-step
+            # critical path
             lr = self.get_lr()[0] if self.optimizer.param_groups else 0.0
+            loss_val = float(jax.device_get(loss))
+            scale = self.loss_scale if self._config.fp16_enabled else 1.0
             log_dist(
                 f"step={self.global_steps}, skipped={self.skipped_steps}, "
-                f"lr={lr:.6g}, loss={float(jax.device_get(loss)):.5f}, "
-                f"loss_scale={self.loss_scale if self._config.fp16_enabled else 1.0}",
+                f"lr={lr:.6g}, loss={loss_val:.5f}, loss_scale={scale}",
                 ranks=[0])
+            # reference tensorboard tags (engine.py:1014-1067)
+            self.monitor.write_scalars(self.global_samples, {
+                "Train/Samples/train_loss": loss_val,
+                "Train/Samples/lr": lr,
+                "Train/Samples/loss_scale": scale,
+            })
         if self.wall_clock_breakdown():
             # the fused program has no forward/step boundary to time
             # separately; report the whole fused step
@@ -962,11 +1023,13 @@ class DeepSpeedEngine:
                      data_sampler=None, collate_fn=None, num_local_io_workers=None):
         batch_size = batch_size or (self.train_micro_batch_size_per_gpu()
                                     * self.dp_world_size)
+        from ..parallel.mesh import data_parallel_process_info
+
+        world, rank = data_parallel_process_info(self.mesh)
         return DeepSpeedDataLoader(
             dataset, batch_size=batch_size, collate_fn=collate_fn,
             tput_timer=self.tput_timer,
-            data_parallel_world_size=jax.process_count(),
-            data_parallel_rank=jax.process_index())
+            data_parallel_world_size=world, data_parallel_rank=rank)
 
     # ------------------------------------------------------------------
     # checkpointing (reference engine.py:1275-1573; layout notes SURVEY §3.5)

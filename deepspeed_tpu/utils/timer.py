@@ -138,6 +138,12 @@ class ThroughputTimer:
         self._init_timer()
         self.started = True
         if self.global_step_count >= self.start_step:
+            if self._window_anchor is None:
+                # first measured window opens here, fenced so queued warmup
+                # work is not billed to it
+                device_fence()
+                self._window_anchor = time.time()
+                self._window_anchor_step = self.global_step_count
             self.start_time = time.time()
 
     def stop(self, report_speed=True):
@@ -151,17 +157,14 @@ class ThroughputTimer:
         self.micro_step_count += 1
         self.global_step_count += 1
         if self.start_time > 0:
-            if self.global_step_count % self.steps_per_output == 0:
+            if (self.global_step_count % self.steps_per_output == 0
+                    and self._window_anchor is not None):
                 device_fence()
                 now = time.time()
-                if self._window_anchor is not None:
-                    self.total_elapsed_time += now - self._window_anchor
-                    self.counted_steps += (self.global_step_count
-                                           - self._window_anchor_step)
-                window_steps = self.global_step_count - (
-                    self._window_anchor_step if self._window_anchor is not None
-                    else self.start_step)
-                window_time = now - (self._window_anchor or self.start_time)
+                window_steps = self.global_step_count - self._window_anchor_step
+                window_time = now - self._window_anchor
+                self.total_elapsed_time += window_time
+                self.counted_steps += window_steps
                 self._window_anchor = now
                 self._window_anchor_step = self.global_step_count
                 if report_speed and window_steps > 0 and window_time > 0:

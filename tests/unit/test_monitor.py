@@ -1,0 +1,63 @@
+"""Monitoring (tensorboard/JSONL scalars) + env report."""
+
+import json
+import os
+
+import numpy as np
+
+import deepspeed_tpu as deepspeed
+from deepspeed_tpu.env_report import op_report
+from deepspeed_tpu.parallel import make_mesh
+from deepspeed_tpu.utils.monitor import TrainingMonitor
+
+from .simple_model import SimpleModel, base_config, random_batches
+
+
+def test_monitor_writes_scalars(tmp_path):
+    mon = TrainingMonitor(True, str(tmp_path), "job")
+    mon.write_scalars(10, {"Train/loss": 1.5, "Train/lr": 0.01})
+    mon.write_scalars(20, {"Train/loss": 1.2, "Train/lr": 0.01})
+    mon.close()
+    lines = [json.loads(l) for l in
+             open(tmp_path / "job" / "events.jsonl")]
+    assert [l["step"] for l in lines] == [10, 20]
+    assert lines[1]["Train/loss"] == 1.2
+    # tensorboard event file exists when the writer is available
+    tb_files = [f for f in os.listdir(tmp_path / "job")
+                if f.startswith("events.out.tfevents")]
+    assert tb_files, "no tensorboard event file written"
+
+
+def test_monitor_disabled_is_noop(tmp_path):
+    mon = TrainingMonitor(False, str(tmp_path), "job")
+    mon.write_scalars(1, {"x": 1.0})
+    mon.close()
+    assert not (tmp_path / "job").exists()
+
+
+def test_engine_tensorboard_wiring(tmp_path, cpu_devices):
+    mesh = make_mesh({"data": 8}, devices=cpu_devices[:8])
+    # monitor scalars follow the steps_per_print cadence (host-sync cost)
+    config = base_config(steps_per_print=1,
+                         tensorboard={"enabled": True,
+                                      "output_path": str(tmp_path),
+                                      "job_name": "unit"})
+    engine, *_ = deepspeed.initialize(model=SimpleModel(16, nlayers=2),
+                                      config=config, mesh=mesh)
+    batch = random_batches(1, engine.train_micro_batch_size_per_gpu() * 8,
+                           16, seed=0)[0]
+    for _ in range(3):
+        engine.train_batch(iter([batch]))
+    lines = [json.loads(l) for l in
+             open(tmp_path / "unit" / "events.jsonl")]
+    assert len(lines) == 3
+    assert all("Train/Samples/train_loss" in l for l in lines)
+    assert all(np.isfinite(l["Train/Samples/train_loss"]) for l in lines)
+
+
+def test_op_report_shape():
+    rows = op_report()
+    names = [r[0] for r in rows]
+    assert "fused_adam" in names and "flash_attention" in names
+    for name, ok, detail in rows:
+        assert isinstance(ok, (bool, np.bool_)) and isinstance(detail, str)

@@ -239,3 +239,22 @@ def test_layout_gather_indices():
     assert valid[0, 0].tolist() == [True, False]
     assert idx[0, 2].tolist() == [1, 3]
     assert valid[0, 1].tolist() == [False, False]
+
+
+def test_fully_masked_rows_yield_zero():
+    """Queries whose every key is padded out produce exactly zero output
+    (and no NaN), matching the flash kernel's fully-masked-row contract."""
+    rng = np.random.default_rng(0)
+    b, s, h, d, blk = 2, 64, 2, 16, 16
+    q, k, v = (jnp.asarray(rng.normal(size=(b, s, h, d)).astype(np.float32))
+               for _ in range(3))
+    layout = np.ones((1, s // blk, s // blk), np.int64)
+    # batch 1: every key masked -> all rows fully masked
+    kpm = np.zeros((b, s), np.float32)
+    kpm[1, :] = -1e9
+    out = block_sparse_attention(q, k, v, layout,
+                                 key_padding_mask=jnp.asarray(kpm))
+    out = np.asarray(out)
+    assert np.isfinite(out).all(), "NaN/inf leaked from fully-masked rows"
+    np.testing.assert_array_equal(out[1], np.zeros_like(out[1]))
+    assert np.abs(out[0]).max() > 0
