@@ -63,6 +63,8 @@ def op_report():
         ("activation_offload", pinned and on_tpu,
          "remat policy offload needs in-jit memory placement (TPU)"),
         ("transformer (bf16)", True, "XLA-fused reference layers"),
+        ("tensorboard monitor", tb_ok,
+         "torch.utils.tensorboard" + ("" if tb_ok else " MISSING — JSONL only")),
     ]
     return rows
 

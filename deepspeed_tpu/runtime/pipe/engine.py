@@ -33,9 +33,11 @@ Hybrid parallelism: the shard_map is manual over ``pipe`` only; ``data``
 sharding and the ZeRO flat-space machinery of the base engine compose
 unchanged (PP×DP×TP, reference ``topology.py:246``).
 
-Constraints of this execution model (v1): stage-boundary activations must
-be a single array of one common shape/dtype (true for transformer stacks);
-a ``loss_fn`` is required when ``pipe > 1``.
+Constraints of this execution model: stage-boundary activations may be any
+pytree of arrays but must be uniform (same structure/shapes/dtypes) across
+stage boundaries; a ``loss_fn`` is required when ``pipe > 1``.  With
+``activation_checkpoint_interval`` set, each pipeline tick rematerializes,
+so stored activations are only the in-flight boundary carries.
 """
 
 import jax
@@ -101,20 +103,35 @@ class _PipelinedModel:
 
         parts = self._ensure_parts(params)
 
-        # Boundary activation shape: chase shapes through the stage slices
-        # and check they agree (single-array uniform-carry execution model).
+        # Boundary activation structure: chase shapes through the stage
+        # slices and check they agree.  Boundaries may be any PYTREE of
+        # arrays (uniform across stages) — multi-tensor carries like
+        # (hidden, attention_bias) work; the reference's meta handshake
+        # (pipe/engine.py:657-768) is this check, done at trace time.
         sample_in = jax.tree_util.tree_map(lambda a: a[0], inputs)
-        bshape = jax.eval_shape(
+        btree = jax.eval_shape(
             lambda p, x: module.apply_range(p, 0, parts[1], x), params, sample_in)
+        bstruct = jax.tree_util.tree_structure(btree)
         for s in range(1, stages - 1):
             nxt = jax.eval_shape(
                 lambda p, x: module.apply_range(p, parts[s], parts[s + 1], x),
-                params, bshape)
-            assert nxt.shape == bshape.shape and nxt.dtype == bshape.dtype, (
-                f"stage {s} boundary {nxt.shape}/{nxt.dtype} != stage 0 "
-                f"boundary {bshape.shape}/{bshape.dtype}; pipeline stages must "
-                "exchange one uniform activation")
-            bshape = nxt
+                params, btree)
+            same = (jax.tree_util.tree_structure(nxt) == bstruct and all(
+                a.shape == b2.shape and a.dtype == b2.dtype
+                for a, b2 in zip(jax.tree_util.tree_leaves(nxt),
+                                 jax.tree_util.tree_leaves(btree))))
+            assert same, (
+                f"stage {s} boundary {nxt} != previous boundary {btree}; "
+                "pipeline stages must exchange one uniform activation pytree")
+            btree = nxt
+
+        def zeros_boundary():
+            return jax.tree_util.tree_map(
+                lambda sd: jnp.zeros(sd.shape, sd.dtype), btree)
+
+        def cast_boundary(y):
+            return jax.tree_util.tree_map(
+                lambda a, sd: a.astype(sd.dtype), y, btree)
 
         def branch_fn(s):
             first, last = s == 0, s == stages - 1
@@ -129,8 +146,8 @@ class _PipelinedModel:
                 if last:
                     loss = module.loss_fn(y, mb_labels)
                     loss = jnp.where(valid, loss.astype(jnp.float32), 0.0)
-                    return jnp.zeros(bshape.shape, bshape.dtype), loss
-                return y.astype(bshape.dtype), jnp.asarray(0.0, jnp.float32)
+                    return zeros_boundary(), loss
+                return cast_boundary(y), jnp.asarray(0.0, jnp.float32)
 
             return branch
 
@@ -138,8 +155,25 @@ class _PipelinedModel:
         perm = [(i, (i + 1) % stages) for i in range(stages)]
         ticks = mb_count + stages - 1
 
+        # Per-tick rematerialization: differentiate-through-scan saves every
+        # tick's layer-internal activations by default (O(ticks·layers)
+        # live memory).  Checkpointing the tick body stores only the
+        # boundary carries and recomputes stage internals in backward — the
+        # memory profile of the reference's activation-checkpointed 1F1B
+        # (stored state = in-flight boundary activations).  Enabled by the
+        # module's activation_checkpoint_interval knob.
+        per_tick_remat = bool(module.activation_checkpoint_interval)
+
         def per_pipe(params, inputs, labels, rng):
             s = jax.lax.axis_index(PIPE_AXIS)
+
+            def tick_compute(params, x_state, mb_inputs, mb_labels, valid,
+                             tick_rng):
+                return jax.lax.switch(s, branches, params, x_state,
+                                      mb_inputs, mb_labels, valid, tick_rng)
+
+            if per_tick_remat:
+                tick_compute = jax.checkpoint(tick_compute)
 
             def tick(carry, t):
                 x_state, loss_sum = carry
@@ -159,14 +193,15 @@ class _PipelinedModel:
                 # per-buffer RNG state
                 tick_rng = (jax.random.fold_in(jax.random.fold_in(rng, my_mb), s)
                             if rng is not None else None)
-                y, loss = jax.lax.switch(s, branches, params, x_state,
-                                         mb_inputs, mb_labels, valid, tick_rng)
-                x_next = jax.lax.ppermute(y, PIPE_AXIS, perm)
+                y, loss = tick_compute(params, x_state, mb_inputs, mb_labels,
+                                       valid, tick_rng)
+                x_next = jax.tree_util.tree_map(
+                    lambda a: jax.lax.ppermute(a, PIPE_AXIS, perm), y)
                 return (x_next, loss_sum + loss), None
 
-            x0 = jnp.zeros(bshape.shape, bshape.dtype)
             (x_state, loss_sum), _ = jax.lax.scan(
-                tick, (x0, jnp.asarray(0.0, jnp.float32)), jnp.arange(ticks))
+                tick, (zeros_boundary(), jnp.asarray(0.0, jnp.float32)),
+                jnp.arange(ticks))
             # reference _aggregate_total_loss: last stage holds the sum;
             # broadcast down the pipe group == psum here (others hold 0)
             return jax.lax.psum(loss_sum, PIPE_AXIS) / mb_count

@@ -120,13 +120,20 @@ class PipelineModule:
         self.layers = []
         self.tied_keys = {}  # key -> index of owning (first) layer
         self._tied_key_of = {}  # layer idx -> key
+        self._tied_attr_of = {}  # layer idx -> tied_weight_attr
         self._forward_fns = {}  # layer idx -> forward_fn override
         for idx, spec in enumerate(self.layer_specs):
             if isinstance(spec, TiedLayerSpec):
                 layer = spec.build()
                 if spec.key not in self.tied_keys:
                     self.tied_keys[spec.key] = idx
+                else:
+                    owner_attr = self._tied_attr_of[self.tied_keys[spec.key]]
+                    assert spec.tied_weight_attr == owner_attr, (
+                        f"tied key {spec.key!r}: tied_weight_attr "
+                        f"{spec.tied_weight_attr!r} != owner's {owner_attr!r}")
                 self._tied_key_of[idx] = spec.key
+                self._tied_attr_of[idx] = spec.tied_weight_attr
                 if spec.forward_fn is not None:
                     self._forward_fns[idx] = spec.forward_fn
                 self.layers.append(layer)
@@ -149,8 +156,13 @@ class PipelineModule:
     def init(self, rng):
         """Build the parameter pytree: ``{"layers": [...], "tied": {...}}``.
 
-        Tied layers' parameters live once under ``tied/<key>``; their slot in
-        ``layers`` is an empty dict.  With ``seed_layers`` each layer gets a
+        Tied layers share parameters under ``tied/<key>``.  When the
+        layer's params are a dict containing ``tied_weight_attr``
+        (reference ``TiedLayerSpec.tied_weight_attr``, ``module.py:71-83``),
+        only THAT entry is shared — each use site keeps its own remaining
+        params (e.g. an output head's bias alongside the tied embedding
+        matrix); otherwise the whole param tree is shared and non-owner
+        slots are empty.  With ``seed_layers`` each layer gets a
         self-contained seed ``base_seed + idx`` independent of ``rng``
         (optionally mapped through ``seed_fn``), mirroring the reference's
         per-layer RNG seeding (``module.py:225-239``) so layer idx N
@@ -171,9 +183,27 @@ class PipelineModule:
                 continue
             tkey = self._tied_key_of.get(idx)
             if tkey is not None:
+                attr = self._tied_attr_of.get(idx)
                 if self.tied_keys[tkey] == idx:
-                    tied[tkey] = layer.init(key)
-                layer_params.append({})
+                    p = layer.init(key)
+                    # subset mode only when there is anything LEFT to keep
+                    # per-site; a dict of just the attr shares whole (else
+                    # _layer_params would hand apply() a bare array)
+                    subset = (isinstance(p, dict) and attr in p and len(p) > 1)
+                    self._tied_subset_mode = getattr(self, "_tied_subset_mode", {})
+                    self._tied_subset_mode[tkey] = subset
+                    tied[tkey] = p[attr] if subset else p
+                    layer_params.append(
+                        {k: v for k, v in p.items() if k != attr}
+                        if subset else {})
+                elif self._tied_subset_mode.get(tkey):
+                    p = layer.init(key)
+                    layer_params.append({k: v for k, v in p.items()
+                                         if k != attr})
+                else:
+                    # whole-share non-owner: nothing per-site, skip the
+                    # (potentially huge) throwaway init entirely
+                    layer_params.append({})
             else:
                 layer_params.append(layer.init(key))
         return {"layers": tuple(layer_params), "tied": tied}
@@ -186,18 +216,21 @@ class PipelineModule:
         counts = []
         for idx in range(self.num_layers):
             tkey = self._tied_key_of.get(idx)
+            leaves = list(jax.tree_util.tree_leaves(params["layers"][idx]))
             if tkey is not None and self.tied_keys[tkey] == idx:
-                leaves = jax.tree_util.tree_leaves(params["tied"][tkey])
-            else:
-                leaves = jax.tree_util.tree_leaves(params["layers"][idx])
+                leaves += jax.tree_util.tree_leaves(params["tied"][tkey])
             counts.append(int(sum(np.prod(l.shape) for l in leaves)))
         return counts
 
     def _layer_params(self, params, idx):
         tkey = self._tied_key_of.get(idx)
-        if tkey is not None:
-            return params["tied"][tkey]
-        return params["layers"][idx]
+        if tkey is None:
+            return params["layers"][idx]
+        slot = params["layers"][idx]
+        if isinstance(slot, dict) and slot:
+            # subset tying: this layer's own params + the shared attr
+            return {**slot, self._tied_attr_of[idx]: params["tied"][tkey]}
+        return params["tied"][tkey]
 
     # ------------------------------------------------------------------
     # forward
@@ -319,7 +352,9 @@ class PipelineModule:
         partitioning can re-load them (reference ``module.py:536-546``)."""
         os.makedirs(save_dir, exist_ok=True)
         for idx in range(self.num_layers):
-            if not self.has_params(idx) or idx in self._tied_key_of:
+            # tied layers with subset tying keep their own (non-shared)
+            # params in their slot — those save per-layer too
+            if not jax.tree_util.tree_leaves(params["layers"][idx]):
                 continue
             flat = _tree_to_host_dict(params["layers"][idx])
             np.savez(self.ckpt_layer_path(save_dir, idx), **flat)
@@ -332,7 +367,7 @@ class PipelineModule:
         ``module.py:548-567``); returns the new pytree."""
         layer_params = list(params["layers"])
         for idx in range(self.num_layers):
-            if not self.has_params(idx) or idx in self._tied_key_of:
+            if not jax.tree_util.tree_leaves(params["layers"][idx]):
                 continue
             path = self.ckpt_layer_path(load_dir, idx)
             layer_params[idx] = _host_dict_to_tree(

@@ -49,6 +49,10 @@ class TrainingMonitor:
         if self._tb is not None:
             for tag, val in scalars.items():
                 self._tb.add_scalar(tag, float(val), int(step))
+            # writes happen on the (coarse) steps_per_print cadence, so
+            # flush eagerly — a run exiting before SummaryWriter's timed
+            # flush would otherwise lose its tail scalars
+            self._tb.flush()
 
     def flush(self):
         if self._tb is not None:

@@ -185,3 +185,151 @@ def test_pipe_schedule_trace(cpu_devices):
     flat = [c for step in trace for c in step]
     names = {c.name for c in flat}
     assert {"ForwardPass", "BackwardPass", "OptimizerStep"} <= names
+
+
+class Embed:
+    """Embedding layer with bias, for subset weight tying (tied 'table',
+    per-site 'bias')."""
+
+    def __init__(self, vocab, hidden):
+        self.vocab, self.hidden = vocab, hidden
+
+    def init(self, rng):
+        return {"table": jax.random.normal(rng, (self.vocab, self.hidden),
+                                           jnp.float32) * 0.1,
+                "bias": jnp.zeros((self.hidden,), jnp.float32)}
+
+    def apply(self, params, x):
+        return jnp.take(params["table"], x, axis=0) + params["bias"]
+
+
+def _lm_head(params, x):
+    # decode with the TIED embedding table (transposed) + this site's bias
+    return x @ params["table"].T + params["bias"][:1][0]
+
+
+def xent_loss(logits, labels):
+    logits = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    return jnp.mean(lse - gold)
+
+
+def _gpt_like_specs(vocab=32, n_blocks=8):
+    """Embedding -> transformer-ish stack -> tied LM head: the GPT-2 shape
+    at toy size (8 pipeline stages need >= 10 layers)."""
+    return ([TiedLayerSpec("emb", Embed, vocab, HIDDEN,
+                           tied_weight_attr="table")]
+            + [LayerSpec(Linear, HIDDEN, HIDDEN) for _ in range(n_blocks)]
+            + [TiedLayerSpec("emb", Embed, vocab, HIDDEN,
+                             forward_fn=_lm_head, tied_weight_attr="table")])
+
+
+def _token_data(micro_batches, mb_size, vocab=32, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        (rng.integers(0, vocab, size=(mb_size, 4)).astype(np.int32),
+         rng.integers(0, vocab, size=(mb_size, 4)).astype(np.int32))
+        for _ in range(micro_batches)
+    ]
+
+
+def test_gpt_like_8stage_tied_subset_matches_sequential(cpu_devices):
+    """GPT-2-shaped stack (tied embedding/LM-head via tied_weight_attr,
+    per-site bias) on an 8-stage pipeline with per-tick remat: loss parity
+    vs the non-pipelined run, and the tied table is stored once."""
+    micro_batches, mb_size, steps = 8, 8, 3
+    data = _token_data(micro_batches, mb_size)
+
+    mesh1 = make_mesh({"data": 1}, devices=cpu_devices[:1])
+    base_module = PipelineModule(_gpt_like_specs(), loss_fn=xent_loss,
+                                 seed_layers=True,
+                                 partition_method="uniform")
+    base_engine, *_ = deepspeed.initialize(
+        model=base_module, config=_config(mb_size, micro_batches, 1),
+        mesh=mesh1)
+    # tied subset: the table lives once under tied/, biases per slot
+    p = base_engine.module.module.init(jax.random.PRNGKey(0))
+    assert set(p["tied"]) == {"emb"}
+    assert "bias" in p["layers"][0] and "table" not in p["layers"][0]
+    assert "bias" in p["layers"][-1]
+    base_losses = _train(base_engine, data, steps)
+
+    mesh = make_mesh({"pipe": 8, "data": 1}, devices=cpu_devices[:8])
+    module = PipelineModule(_gpt_like_specs(), loss_fn=xent_loss,
+                            seed_layers=True, partition_method="uniform",
+                            activation_checkpoint_interval=1)
+    engine, *_ = deepspeed.initialize(
+        model=module, config=_config(mb_size, micro_batches, 1), mesh=mesh)
+    pipe_losses = _train(engine, data, steps)
+
+    assert np.allclose(base_losses, pipe_losses, rtol=2e-4, atol=2e-5), (
+        f"8-stage tied pipeline {pipe_losses} != sequential {base_losses}")
+    assert pipe_losses[-1] < pipe_losses[0]
+
+
+def test_per_tick_remat_in_program(cpu_devices):
+    """activation_checkpoint_interval puts a remat around every pipeline
+    tick (stored activations = boundary carries only)."""
+    mesh = make_mesh({"pipe": 4, "data": 1}, devices=cpu_devices[:4])
+    for interval, expect_remat in ((0, False), (1, True)):
+        module = PipelineModule(_specs(8), loss_fn=mse_loss,
+                                activation_checkpoint_interval=interval)
+        engine, *_ = deepspeed.initialize(
+            model=module, config=_config(8, 2, 1), mesh=mesh)
+        data = _data(2, 8)
+        batch = engine._stack_micro_batches(iter(data))
+        jx = jax.make_jaxpr(
+            lambda p, b: jax.grad(lambda q: engine._loss_fn(
+                q, b, rng=None, train=True))(p))(
+            engine._module_params,
+            jax.tree_util.tree_map(jnp.asarray, batch))
+        has_remat = "remat2" in str(jx)
+        assert has_remat == expect_remat, (interval, has_remat)
+
+
+class SplitCarry:
+    """Layer whose output is a (tuple) pytree boundary."""
+
+    def __init__(self):
+        pass
+
+    def init(self, rng):
+        return {"w": jnp.eye(HIDDEN)}
+
+    def apply(self, params, x):
+        if isinstance(x, tuple):
+            a, b = x
+            return (jnp.tanh(a @ params["w"]), b + 1.0)
+        return (jnp.tanh(x @ params["w"]), jnp.zeros(x.shape[:1]))
+
+
+class MergeCarry:
+    def init(self, rng):
+        return {"w": jnp.eye(HIDDEN)}
+
+    def apply(self, params, x):
+        a, b = x
+        return a @ params["w"] + b[:, None]
+
+
+def test_pytree_boundary_activations(cpu_devices):
+    """Stage boundaries may carry a pytree (here (hidden, counter));
+    parity vs sequential."""
+    specs = [LayerSpec(SplitCarry), LayerSpec(SplitCarry),
+             LayerSpec(SplitCarry), LayerSpec(MergeCarry)]
+    data = _data(4, 8)
+
+    mesh1 = make_mesh({"data": 1}, devices=cpu_devices[:1])
+    base, *_ = deepspeed.initialize(
+        model=PipelineModule(specs, loss_fn=mse_loss, seed_layers=True),
+        config=_config(8, 4, 1), mesh=mesh1)
+    base_losses = _train(base, data, 2)
+
+    mesh = make_mesh({"pipe": 4, "data": 1}, devices=cpu_devices[:4])
+    eng, *_ = deepspeed.initialize(
+        model=PipelineModule(specs, loss_fn=mse_loss, seed_layers=True),
+        config=_config(8, 4, 1), mesh=mesh)
+    pipe_losses = _train(eng, data, 2)
+    assert np.allclose(base_losses, pipe_losses, rtol=2e-4, atol=2e-5), (
+        f"pytree boundary: {pipe_losses} != {base_losses}")
