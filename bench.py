@@ -3,9 +3,10 @@
 
 Mirrors the reference's headline single-GPU number — BERT-large seq128
 samples/sec (272 samples/s on V100-32GB, ``BASELINE.md``).  Runs the full
-DeepSpeed-TPU engine train step (fwd + bwd + fused Adam) in bf16 with the
-Pallas flash-attention kernel on the available accelerator and prints ONE
-JSON line.
+DeepSpeed-TPU engine train step (fwd + bwd + fused Adam) in bf16 on the
+available accelerator and prints ONE JSON line.  Attention dispatch is the
+engine's memory-aware policy (XLA batched attention at this seq length;
+the Pallas flash kernel takes over when score memory exceeds its budget).
 
 Timing discipline: on this platform ``jax.block_until_ready`` has been
 observed not to fence remote execution, so every timing boundary is a host

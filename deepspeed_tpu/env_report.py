@@ -22,50 +22,24 @@ def _try_version(mod):
 
 def op_report():
     """[(op_name, compatible, detail)] — the reference's per-op
-    compatibility matrix (``env_report.py:23``), re-targeted at the
-    framework's TPU execution paths."""
-    import jax
+    compatibility matrix (``env_report.py:23``), driven by the op registry
+    (``ops/op_builder.py``, the reference's ``ALL_OPS``)."""
+    from .ops.op_builder import ALL_OPS
 
-    backend = jax.default_backend()
-    dev = jax.devices()[0]
-    on_tpu = backend == "tpu"
-
-    def has_memory(kind):
-        try:
-            dev.memory(kind)
-            return True
-        except Exception:
-            return False
-
-    pallas_ok = True
-    try:
-        from jax.experimental import pallas  # noqa: F401
-    except Exception:
-        pallas_ok = False
+    rows = []
+    for name, builder in ALL_OPS.items():
+        ok, detail = builder.compatibility()
+        rows.append((name, ok, detail))
 
     tb_ok = _try_version("torch") is not None
-    try:
-        from torch.utils import tensorboard  # noqa: F401
-    except Exception:
-        tb_ok = False
-
-    pinned = has_memory("pinned_host")
-    rows = [
-        ("fused_adam", True, "flat-space XLA elementwise (always available)"),
-        ("fused_lamb", True, "flat-space XLA + segment reductions"),
-        ("flash_attention", pallas_ok and on_tpu,
-         "Pallas kernel; compiled on TPU, interpret-mode elsewhere"),
-        ("sparse_attention", True, "static-layout XLA gather compute"),
-        ("ring_attention", True, "shard_map ppermute over the seq axis"),
-        ("onebit_adam", True, "packed-sign collectives over the data axis"),
-        ("cpu_adam (ZeRO-Offload)", pinned,
-         "pinned_host memory space" + ("" if pinned else " MISSING")),
-        ("activation_offload", pinned and on_tpu,
-         "remat policy offload needs in-jit memory placement (TPU)"),
-        ("transformer (bf16)", True, "XLA-fused reference layers"),
-        ("tensorboard monitor", tb_ok,
-         "torch.utils.tensorboard" + ("" if tb_ok else " MISSING — JSONL only")),
-    ]
+    if tb_ok:
+        try:
+            from torch.utils import tensorboard  # noqa: F401
+        except Exception:
+            tb_ok = False
+    rows.append(("tensorboard monitor", tb_ok,
+                 "torch.utils.tensorboard"
+                 + ("" if tb_ok else " MISSING — JSONL only")))
     return rows
 
 

@@ -1,0 +1,144 @@
+"""Op registry — the TPU analog of the reference's ``op_builder`` package.
+
+The reference (``op_builder/builder.py:78-260``) compiles CUDA extensions
+ahead-of-time or JIT (ninja), with per-op compatibility checks against the
+local torch/CUDA install, and a registry ``ALL_OPS`` consumed by setup.py
+and ``ds_report``.  Under JAX there is nothing to compile at install time —
+"ops" are jitted XLA programs and Pallas kernels compiled on first trace —
+so a builder here is a *capability probe + loader*: ``is_compatible()``
+answers whether this platform can run the op's fast path, and ``load()``
+returns the op's entry point (triggering any lazy imports), mirroring the
+reference's ``OpBuilder.load()`` contract.
+"""
+
+import importlib
+
+
+class OpBuilder:
+    """Base op record (reference ``op_builder/builder.py:78``)."""
+
+    NAME = "op"
+    MODULE = None       # dotted path relative to deepspeed_tpu
+    ENTRY = None        # attribute to return from load()
+
+    def absolute_name(self):
+        return f"deepspeed_tpu.{self.MODULE}"
+
+    def is_compatible(self):
+        ok, _ = self.compatibility()
+        return ok
+
+    def compatibility(self):
+        """(ok, detail) — platform-dependent checks live in subclasses."""
+        return True, "pure-XLA op (always available)"
+
+    def load(self):
+        """Import and return the op entry point (the reference's JIT-load;
+        here the compile happens lazily on first trace)."""
+        mod = importlib.import_module(self.absolute_name())
+        return getattr(mod, self.ENTRY) if self.ENTRY else mod
+
+
+def _backend():
+    import jax
+
+    return jax.default_backend()
+
+
+def _has_memory(kind):
+    import jax
+
+    try:
+        jax.devices()[0].memory(kind)
+        return True
+    except Exception:
+        return False
+
+
+class FusedAdamBuilder(OpBuilder):
+    NAME = "fused_adam"
+    MODULE = "ops.adam.fused_adam"
+    ENTRY = "FusedAdam"
+
+
+class FusedLambBuilder(OpBuilder):
+    NAME = "fused_lamb"
+    MODULE = "ops.lamb.fused_lamb"
+    ENTRY = "FusedLamb"
+
+
+class FlashAttentionBuilder(OpBuilder):
+    NAME = "flash_attention"
+    MODULE = "ops.transformer.flash_attention"
+    ENTRY = "flash_attention"
+
+    def compatibility(self):
+        try:
+            from jax.experimental.pallas import tpu  # noqa: F401
+        except Exception:
+            return False, "Pallas TPU backend not importable"
+        if _backend() != "tpu":
+            return False, "compiled Mosaic kernels need a TPU (interpret mode elsewhere)"
+        return True, "Pallas kernel; engaged when score memory exceeds budget"
+
+
+class SparseAttentionBuilder(OpBuilder):
+    NAME = "sparse_attention"
+    MODULE = "ops.sparse_attention"
+    ENTRY = "block_sparse_attention"
+
+
+class RingAttentionBuilder(OpBuilder):
+    NAME = "ring_attention"
+    MODULE = "ops.transformer.ring_attention"
+    ENTRY = "ring_attention"
+
+
+class OnebitAdamBuilder(OpBuilder):
+    NAME = "onebit_adam"
+    MODULE = "runtime.fp16.onebit_adam"
+    ENTRY = "OnebitAdam"
+
+
+class CPUAdamBuilder(OpBuilder):
+    """ZeRO-Offload's host-resident optimizer state (the reference's
+    AVX ``cpu_adam``; here a memory-space capability)."""
+
+    NAME = "cpu_adam"
+    MODULE = "runtime.zero.coordinator"
+    ENTRY = "FlatParamCoordinator"
+
+    def compatibility(self):
+        if not _has_memory("pinned_host"):
+            return False, "no pinned_host memory space on this backend"
+        return True, "pinned_host master/optimizer state"
+
+
+class ActivationOffloadBuilder(OpBuilder):
+    NAME = "activation_offload"
+    MODULE = "runtime.activation_checkpointing.checkpointing"
+    ENTRY = "make_remat_policy"
+
+    def compatibility(self):
+        if not _has_memory("pinned_host"):
+            return False, "no pinned_host memory space"
+        if _backend() != "tpu":
+            return False, "remat offload needs in-jit memory placement (TPU)"
+        return True, "save_and_offload remat policy"
+
+
+class TransformerBuilder(OpBuilder):
+    NAME = "transformer"
+    MODULE = "models.layers"
+    ENTRY = "TransformerLayer"
+
+
+ALL_OPS = {b.NAME: b for b in (
+    FusedAdamBuilder(), FusedLambBuilder(), FlashAttentionBuilder(),
+    SparseAttentionBuilder(), RingAttentionBuilder(), OnebitAdamBuilder(),
+    CPUAdamBuilder(), ActivationOffloadBuilder(), TransformerBuilder(),
+)}
+
+
+def get_op_builder(name):
+    return ALL_OPS[name]

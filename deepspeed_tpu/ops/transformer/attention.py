@@ -11,16 +11,45 @@ bandwidth story on TPU.
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
 
+# When the fp32 score tensor would exceed this, attention goes blockwise
+# (Pallas flash) regardless of speed: measured on v5e, XLA's batched
+# attention beats the flash kernel at every length that FITS (seq 128:
+# 416 vs 344 samples/s end-to-end on BERT-large), so the kernel's job is
+# the memory ceiling, not throughput.  DS_FLASH_ATTENTION=always|never|auto
+# overrides.
+PALLAS_MIN_SCORE_BYTES = 2 * 1024 ** 3
+
 
 def _use_pallas(q, k):
     try:
-        return (jax.default_backend() == "tpu" and q.shape[1] >= 128
-                and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0
-                and q.shape[-1] % 64 == 0)
+        mode = os.environ.get("DS_FLASH_ATTENTION", "auto")
+        shapes_ok = (jax.default_backend() == "tpu" and q.shape[1] >= 128
+                     and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0
+                     and q.shape[-1] % 64 == 0)
+        if mode == "never":
+            return False
+        if mode == "always":
+            return shapes_ok
+        b, sq, h, _ = q.shape
+        score_bytes = 4 * b * h * sq * k.shape[1]
+        # shapes here are logical/global; under data-parallel GSPMD each
+        # chip materializes 1/dp of the batch — budget the PER-DEVICE size
+        try:
+            from ...parallel.mesh import get_current_mesh
+
+            mesh = get_current_mesh()
+            if mesh is not None:
+                dp = dict(zip(mesh.axis_names, mesh.devices.shape)).get(
+                    "data", 1)
+                score_bytes //= max(dp, 1)
+        except Exception:
+            pass
+        return shapes_ok and score_bytes > PALLAS_MIN_SCORE_BYTES
     except Exception:
         return False
 

@@ -141,8 +141,11 @@ class _PipelinedModel:
                 layer_kw = {"deterministic": not train}
                 if tick_rng is not None:
                     layer_kw["rng"] = tick_rng
+                # interval=0: the engine remats whole ticks (below);
+                # nesting apply_range's per-chunk remat inside would
+                # recompute the forward twice in backward
                 y = module.apply_range(params, parts[s], parts[s + 1], x,
-                                       **layer_kw)
+                                       interval=0, **layer_kw)
                 if last:
                     loss = module.loss_fn(y, mb_labels)
                     loss = jnp.where(valid, loss.astype(jnp.float32), 0.0)

@@ -198,11 +198,30 @@ class PipelineModule:
                         if subset else {})
                 elif self._tied_subset_mode.get(tkey):
                     p = layer.init(key)
+                    assert isinstance(p, dict) and attr in p, (
+                        f"tied key {tkey!r} (subset mode, attr {attr!r}): "
+                        f"use-site layer {idx} init() must return a dict "
+                        f"containing {attr!r}, got {type(p).__name__}")
                     layer_params.append({k: v for k, v in p.items()
                                          if k != attr})
                 else:
                     # whole-share non-owner: nothing per-site, skip the
-                    # (potentially huge) throwaway init entirely
+                    # (potentially huge) throwaway init — but validate
+                    # abstractly that this site's params match the shared
+                    # tree (a site needing per-site params tied to a
+                    # bare-weight owner would otherwise KeyError deep in
+                    # tracing)
+                    shape_here = jax.eval_shape(layer.init, key)
+                    shape_owner = jax.tree_util.tree_map(
+                        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                        tied[tkey])
+                    assert (jax.tree_util.tree_structure(shape_here)
+                            == jax.tree_util.tree_structure(shape_owner)), (
+                        f"tied key {tkey!r}: use-site layer {idx}'s param "
+                        f"structure {jax.tree_util.tree_structure(shape_here)} "
+                        f"!= owner's {jax.tree_util.tree_structure(shape_owner)}"
+                        f" — whole-tree sharing requires identical structure "
+                        f"(or give the owner per-site params for subset mode)")
                     layer_params.append({})
             else:
                 layer_params.append(layer.init(key))
@@ -271,11 +290,14 @@ class PipelineModule:
             return layer.apply(self._layer_params(params, idx), x, **kw)
         return layer(x, **kw)
 
-    def apply_range(self, params, start, stop, x, **kw):
+    def apply_range(self, params, start, stop, x, interval=None, **kw):
         """Apply layers [start, stop), rematerializing every
         ``activation_checkpoint_interval`` layers (reference
-        ``module.py:292-346``)."""
-        interval = self.activation_checkpoint_interval
+        ``module.py:292-346``).  ``interval=0`` disables the per-chunk
+        remat (the pipeline engine does this when it checkpoints whole
+        ticks — nesting both would recompute twice)."""
+        interval = (self.activation_checkpoint_interval if interval is None
+                    else interval)
         if interval <= 0:
             for idx in range(start, stop):
                 x = self.apply_layer(params, idx, x, **kw)

@@ -61,3 +61,18 @@ def test_op_report_shape():
     assert "fused_adam" in names and "flash_attention" in names
     for name, ok, detail in rows:
         assert isinstance(ok, (bool, np.bool_)) and isinstance(detail, str)
+
+
+def test_op_builder_registry():
+    """Every registered op loads its entry point, and compatibility checks
+    run without error (reference ALL_OPS / OpBuilder.load contract)."""
+    from deepspeed_tpu.ops.op_builder import ALL_OPS, get_op_builder
+
+    assert {"fused_adam", "flash_attention", "cpu_adam",
+            "onebit_adam"} <= set(ALL_OPS)
+    for name, builder in ALL_OPS.items():
+        ok, detail = builder.compatibility()
+        assert isinstance(detail, str)
+        entry = builder.load()
+        assert entry is not None, name
+    assert get_op_builder("fused_adam").load().__name__ == "FusedAdam"
