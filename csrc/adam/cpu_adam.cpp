@@ -1,0 +1,31 @@
+// Host-resident Adam(W) kernel — the native component analog of the
+// reference's csrc/adam/cpu_adam.cpp:21-682 (AVX512/AVX2 tiled, OpenMP).
+// Vectorization is delegated to the compiler (-O3 -march=native auto-
+// vectorizes the fused loop; the reference hand-writes SIMD_* intrinsics
+// for the same arithmetic), parallelism to OpenMP like the reference's
+// parallel_for. Exposed with a C ABI for ctypes; invoked from inside
+// jitted programs via jax.pure_callback (ops/adam/cpu_adam.py).
+
+#include <cmath>
+#include <cstdint>
+
+extern "C" void ds_adam_step(
+    float* p_out, float* m_out, float* v_out,
+    const float* p, const float* m, const float* v, const float* g,
+    long long n, float lr, float beta1, float beta2, float eps,
+    float weight_decay, float bc1, float bc2, int adamw) {
+#pragma omp parallel for schedule(static)
+  for (long long i = 0; i < n; ++i) {
+    float gi = g[i];
+    float pi = p[i];
+    if (!adamw) gi += weight_decay * pi;  // L2 mode: decay folded into grad
+    float mi = beta1 * m[i] + (1.0f - beta1) * gi;
+    float vi = beta2 * v[i] + (1.0f - beta2) * gi * gi;
+    float denom = sqrtf(vi / bc2) + eps;
+    float upd = (mi / bc1) / denom;
+    if (adamw) upd += weight_decay * pi;  // AdamW: decoupled decay
+    p_out[i] = pi - lr * upd;
+    m_out[i] = mi;
+    v_out[i] = vi;
+  }
+}

@@ -78,7 +78,9 @@ class TransformerLayer:
     def __init__(self, hidden_size, heads, intermediate_size=None, causal=False,
                  attn_dropout_ratio=0.1, hidden_dropout_ratio=0.1,
                  pre_layer_norm=False, initializer_range=0.02, layer_norm_eps=1e-12,
-                 attn_impl="auto", sparsity_config=None):
+                 attn_impl="auto", sparsity_config=None,
+                 gelu_checkpoint=False, attn_dropout_checkpoint=False,
+                 normalize_invertible=False):
         assert hidden_size % heads == 0
         self.hidden_size = hidden_size
         self.heads = heads
@@ -90,6 +92,14 @@ class TransformerLayer:
         self.pre_layer_norm = pre_layer_norm
         self.initializer_range = initializer_range
         self.layer_norm_eps = layer_norm_eps
+        # memory knobs mirroring DeepSpeedTransformerConfig (reference
+        # ops/transformer/transformer.py:109-137): each drops a class of
+        # saved activations and recomputes it in backward — here expressed
+        # as jax.checkpoint around the corresponding sub-block (the
+        # reference frees the buffer and replays the kernel)
+        self.gelu_checkpoint = gelu_checkpoint
+        self.attn_dropout_checkpoint = attn_dropout_checkpoint
+        self.normalize_invertible = normalize_invertible
         # attention core selection:
         #   'auto'   — flash kernel on TPU / jnp reference elsewhere
         #   'ring'   — sequence-parallel ring attention over the 'seq' mesh
@@ -199,16 +209,28 @@ class TransformerLayer:
             z = dense(params["fc2"], z)
             return dropout(r3, z, self.hidden_dropout_ratio, deterministic)
 
+        if self.attn_dropout_checkpoint:
+            # don't save attention internals (probs/dropout mask);
+            # recompute in backward (reference attn_dropout_checkpoint)
+            attention_block = jax.checkpoint(attention_block)
+        if self.gelu_checkpoint:
+            # recompute gelu/fc1 intermediates (reference gelu_checkpoint)
+            mlp_block = jax.checkpoint(mlp_block)
+
+        def ln(p, y):
+            return layer_norm(p, y, self.layer_norm_eps)
+
+        if self.normalize_invertible:
+            # don't save layernorm inputs (reference normalize_invertible
+            # re-derives them; recompute is the XLA-friendly equivalent)
+            ln = jax.checkpoint(ln)
+
         if self.pre_layer_norm:
-            x = x + attention_block(params, layer_norm(params["ln_attn"], x,
-                                                       self.layer_norm_eps))
-            x = x + mlp_block(params, layer_norm(params["ln_mlp"], x,
-                                                 self.layer_norm_eps))
+            x = x + attention_block(params, ln(params["ln_attn"], x))
+            x = x + mlp_block(params, ln(params["ln_mlp"], x))
         else:
-            x = layer_norm(params["ln_attn"], x + attention_block(params, x),
-                           self.layer_norm_eps)
-            x = layer_norm(params["ln_mlp"], x + mlp_block(params, x),
-                           self.layer_norm_eps)
+            x = ln(params["ln_attn"], x + attention_block(params, x))
+            x = ln(params["ln_mlp"], x + mlp_block(params, x))
         return x
 
 

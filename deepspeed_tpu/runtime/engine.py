@@ -474,6 +474,13 @@ class DeepSpeedEngine:
         if name in (C.ADAM_OPTIMIZER, "adamw"):
             return FusedAdam(adam_w_mode=(name == "adamw" or params.pop("adam_w_mode", True)),
                              **params)
+        if name in ("cpuadam", "cpu_adam", "deepspeedcpuadam"):
+            from ..ops.adam.cpu_adam import DeepSpeedCPUAdam
+
+            shard_axis = "data" if (self.zero_stage >= 1
+                                    and self.dp_world_size > 1) else None
+            return DeepSpeedCPUAdam(shard_axis=shard_axis, mesh=self.mesh,
+                                    **params)
         if name == C.LAMB_OPTIMIZER:
             return FusedLamb(**params)
         if name == C.ONEBIT_ADAM_OPTIMIZER:

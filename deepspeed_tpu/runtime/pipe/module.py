@@ -215,12 +215,16 @@ class PipelineModule:
                     shape_owner = jax.tree_util.tree_map(
                         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
                         tied[tkey])
-                    assert (jax.tree_util.tree_structure(shape_here)
-                            == jax.tree_util.tree_structure(shape_owner)), (
-                        f"tied key {tkey!r}: use-site layer {idx}'s param "
-                        f"structure {jax.tree_util.tree_structure(shape_here)} "
-                        f"!= owner's {jax.tree_util.tree_structure(shape_owner)}"
-                        f" — whole-tree sharing requires identical structure "
+                    same = (jax.tree_util.tree_structure(shape_here)
+                            == jax.tree_util.tree_structure(shape_owner)
+                            and all(a.shape == b.shape and a.dtype == b.dtype
+                                    for a, b in zip(
+                                        jax.tree_util.tree_leaves(shape_here),
+                                        jax.tree_util.tree_leaves(shape_owner))))
+                    assert same, (
+                        f"tied key {tkey!r}: use-site layer {idx}'s params "
+                        f"{shape_here} != owner's {shape_owner} — whole-tree "
+                        f"sharing requires identical structure AND shapes "
                         f"(or give the owner per-site params for subset mode)")
                     layer_params.append({})
             else:

@@ -110,3 +110,34 @@ def test_gpt2_eval_logits(cpu_devices):
     rng = np.random.default_rng(0)
     logits = engine.eval_batch(gpt2_batch(rng, 4))
     assert logits.shape == (4, SEQ, VOCAB)
+
+
+def test_transformer_memory_knobs():
+    """DeepSpeedTransformerConfig memory knobs (reference
+    transformer.py:109-137): each adds a remat region without changing
+    numerics."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models.layers import TransformerLayer
+
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 16, 32)),
+                    jnp.float32)
+
+    def run(**knobs):
+        layer = TransformerLayer(32, 4, attn_dropout_ratio=0.0,
+                                 hidden_dropout_ratio=0.0, **knobs)
+        params = layer.init(jax.random.PRNGKey(0))
+        out = layer.apply(params, x, deterministic=True)
+        jx = jax.make_jaxpr(jax.grad(
+            lambda p: layer.apply(p, x, deterministic=True)
+            .astype(jnp.float32).sum()))(params)
+        return np.asarray(out), str(jx).count("remat2")
+
+    base_out, base_remats = run()
+    assert base_remats == 0
+    for knob in ("gelu_checkpoint", "attn_dropout_checkpoint",
+                 "normalize_invertible"):
+        out, remats = run(**{knob: True})
+        assert remats > 0, knob
+        np.testing.assert_allclose(out, base_out, rtol=1e-6, err_msg=knob)
