@@ -76,7 +76,7 @@ def main():
     from deepspeed_tpu.models import BertConfig, BertForPreTrainingTPU
     from deepspeed_tpu.parallel import make_mesh
 
-    batch = int(os.environ.get("BENCH_BATCH", "128"))
+    batch = int(os.environ.get("BENCH_BATCH", "112"))
     steps = int(os.environ.get("BENCH_STEPS", "30"))
     warmup = int(os.environ.get("BENCH_WARMUP", "5"))
 
