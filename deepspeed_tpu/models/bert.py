@@ -16,7 +16,6 @@ Batch contract for pretraining (``BertForPreTrainingTPU``):
 batch layout.
 """
 
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -19,7 +19,6 @@ CUDA ``DeepSpeedTransformerLayer`` plays in the reference
   ``ops/transformer/transformer.py:39-154``).
 """
 
-import math
 from typing import Any, Dict, Optional
 
 import jax

@@ -21,7 +21,6 @@ Numerics: our layer is post-LayerNorm with tanh-GELU, matching HF's
 activation (<1e-3 in bf16).
 """
 
-import jax
 import jax.numpy as jnp
 
 

@@ -9,7 +9,6 @@ style), which is both the memory story (long sequences) and the HBM-
 bandwidth story on TPU.
 """
 
-import functools
 import math
 import os
 

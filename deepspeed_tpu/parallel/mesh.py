@@ -12,7 +12,7 @@ Canonical axis names (outermost → innermost): ``pipe``, ``data``, ``seq``,
 stages.  Any axis of size 1 can be omitted from the mesh.
 """
 
-from typing import Optional, Sequence
+from typing import Optional
 
 import numpy as np
 

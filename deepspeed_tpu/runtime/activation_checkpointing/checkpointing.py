@@ -27,8 +27,7 @@ models use.
 
 import jax
 
-from ...utils.logging import logger
-from .config import (ACT_CHKPT_DEFAULT, DeepSpeedActivationCheckpointingConfig)
+from .config import DeepSpeedActivationCheckpointingConfig
 
 _CKPT_NAME = "ds_act_ckpt_input"
 

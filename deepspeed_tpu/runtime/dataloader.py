@@ -12,8 +12,6 @@ its ``jax.process_index()``-th slice of every global batch
 with ``jax.make_array_from_process_local_data``.
 """
 
-import itertools
-
 import numpy as np
 
 from ..utils.logging import logger

@@ -43,7 +43,6 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..ops.adam.fused_adam import FusedAdam
 from ..ops.lamb.fused_lamb import FusedLamb
-from ..ops.op_common import build_segments
 from ..parallel.mesh import DATA_AXIS, MeshGrid, make_mesh, set_current_mesh
 from ..utils.distributed import init_distributed
 from ..utils.logging import log_dist, logger
@@ -54,7 +53,7 @@ from .dataloader import DeepSpeedDataLoader, RepeatingLoader
 from .fp16.loss_scaler import DynamicScaleState, update_scale_state
 from .lr_schedules import SCHEDULE_CLASSES
 from .progressive_layer_drop import ProgressiveLayerDrop
-from .utils import flatten_tree, tree_path_key, unflatten_like
+from .utils import tree_path_key
 
 def _pack_batches(micro_batches):
     """Stack ``grad_acc`` micro-batch pytrees and pack all leaves into ONE

@@ -39,7 +39,6 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ...ops.op_common import LANES, build_segments
-from ...utils.logging import logger
 
 
 class FlatParamCoordinator:
