@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""BERT pretraining example (the reference's bing_bert flow, TPU-native).
+
+Synthetic data; swap ``synthetic_dataset`` for your tokenized corpus.
+
+Single host:   python examples/bert_pretraining.py --steps 50
+Multi host:    bin/deepspeed --hostfile H examples/bert_pretraining.py
+ZeRO/offload/remat are plain config edits below (docs/config.md).
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import deepspeed_tpu as deepspeed  # noqa: E402
+from deepspeed_tpu.models import BertConfig, BertForPreTrainingTPU  # noqa: E402
+from deepspeed_tpu.parallel import make_mesh  # noqa: E402
+
+
+def synthetic_dataset(n, seq, vocab, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        ids = rng.integers(0, vocab, size=(seq,)).astype(np.int32)
+        out.append({
+            "input_ids": ids,
+            "attention_mask": np.ones((seq,), np.int32),
+            "token_type_ids": np.zeros((seq,), np.int32),
+            "masked_lm_labels": np.where(rng.random(seq) < 0.15, ids,
+                                         -100).astype(np.int32),
+            "next_sentence_labels": np.int32(rng.integers(0, 2)),
+        })
+    return out
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=50)
+    parser.add_argument("--batch", type=int, default=32)
+    parser.add_argument("--seq", type=int, default=128)
+    parser.add_argument("--model", choices=["tiny", "base", "large"],
+                        default="large")
+    parser.add_argument("--zero", type=int, default=0)
+    parser.add_argument("--data_parallel", type=int, default=-1)
+    parser.add_argument("--ckpt_dir", type=str, default="")
+    deepspeed.add_config_arguments(parser)
+    args = parser.parse_args()
+
+    # --deepspeed_config, if given, wins over the inline dict below
+    config = None if getattr(args, "deepspeed_config", None) else {
+        "train_batch_size": args.batch,
+        "steps_per_print": 10,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-4,
+                                                 "weight_decay": 0.01}},
+        "scheduler": {"type": "WarmupLR",
+                      "params": {"warmup_num_steps": 100}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": args.zero},
+        "gradient_clipping": 1.0,
+    }
+
+    if args.model == "tiny":
+        bert_cfg = BertConfig(vocab_size=1024, hidden_size=128,
+                              num_hidden_layers=2, num_attention_heads=4,
+                              max_position_embeddings=max(args.seq, 128))
+    elif args.model == "base":
+        bert_cfg = BertConfig.bert_base()
+    else:
+        bert_cfg = BertConfig.bert_large()
+
+    mesh = make_mesh({"data": args.data_parallel})
+    model = BertForPreTrainingTPU(bert_cfg)
+    dataset = synthetic_dataset(args.batch * 4, args.seq, bert_cfg.vocab_size)
+    engine, _, loader, _ = deepspeed.initialize(
+        args=args, model=model, config=config, mesh=mesh,
+        training_data=dataset)
+
+    for step in range(args.steps):
+        loss = engine.train_batch()
+    print(f"final loss: {float(np.asarray(loss)):.4f}")
+    if args.ckpt_dir:
+        engine.save_checkpoint(args.ckpt_dir)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""GPT-2 with hybrid pipeline x data parallelism (the reference's
+Megatron+pipeline tutorial flow, compiled-SPMD style).
+
+Builds the LM as a PipelineModule (tied embedding/LM head via
+TiedLayerSpec) and trains on a pipe x data mesh with per-tick
+rematerialization.  Synthetic tokens; single- or multi-host via
+bin/deepspeed.
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+import deepspeed_tpu as deepspeed  # noqa: E402
+from deepspeed_tpu.models.layers import (TransformerLayer,  # noqa: E402
+                                         cross_entropy_with_logits,
+                                         embedding_init, layer_norm)
+from deepspeed_tpu.parallel import make_mesh  # noqa: E402
+from deepspeed_tpu.runtime.pipe import (LayerSpec, PipelineModule,  # noqa: E402
+                                        TiedLayerSpec)
+
+
+class Embedding:
+    def __init__(self, vocab, hidden, max_pos):
+        self.vocab, self.hidden, self.max_pos = vocab, hidden, max_pos
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        return {"wte": embedding_init(k1, self.vocab, self.hidden),
+                "wpe": embedding_init(k2, self.max_pos, self.hidden)}
+
+    def apply(self, params, ids):
+        s = ids.shape[1]
+        return jnp.take(params["wte"], ids, axis=0) + params["wpe"][None, :s]
+
+
+def lm_head(params, x):
+    # decode with the TIED token embedding (wte), transposed
+    return x @ params["wte"].T.astype(x.dtype)
+
+
+class FinalNorm:
+    def init(self, rng):
+        return {"scale": jnp.ones((HIDDEN,), jnp.float32),
+                "bias": jnp.zeros((HIDDEN,), jnp.float32)}
+
+    def apply(self, params, x):
+        return layer_norm(params, x)
+
+
+HIDDEN = 256
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=30)
+    parser.add_argument("--pipe", type=int, default=2)
+    parser.add_argument("--data", type=int, default=2)
+    parser.add_argument("--layers", type=int, default=8)
+    parser.add_argument("--micro_batch", type=int, default=4)
+    parser.add_argument("--grad_acc", type=int, default=4)
+    parser.add_argument("--seq", type=int, default=64)
+    parser.add_argument("--vocab", type=int, default=1024)
+    args = parser.parse_args()
+
+    specs = (
+        [TiedLayerSpec("embed", Embedding, args.vocab, HIDDEN, args.seq,
+                       tied_weight_attr="wte")]
+        + [LayerSpec(TransformerLayer, HIDDEN, 8, causal=True,
+                     attn_dropout_ratio=0.0, hidden_dropout_ratio=0.0,
+                     pre_layer_norm=True) for _ in range(args.layers)]
+        + [LayerSpec(FinalNorm),
+           TiedLayerSpec("embed", Embedding, args.vocab, HIDDEN, args.seq,
+                         forward_fn=lm_head, tied_weight_attr="wte")]
+    )
+
+    def loss_fn(logits, labels):
+        return cross_entropy_with_logits(logits, labels)
+
+    module = PipelineModule(specs, loss_fn=loss_fn, seed_layers=True,
+                            partition_method="uniform",
+                            activation_checkpoint_interval=1)
+    config = {
+        "train_micro_batch_size_per_gpu": args.micro_batch,
+        "gradient_accumulation_steps": args.grad_acc,
+        "steps_per_print": 10,
+        "optimizer": {"type": "Adam", "params": {"lr": 3e-4}},
+    }
+    mesh = make_mesh({"pipe": args.pipe, "data": args.data})
+    engine, *_ = deepspeed.initialize(model=module, config=config, mesh=mesh)
+
+    rng = np.random.default_rng(0)
+    bs = args.micro_batch * args.data
+
+    def batches():
+        while True:
+            ids = rng.integers(0, args.vocab,
+                               size=(bs, args.seq + 1)).astype(np.int32)
+            yield ids[:, :-1], ids[:, 1:]
+
+    it = batches()
+    for step in range(args.steps):
+        loss = engine.train_batch(it)
+    print(f"final loss: {float(np.asarray(jax.device_get(loss))):.4f}")
+
+
+if __name__ == "__main__":
+    main()
