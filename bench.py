@@ -79,6 +79,10 @@ def main():
     batch = int(os.environ.get("BENCH_BATCH", "112"))
     steps = int(os.environ.get("BENCH_STEPS", "30"))
     warmup = int(os.environ.get("BENCH_WARMUP", "5"))
+    # The reference's 272 samples/s is real pretraining — dropout 0.1 on.
+    # Benchmark the same workload (rbg PRNG + byte-mask dropout keep the
+    # cost ~7%); BENCH_DROPOUT=0 ablates.
+    dropout_p = float(os.environ.get("BENCH_DROPOUT", "0.1"))
 
     dev = jax.devices()[0]
     mesh = make_mesh({"data": 1}, devices=[dev])
@@ -90,8 +94,8 @@ def main():
         "bf16": {"enabled": True},
     }
     bert_cfg = BertConfig.bert_large(max_position_embeddings=512, vocab_size=VOCAB,
-                                     hidden_dropout_prob=0.0,
-                                     attention_probs_dropout_prob=0.0)
+                                     hidden_dropout_prob=dropout_p,
+                                     attention_probs_dropout_prob=dropout_p)
     model = BertForPreTrainingTPU(bert_cfg, compute_dtype=None)
     engine, *_ = deepspeed.initialize(model=model, config=config, mesh=mesh)
 
@@ -149,6 +153,7 @@ def main():
         "chip_peak_tflops": peak,
         "loss": round(final_loss, 4),
         "batch": batch,
+        "dropout": dropout_p,
         "device": getattr(dev, "device_kind", str(dev)),
     }))
 

@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ..ops.op_common import random_keep
 from ..ops.transformer.attention import (dot_product_attention,
                                          key_padding_to_additive)
 
@@ -59,10 +60,10 @@ def gelu(x):
 
 
 def dropout(rng, x, rate, deterministic):
-    if deterministic or rate == 0.0:
+    if deterministic or rate < 1.0 / 512.0 or rng is None:
         return x
-    keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
-    return jnp.where(keep, x / (1.0 - rate), jnp.zeros_like(x))
+    keep, scale = random_keep(rng, x.shape, rate)
+    return jnp.where(keep, x * jnp.asarray(scale, x.dtype), jnp.zeros_like(x))
 
 
 class TransformerLayer:

@@ -15,6 +15,8 @@ import os
 import jax
 import jax.numpy as jnp
 
+from ..op_common import random_keep
+
 # When the fp32 score tensor would exceed this, attention goes blockwise
 # (Pallas flash) regardless of speed: measured on v5e, XLA's batched
 # attention beats the flash kernel at every length that FITS (seq 128:
@@ -69,11 +71,15 @@ def reference_attention(q, k, v, mask=None, causal=False, dropout_rate=0.0,
         scores = jnp.where(causal_mask[None, None], scores, jnp.float32(-1e9))
     if mask is not None:
         scores = scores + mask.astype(jnp.float32)
-    probs = jax.nn.softmax(scores, axis=-1)
-    if not deterministic and dropout_rate > 0.0 and dropout_rng is not None:
-        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)
-        probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
-    ctx = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    if (not deterministic and dropout_rate >= 1.0 / 512.0
+            and dropout_rng is not None):
+        # one random byte per element in compute dtype (the reference kernel
+        # likewise drops the fp16 softmax output, dropout_kernels.cu); rates
+        # below the 1/256 quantum pass through, matching layers.dropout
+        keep, scale = random_keep(dropout_rng, probs.shape, dropout_rate)
+        probs = jnp.where(keep, probs * jnp.asarray(scale, probs.dtype), 0.0)
+    ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
     return ctx
 
 

@@ -56,3 +56,65 @@ def test_has_overflow():
     bad = {"w": jnp.array([1.0, float("inf")])}
     assert not bool(ds_utils.has_overflow(ok))
     assert bool(ds_utils.has_overflow(bad))
+
+
+def test_random_keep_mask_statistics():
+    """Byte-mask dropout: keep rate matches the 1/256-quantized request and
+    the scale makes it exactly unbiased (E[keep * scale] == 1)."""
+    import jax
+
+    from deepspeed_tpu.ops.op_common import random_keep
+
+    rng = jax.random.PRNGKey(7)
+    for rate in (0.1, 0.5, 0.015625):
+        keep, scale = random_keep(rng, (1 << 16,), rate)
+        thresh = round(rate * 256.0)
+        expect_keep = (256 - thresh) / 256.0
+        assert abs(scale * expect_keep - 1.0) < 1e-9
+        got = float(jnp.mean(keep.astype(jnp.float32)))
+        assert abs(got - expect_keep) < 0.01, (rate, got, expect_keep)
+    # degenerate rates clamp instead of crashing
+    for rate in (1e-4, 0.9999):
+        keep, scale = random_keep(rng, (128,), rate)
+        assert np.isfinite(scale)
+
+
+def test_dropout_passthrough_and_scaling():
+    import jax
+
+    from deepspeed_tpu.models.layers import dropout
+
+    rng = jax.random.PRNGKey(0)
+    x = jnp.ones((4096,), jnp.float32)
+    assert dropout(rng, x, 0.5, deterministic=True) is x
+    assert dropout(rng, x, 0.0, deterministic=False) is x
+    assert dropout(None, x, 0.5, deterministic=False) is x
+    y = dropout(rng, x, 0.5, deterministic=False)
+    kept = np.asarray(y) > 0
+    # inverted dropout: survivors scaled by 1/keep_prob (=2.0 at rate 0.5)
+    assert np.allclose(np.asarray(y)[kept], 2.0)
+    assert abs(kept.mean() - 0.5) < 0.05
+
+
+def test_engine_prng_impl_config():
+    """prng_impl=auto resolves per-backend; explicit values are honored."""
+    import jax
+
+    import deepspeed_tpu as deepspeed
+    from deepspeed_tpu.models import BertConfig, BertForPreTrainingTPU
+    from deepspeed_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"data": 1}, devices=jax.devices("cpu")[:1])
+    config = {"train_batch_size": 2, "steps_per_print": 10 ** 9,
+              "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+              "prng_impl": "rbg"}
+    model = BertForPreTrainingTPU(BertConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=2, max_position_embeddings=32))
+    engine, *_ = deepspeed.initialize(model=model, config=config, mesh=mesh)
+    assert "rbg" in str(jax.random.key_impl(engine._rng))
+    batch = {"input_ids": np.zeros((2, 16), np.int32),
+             "attention_mask": np.ones((2, 16), np.int32),
+             "masked_lm_labels": np.zeros((2, 16), np.int32)}
+    loss = engine.train_batch(iter([batch]))
+    assert np.isfinite(float(jax.device_get(loss)))
