@@ -28,6 +28,7 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 BASELINE_SAMPLES_PER_SEC = 272.0  # V100-32GB, reference fastest-bert post
+BASELINE_SEQ512_SAMPLES_PER_SEC = 52.0  # same post, seq 512 row
 SEQ = 128
 VOCAB = 30528
 
@@ -143,7 +144,7 @@ def main():
                                     f"synchronize; result discarded")}))
         sys.exit(1)
 
-    print(json.dumps({
+    record = {
         "metric": "bert_large_seq128_samples_per_sec_per_chip",
         "value": round(samples_per_sec, 2),
         "unit": "samples/s",
@@ -155,7 +156,54 @@ def main():
         "batch": batch,
         "dropout": dropout_p,
         "device": getattr(dev, "device_kind", str(dev)),
-    }))
+    }
+
+    # Secondary: the reference's seq-512 row (52 samples/s on V100).  The
+    # flash kernel (tuned blocks + in-kernel PRNG dropout) carries this
+    # config; BENCH_SEQ512=0 skips.
+    if os.environ.get("BENCH_SEQ512", "1") != "0":
+        b512 = int(os.environ.get("BENCH_SEQ512_BATCH", "16"))
+        s512_steps = max(steps // 3, 5)
+        cfg512 = BertConfig.bert_large(
+            max_position_embeddings=512, vocab_size=VOCAB,
+            hidden_dropout_prob=dropout_p,
+            attention_probs_dropout_prob=dropout_p)
+        model512 = BertForPreTrainingTPU(cfg512, compute_dtype=None)
+        eng512, *_ = deepspeed.initialize(
+            model=model512, config=dict(config, train_batch_size=b512),
+            mesh=mesh)
+        ids512 = rng.integers(0, VOCAB, size=(b512, 512)).astype(np.int32)
+        batch512 = {
+            "input_ids": ids512,
+            "attention_mask": np.ones((b512, 512), np.int32),
+            "token_type_ids": np.zeros((b512, 512), np.int32),
+            "masked_lm_labels": np.where(rng.random((b512, 512)) < 0.15,
+                                         ids512, -100).astype(np.int32),
+            "next_sentence_labels": rng.integers(
+                0, 2, size=(b512,)).astype(np.int32),
+        }
+        for _ in range(max(warmup // 2, 1)):
+            loss512 = eng512.train_batch(iter([batch512]))
+        float(jax.device_get(loss512))
+        t0 = time.perf_counter()
+        for _ in range(s512_steps):
+            loss512 = eng512.train_batch(iter([batch512]))
+        final512 = float(jax.device_get(loss512))
+        dt512 = time.perf_counter() - t0
+        sps512 = b512 * s512_steps / dt512
+        mfu512 = sps512 * bert_model_flops_per_sample(cfg512, 512) / 1e12 / peak
+        if mfu512 > 1.0 or not math.isfinite(final512):
+            # same discipline as the primary metric: an unsynchronized or
+            # NaN measurement is reported as invalid, not silently omitted
+            record["seq512_error"] = (
+                f"invalid measurement: mfu={mfu512:.2f} loss={final512}")
+        else:
+            record["seq512_samples_per_sec"] = round(sps512, 2)
+            record["seq512_vs_baseline"] = round(
+                sps512 / BASELINE_SEQ512_SAMPLES_PER_SEC, 3)
+            record["seq512_mfu"] = round(mfu512, 4)
+
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -18,6 +18,7 @@ reference's comm-interval alignment (``stage1.py:32-103``).
 
 from typing import List, NamedTuple, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -96,8 +97,6 @@ def random_keep(rng, shape, rate):
 
     Returns ``(keep_mask_bool, scale_float)``.
     """
-    import jax
-
     thresh = min(255, max(1, int(round(float(rate) * 256.0))))
     bits = jax.random.bits(rng, shape, dtype=jnp.uint8)
     return bits >= jnp.uint8(thresh), 256.0 / (256 - thresh)

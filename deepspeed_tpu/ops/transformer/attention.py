@@ -17,12 +17,14 @@ import jax.numpy as jnp
 
 from ..op_common import random_keep
 
-# When the fp32 score tensor would exceed this, attention goes blockwise
-# (Pallas flash) regardless of speed: measured on v5e, XLA's batched
-# attention beats the flash kernel at every length that FITS (seq 128:
-# 416 vs 344 samples/s end-to-end on BERT-large), so the kernel's job is
-# the memory ceiling, not throughput.  DS_FLASH_ATTENTION=always|never|auto
-# overrides.
+# Dispatch policy, measured on v5e (BERT-large shapes, h16 d64):
+# - short sequences (128-256): XLA's batched attention wins — blocks are too
+#   small for the flash pipeline (seq 128: 416 vs 344 samples/s end-to-end);
+# - seq >= 512: the tuned-block Pallas kernel wins (seq 512: 5.7 vs 6.8 ms
+#   fwd+bwd; seq 2048: 8.7 vs 15.8 ms) AND never materializes the [s, s]
+#   score tensor, which is also what lifts the memory ceiling for long
+#   sequences.  DS_FLASH_ATTENTION=always|never|auto overrides.
+PALLAS_MIN_SEQ = 512
 PALLAS_MIN_SCORE_BYTES = 2 * 1024 ** 3
 
 
@@ -35,6 +37,8 @@ def _use_pallas(q, k):
         if mode == "never":
             return False
         if mode == "always":
+            return shapes_ok
+        if q.shape[1] >= PALLAS_MIN_SEQ and k.shape[1] >= PALLAS_MIN_SEQ:
             return shapes_ok
         b, sq, h, _ = q.shape
         score_bytes = 4 * b * h * sq * k.shape[1]
@@ -77,8 +81,8 @@ def reference_attention(q, k, v, mask=None, causal=False, dropout_rate=0.0,
         # one random byte per element in compute dtype (the reference kernel
         # likewise drops the fp16 softmax output, dropout_kernels.cu); rates
         # below the 1/256 quantum pass through, matching layers.dropout
-        keep, scale = random_keep(dropout_rng, probs.shape, dropout_rate)
-        probs = jnp.where(keep, probs * jnp.asarray(scale, probs.dtype), 0.0)
+        keep, inv_keep = random_keep(dropout_rng, probs.shape, dropout_rate)
+        probs = jnp.where(keep, probs * jnp.asarray(inv_keep, probs.dtype), 0.0)
     ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
     return ctx
 
@@ -98,11 +102,20 @@ def dot_product_attention(q, k, v, mask=None, key_padding_mask=None,
     """
     assert mask is None or key_padding_mask is None, (
         "pass either an additive mask or a key_padding_mask, not both")
-    if (_use_pallas(q, k) and (deterministic or dropout_rate == 0.0)
-            and mask is None):
+    if _use_pallas(q, k) and mask is None:
         from .flash_attention import flash_attention
 
-        return flash_attention(q, k, v, kv_mask=key_padding_mask, causal=causal)
+        seed, rate = None, 0.0
+        if (not deterministic and dropout_rate >= 1.0 / 512.0
+                and dropout_rng is not None):
+            # in-kernel probs dropout: hand the kernel a 32-bit seed drawn
+            # from this call's rng stream
+            seed = jax.lax.bitcast_convert_type(
+                jax.random.bits(dropout_rng, (), jnp.uint32), jnp.int32)
+            rate = float(dropout_rate)
+        return flash_attention(q, k, v, kv_mask=key_padding_mask,
+                               dropout_seed=seed, causal=causal,
+                               dropout_rate=rate)
     if key_padding_mask is not None:
         mask = key_padding_to_additive(key_padding_mask)[:, None, None, :]
     return reference_attention(q, k, v, mask=mask, causal=causal,

@@ -379,29 +379,26 @@ class DeepSpeedConfig:
         micro_batch = self.train_micro_batch_size_per_gpu
         grad_acc = self.gradient_accumulation_steps
 
-        # all values are provided nothing needs to be set
+        # Invariant: train_batch = micro_batch x grad_acc x dp_world.
+        # Given any subset of the triple, solve for the rest; with only one
+        # value given, grad_acc defaults to 1.
         if train_batch is not None and micro_batch is not None and grad_acc is not None:
             return
-        # global_accumulation_steps needs to be set
         elif train_batch is not None and micro_batch is not None:
             grad_acc = train_batch // micro_batch
             grad_acc //= self.world_size
             self.gradient_accumulation_steps = grad_acc
-        # micro_batch_per_gpu needs to be set
         elif train_batch is not None and grad_acc is not None:
             micro_batch = train_batch // self.world_size
             micro_batch //= grad_acc
             self.train_micro_batch_size_per_gpu = micro_batch
-        # train_batch_size needs to be set
         elif micro_batch is not None and grad_acc is not None:
             train_batch_size = micro_batch * grad_acc
             train_batch_size *= self.world_size
             self.train_batch_size = train_batch_size
-        # gradient_accumulation_steps and micro_batch_per_gpus is set
         elif train_batch is not None:
             self.gradient_accumulation_steps = 1
             self.train_micro_batch_size_per_gpu = train_batch // self.world_size
-        # train_batch_size and gradient_accumulation_step is set
         elif micro_batch is not None:
             self.train_batch_size = micro_batch * self.world_size
             self.gradient_accumulation_steps = 1
@@ -441,7 +438,7 @@ class DeepSpeedConfig:
         if vocabulary_size and vocabulary_size % TENSOR_CORE_ALIGN_SIZE != 0:
             logger.warning(
                 f"DeepSpeedConfig: vocabulary size {vocabulary_size} is not aligned to "
-                f"{TENSOR_CORE_ALIGN_SIZE}, may import training performance")
+                f"{TENSOR_CORE_ALIGN_SIZE}, which may hurt MXU tiling efficiency")
         if (self.optimizer_params is not None
                 and C.MAX_GRAD_NORM in self.optimizer_params.keys()
                 and self.optimizer_params[C.MAX_GRAD_NORM] > 0):
