@@ -160,7 +160,22 @@ def main():
 
     # Secondary: the reference's seq-512 row (52 samples/s on V100).  The
     # flash kernel (tuned blocks + in-kernel PRNG dropout) carries this
-    # config; BENCH_SEQ512=0 skips.
+    # config; BENCH_SEQ512=0 skips.  Guarded so a secondary failure (OOM on
+    # a smaller chip, compile error) can never lose the validated primary
+    # metric above.
+    try:
+        _measure_seq512(record, deepspeed, BertConfig, BertForPreTrainingTPU,
+                        mesh, config, rng, steps, warmup, dropout_p, peak)
+    except Exception as e:  # pragma: no cover - depends on chip
+        record["seq512_error"] = f"secondary run failed: {e!r:.300}"
+
+    print(json.dumps(record))
+
+
+def _measure_seq512(record, deepspeed, BertConfig, BertForPreTrainingTPU,
+                    mesh, config, rng, steps, warmup, dropout_p, peak):
+    import jax
+
     if os.environ.get("BENCH_SEQ512", "1") != "0":
         b512 = int(os.environ.get("BENCH_SEQ512_BATCH", "16"))
         s512_steps = max(steps // 3, 5)
@@ -202,8 +217,6 @@ def main():
             record["seq512_vs_baseline"] = round(
                 sps512 / BASELINE_SEQ512_SAMPLES_PER_SEC, 3)
             record["seq512_mfu"] = round(mfu512, 4)
-
-    print(json.dumps(record))
 
 
 if __name__ == "__main__":

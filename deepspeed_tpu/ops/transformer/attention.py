@@ -20,8 +20,9 @@ from ..op_common import random_keep
 # Dispatch policy, measured on v5e (BERT-large shapes, h16 d64):
 # - short sequences (128-256): XLA's batched attention wins — blocks are too
 #   small for the flash pipeline (seq 128: 416 vs 344 samples/s end-to-end);
-# - seq >= 512: the tuned-block Pallas kernel wins (seq 512: 5.7 vs 6.8 ms
-#   fwd+bwd; seq 2048: 8.7 vs 15.8 ms) AND never materializes the [s, s]
+# - seq >= 512: the tuned-block Pallas kernel wins (seq 512: 5.4 vs 6.8 ms
+#   fwd+bwd; seq 2048: 7.3 vs 15.8 ms — see flash_attention._auto_blocks,
+#   the authoritative tuning record) AND never materializes the [s, s]
 #   score tensor, which is also what lifts the memory ceiling for long
 #   sequences.  DS_FLASH_ATTENTION=always|never|auto overrides.
 PALLAS_MIN_SEQ = 512
