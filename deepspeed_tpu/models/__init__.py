@@ -1,3 +1,5 @@
-from .bert import BertConfig, BertForPreTrainingTPU, BertModel
+from .bert import (BertConfig, BertForPreTrainingTPU,
+                   BertForQuestionAnsweringTPU,
+                   BertForSequenceClassificationTPU, BertModel)
 from .gpt2 import GPT2Config, GPT2LMHeadTPU
 from .layers import TransformerLayer, cross_entropy_with_logits

@@ -234,3 +234,99 @@ class BertForPreTrainingTPU:
                                                  batch["next_sentence_labels"])
             loss = loss + nsp_loss
         return loss
+
+
+class BertForQuestionAnsweringTPU:
+    """Extractive QA (SQuAD) head: per-token start/end logits.
+
+    Parity target: the reference's BingBertSquad fine-tuning flow
+    (``tests/model/BingBertSquad/test_e2e_squad.py``) whose model is BERT +
+    a 2-output span classifier.  Batch: ``{"input_ids", "attention_mask",
+    "token_type_ids", "start_positions", "end_positions"}`` → scalar loss;
+    without positions, returns ``(start_logits, end_logits)``.
+    """
+
+    def __init__(self, config: BertConfig, compute_dtype=None):
+        self.config = config
+        self.bert = BertModel(config)
+        self.compute_dtype = compute_dtype
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        return {"bert": self.bert.init(k1),
+                "qa_outputs": _dense_init(k2, self.config.hidden_size, 2,
+                                          self.config.initializer_range)}
+
+    def partition_specs(self, mesh):
+        return {"bert": self.bert.partition_specs(mesh),
+                "qa_outputs": {"kernel": P(), "bias": P()}}
+
+    def apply(self, params, batch, rng=None, train=True, **kw):
+        seq_out, _ = self.bert.encode(
+            params["bert"], batch["input_ids"], batch.get("attention_mask"),
+            batch.get("token_type_ids"), rng=rng, deterministic=not train,
+            dtype=self.compute_dtype)
+        logits = dense(params["qa_outputs"], seq_out)  # [b, s, 2]
+        start_logits = logits[..., 0]
+        end_logits = logits[..., 1]
+        if "start_positions" not in batch:
+            return start_logits, end_logits
+        # out-of-range positions (truncated/unanswerable spans in SQuAD
+        # preprocessing) contribute nothing — torch CrossEntropyLoss
+        # ignored_index semantics, via this codebase's ignore_index path
+        s_len = start_logits.shape[1]
+
+        def ignore_oob(pos):
+            return jnp.where((pos < 0) | (pos >= s_len), -100, pos)
+
+        loss = 0.5 * (
+            cross_entropy_with_logits(start_logits,
+                                      ignore_oob(batch["start_positions"]))
+            + cross_entropy_with_logits(end_logits,
+                                        ignore_oob(batch["end_positions"])))
+        return loss
+
+
+class BertForSequenceClassificationTPU:
+    """[CLS]-pooled classification/regression head (GLUE-style).
+
+    Batch: ``{"input_ids", "attention_mask", "token_type_ids", "labels"}``
+    → scalar loss; without labels, returns [b, num_labels] logits.
+    Integer labels → cross entropy; float labels → mean-squared error on
+    the squeezed logits (STS-B-style regression).
+    """
+
+    def __init__(self, config: BertConfig, num_labels=2, compute_dtype=None):
+        self.config = config
+        self.num_labels = num_labels
+        self.bert = BertModel(config)
+        self.compute_dtype = compute_dtype
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        return {"bert": self.bert.init(k1),
+                "classifier": _dense_init(k2, self.config.hidden_size,
+                                          self.num_labels,
+                                          self.config.initializer_range)}
+
+    def partition_specs(self, mesh):
+        return {"bert": self.bert.partition_specs(mesh),
+                "classifier": {"kernel": P(), "bias": P()}}
+
+    def apply(self, params, batch, rng=None, train=True, **kw):
+        _, pooled = self.bert.encode(
+            params["bert"], batch["input_ids"], batch.get("attention_mask"),
+            batch.get("token_type_ids"), rng=rng, deterministic=not train,
+            dtype=self.compute_dtype)
+        if rng is not None and train:
+            pooled = dropout(jax.random.fold_in(rng, 99), pooled,
+                             self.config.hidden_dropout_prob, False)
+        logits = dense(params["classifier"], pooled)
+        if "labels" not in batch:
+            return logits
+        labels = batch["labels"]
+        if jnp.issubdtype(jnp.asarray(labels).dtype, jnp.floating):
+            preds = jnp.squeeze(logits, -1) if logits.shape[-1] == 1 else logits
+            return jnp.mean((preds.astype(jnp.float32)
+                             - labels.astype(jnp.float32)) ** 2)
+        return cross_entropy_with_logits(logits, labels)

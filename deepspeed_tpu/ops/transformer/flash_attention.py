@@ -45,7 +45,11 @@ try:  # pallas TPU backend is unavailable on CPU-only builds
     _VMEM = pltpu.VMEM
 except Exception:  # pragma: no cover
     pltpu = None
-    _VMEM = None
+
+    def _VMEM(shape, dtype):
+        # interpret-mode fallback on builds without the pallas TPU package:
+        # a plain ShapeDtypeStruct scratch allocation
+        return jax.ShapeDtypeStruct(shape, dtype)
 
 NEG_INF = -1e30
 # Running-max floor: keeps exp(NEG_INF - m) == 0 even for rows where every
