@@ -32,7 +32,9 @@ class BertConfig:
                  hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1,
                  initializer_range=0.02, pre_layer_norm=False,
                  layer_norm_eps=1e-12, remat=False,
-                 attn_impl="auto", sparsity_config=None):
+                 attn_impl="auto", sparsity_config=None,
+                 gelu_checkpoint=False, attn_dropout_checkpoint=False,
+                 normalize_invertible=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_hidden_layers = num_hidden_layers
@@ -48,6 +50,11 @@ class BertConfig:
         self.remat = remat
         self.attn_impl = attn_impl
         self.sparsity_config = sparsity_config
+        # kernel memory knobs (reference DeepSpeedTransformerConfig,
+        # ops/transformer/transformer.py:109-137)
+        self.gelu_checkpoint = gelu_checkpoint
+        self.attn_dropout_checkpoint = attn_dropout_checkpoint
+        self.normalize_invertible = normalize_invertible
 
     @staticmethod
     def bert_base(**kw):
@@ -74,7 +81,10 @@ class BertModel:
             initializer_range=config.initializer_range,
             layer_norm_eps=config.layer_norm_eps,
             attn_impl=config.attn_impl,
-            sparsity_config=config.sparsity_config)
+            sparsity_config=config.sparsity_config,
+            gelu_checkpoint=config.gelu_checkpoint,
+            attn_dropout_checkpoint=config.attn_dropout_checkpoint,
+            normalize_invertible=config.normalize_invertible)
 
     def init(self, rng):
         c = self.config
@@ -269,8 +279,10 @@ class BertForQuestionAnsweringTPU:
         logits = dense(params["qa_outputs"], seq_out)  # [b, s, 2]
         start_logits = logits[..., 0]
         end_logits = logits[..., 1]
-        if "start_positions" not in batch:
+        if "start_positions" not in batch and "end_positions" not in batch:
             return start_logits, end_logits
+        assert "start_positions" in batch and "end_positions" in batch, (
+            "QA batches must carry both start_positions and end_positions")
         # out-of-range positions (truncated/unanswerable spans in SQuAD
         # preprocessing) contribute nothing — torch CrossEntropyLoss
         # ignored_index semantics, via this codebase's ignore_index path

@@ -23,7 +23,9 @@ class GPT2Config:
                  num_heads=12, max_position_embeddings=1024,
                  embd_dropout=0.1, attn_dropout=0.1, resid_dropout=0.1,
                  initializer_range=0.02, layer_norm_eps=1e-5, remat=False,
-                 attn_impl="auto", sparsity_config=None):
+                 attn_impl="auto", sparsity_config=None,
+                 gelu_checkpoint=False, attn_dropout_checkpoint=False,
+                 normalize_invertible=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -34,6 +36,9 @@ class GPT2Config:
         self.resid_dropout = resid_dropout
         self.initializer_range = initializer_range
         self.layer_norm_eps = layer_norm_eps
+        self.gelu_checkpoint = gelu_checkpoint
+        self.attn_dropout_checkpoint = attn_dropout_checkpoint
+        self.normalize_invertible = normalize_invertible
         self.remat = remat
         self.attn_impl = attn_impl
         self.sparsity_config = sparsity_config
@@ -68,7 +73,10 @@ class GPT2LMHeadTPU:
             initializer_range=config.initializer_range,
             layer_norm_eps=config.layer_norm_eps,
             attn_impl=config.attn_impl,
-            sparsity_config=config.sparsity_config)
+            sparsity_config=config.sparsity_config,
+            gelu_checkpoint=config.gelu_checkpoint,
+            attn_dropout_checkpoint=config.attn_dropout_checkpoint,
+            normalize_invertible=config.normalize_invertible)
 
     def init(self, rng):
         c = self.config

@@ -47,9 +47,14 @@ except Exception:  # pragma: no cover
     pltpu = None
 
     def _VMEM(shape, dtype):
-        # interpret-mode fallback on builds without the pallas TPU package:
-        # a plain ShapeDtypeStruct scratch allocation
-        return jax.ShapeDtypeStruct(shape, dtype)
+        # no working scratch allocation exists without the pallas TPU
+        # package (ShapeDtypeStruct is rejected by scratch_shapes even in
+        # interpret mode) — fail with the real reason instead of a
+        # confusing trace-time AttributeError
+        raise RuntimeError(
+            "flash_attention needs jax.experimental.pallas.tpu, which this "
+            "jax build could not import — use attn_impl='auto' on a CPU "
+            "backend (XLA attention) instead")
 
 NEG_INF = -1e30
 # Running-max floor: keeps exp(NEG_INF - m) == 0 even for rows where every

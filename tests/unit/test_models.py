@@ -196,3 +196,35 @@ def test_bert_classifier_head_trains():
                          {k: batch[k] for k in ("input_ids", "attention_mask")},
                          train=False)
     assert logits.shape == (4, 3)
+
+
+def test_memory_knobs_preserve_loss():
+    """gelu_checkpoint/attn_dropout_checkpoint/normalize_invertible change
+    what is stored for backward, never the math (reference kernel knobs,
+    ops/transformer/transformer.py:109-137)."""
+    import deepspeed_tpu as deepspeed
+    from deepspeed_tpu.parallel import make_mesh
+
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, VOCAB, (4, SEQ)).astype(np.int32),
+             "attention_mask": np.ones((4, SEQ), np.int32),
+             "masked_lm_labels": rng.integers(0, VOCAB, (4, SEQ)).astype(np.int32)}
+
+    def losses(**knobs):
+        mesh = make_mesh({"data": 1}, devices=jax.devices("cpu")[:1])
+        cfg = BertConfig(vocab_size=VOCAB, hidden_size=64, num_hidden_layers=2,
+                         num_attention_heads=4, max_position_embeddings=SEQ,
+                         hidden_dropout_prob=0.0,
+                         attention_probs_dropout_prob=0.0, **knobs)
+        engine, *_ = deepspeed.initialize(
+            model=BertForPreTrainingTPU(cfg),
+            config={"train_batch_size": 4, "steps_per_print": 10 ** 9,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}},
+            mesh=mesh)
+        return [float(jax.device_get(engine.train_batch(iter([batch]))))
+                for _ in range(3)]
+
+    base = losses()
+    knobbed = losses(gelu_checkpoint=True, attn_dropout_checkpoint=True,
+                     normalize_invertible=True)
+    np.testing.assert_allclose(base, knobbed, rtol=2e-5)
