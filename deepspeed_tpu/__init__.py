@@ -33,3 +33,27 @@ def add_config_arguments(parser):
     from .runtime.arguments import add_config_arguments as _add
 
     return _add(parser)
+
+
+def get_sparse_attention_config(config, num_heads):
+    """Json config (dict or path) → live ``SparsityConfig`` for model
+    construction.
+
+    The ``sparse_attention`` section is parsed by ``DeepSpeedConfig``
+    (reference ``config.py:192-360``); this turns it into the layout object
+    models take as ``sparsity_config=...`` — callable *before*
+    ``initialize()``, since the model is built first.
+    """
+    import json as _json
+
+    from .ops.sparse_attention import build_sparsity_config
+
+    if isinstance(config, str):
+        with open(config) as f:
+            config = _json.load(f)
+    from .runtime.config import get_sparse_attention
+
+    section = get_sparse_attention(config)
+    if section is None:
+        return None
+    return build_sparsity_config(section, num_heads)

@@ -4,4 +4,5 @@ from .sparse_attention_utils import SparseAttentionUtils
 from .sparse_self_attention import SparseSelfAttention
 from .sparsity_config import (BigBirdSparsityConfig, BSLongformerSparsityConfig,
                               DenseSparsityConfig, FixedSparsityConfig,
-                              SparsityConfig, VariableSparsityConfig)
+                              SparsityConfig, VariableSparsityConfig,
+                              build_sparsity_config)

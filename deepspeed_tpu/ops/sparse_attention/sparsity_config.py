@@ -343,3 +343,26 @@ class BSLongformerSparsityConfig(SparsityConfig):
             layout = self.set_sliding_window_layout(h, layout)
             layout = self.set_global_layout(h, layout)
         return self.check_and_propagate_first_head_layout(layout)
+
+
+def build_sparsity_config(sparse_attention_dict, num_heads):
+    """Parsed ``sparse_attention`` config section → SparsityConfig instance.
+
+    This is how the json config's sparse-attention subsection (reference
+    ``config.py:192-360``; the bing_bert flow hands it to
+    ``SparseSelfAttention``) becomes a live layout object: the ``mode`` key
+    selects the class, every other key is a constructor kwarg.
+    """
+    modes = {
+        "dense": DenseSparsityConfig,
+        "fixed": FixedSparsityConfig,
+        "variable": VariableSparsityConfig,
+        "bigbird": BigBirdSparsityConfig,
+        "bslongformer": BSLongformerSparsityConfig,
+    }
+    kwargs = dict(sparse_attention_dict)
+    mode = kwargs.pop("mode", "fixed")
+    if mode not in modes:
+        raise ValueError(f"unknown sparse attention mode {mode!r}; "
+                         f"expected one of {sorted(modes)}")
+    return modes[mode](num_heads=num_heads, **kwargs)

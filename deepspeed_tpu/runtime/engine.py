@@ -474,6 +474,17 @@ class DeepSpeedEngine:
     def _configure_basic_optimizer(self, client_optimizer):
         if client_optimizer is not None:
             if hasattr(client_optimizer, "init_state") and hasattr(client_optimizer, "update"):
+                if (self.zero_stage >= 1
+                        and not self._config.zero_allow_untested_optimizer
+                        and type(client_optimizer).__name__ not in (
+                            "FusedAdam", "FusedLamb", "DeepSpeedCPUAdam")):
+                    # reference gate: ZeRO is validated against its own
+                    # optimizers; client optimizers need the explicit
+                    # zero_allow_untested_optimizer opt-in
+                    # (zero/utils.py:26, engine.py:672-712)
+                    raise ValueError(
+                        "ZeRO with a client optimizer requires "
+                        '"zero_allow_untested_optimizer": true')
                 return client_optimizer
             raise TypeError(
                 "client optimizer must implement init_state/update/hyperparams "
@@ -907,6 +918,10 @@ class DeepSpeedEngine:
                 "Train/Samples/loss_scale": scale,
             })
         self._losses = []
+        if self._config.memory_breakdown:
+            from .utils import see_memory_usage
+
+            see_memory_usage(f"after step {self.global_steps}", force=True)
         if self.wall_clock_breakdown():
             self.timers("step").stop(sync=False)
             self.timers.log(["forward", "step"])

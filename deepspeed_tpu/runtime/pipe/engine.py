@@ -248,6 +248,19 @@ class PipelineEngine(DeepSpeedEngine):
                          config_params=config_params, mesh=mesh)
         shape = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
         assert shape.get(PIPE_AXIS, 1) >= 1
+        # json "pipeline" section (reference config.py:363-374) fills in
+        # knobs the module constructor left at defaults — applied before the
+        # first trace, so the compiled schedule sees them
+        pipe_cfg = self._config.pipeline or {}
+        ckpt_interval = pipe_cfg.get("activation_checkpoint_interval", 0)
+        if ckpt_interval and not model.activation_checkpoint_interval:
+            model.activation_checkpoint_interval = ckpt_interval
+            log_dist(f"pipeline config: activation_checkpoint_interval="
+                     f"{ckpt_interval}", ranks=[0])
+        part = pipe_cfg.get("partition", "best")
+        if part not in ("best", None) and model.partition_method == "parameters":
+            model.partition_method = part
+            log_dist(f"pipeline config: partition={part}", ranks=[0])
         self.micro_batches = self.gradient_accumulation_steps()
         # one pipelined forward/backward covers the whole global batch
         self.tput_timer.batch_size = self.train_batch_size()

@@ -156,3 +156,62 @@ def test_pipeline_defaults():
     cfg = make_config({"train_batch_size": 8}, world_size=1)
     assert cfg.pipeline["partition"] == "best"
     assert cfg.pipeline["activation_checkpoint_interval"] == 0
+
+
+def test_sparse_attention_config_builds_model_layout():
+    """json sparse_attention section → SparsityConfig → trainable model."""
+    import jax
+    import numpy as np
+
+    import deepspeed_tpu as deepspeed
+    from deepspeed_tpu.models import BertConfig, BertForPreTrainingTPU
+    from deepspeed_tpu.parallel import make_mesh
+
+    ds_config = {"train_batch_size": 2, "steps_per_print": 10 ** 9,
+                 "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                 "sparse_attention": {"mode": "fixed", "block": 8,
+                                      "num_local_blocks": 2,
+                                      "num_global_blocks": 1}}
+    sa = deepspeed.get_sparse_attention_config(ds_config, num_heads=4)
+    assert type(sa).__name__ == "FixedSparsityConfig" and sa.block == 8
+    model = BertForPreTrainingTPU(BertConfig(
+        vocab_size=64, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=4, max_position_embeddings=64,
+        attn_impl="sparse", sparsity_config=sa))
+    mesh = make_mesh({"data": 1}, devices=jax.devices("cpu")[:1])
+    engine, *_ = deepspeed.initialize(model=model, config=ds_config, mesh=mesh)
+    batch = {"input_ids": np.zeros((2, 64), np.int32),
+             "attention_mask": np.ones((2, 64), np.int32),
+             "masked_lm_labels": np.zeros((2, 64), np.int32)}
+    loss = engine.train_batch(iter([batch]))
+    assert np.isfinite(float(jax.device_get(loss)))
+
+
+def test_zero_untested_optimizer_gate():
+    import jax
+    import pytest
+
+    import deepspeed_tpu as deepspeed
+    from deepspeed_tpu.models import BertConfig, BertForPreTrainingTPU
+    from deepspeed_tpu.parallel import make_mesh
+    from deepspeed_tpu.ops.adam.fused_adam import FusedAdam
+
+    class MyOpt(FusedAdam):
+        pass
+
+    def build(allow):
+        cfg = {"train_batch_size": 2, "steps_per_print": 10 ** 9,
+               "zero_optimization": {"stage": 1}}
+        if allow:
+            cfg["zero_allow_untested_optimizer"] = True
+        mesh = make_mesh({"data": 1}, devices=jax.devices("cpu")[:1])
+        model = BertForPreTrainingTPU(BertConfig(
+            vocab_size=64, hidden_size=32, num_hidden_layers=1,
+            num_attention_heads=2, max_position_embeddings=32))
+        return deepspeed.initialize(model=model, optimizer=MyOpt(),
+                                    config=cfg, mesh=mesh)
+
+    with pytest.raises(ValueError, match="zero_allow_untested_optimizer"):
+        build(allow=False)
+    engine, *_ = build(allow=True)
+    assert type(engine.optimizer).__name__ == "MyOpt"

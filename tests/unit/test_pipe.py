@@ -373,3 +373,39 @@ def test_pytree_boundary_activations(cpu_devices):
     pipe_losses = _train(eng, data, 2)
     assert np.allclose(base_losses, pipe_losses, rtol=2e-4, atol=2e-5), (
         f"pytree boundary: {pipe_losses} != {base_losses}")
+
+
+def test_pipeline_config_section_fills_module_defaults(cpu_devices):
+    """json "pipeline" section applies knobs the module ctor left default
+    (reference config.py:363-374)."""
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu as deepspeed
+    from deepspeed_tpu.parallel import make_mesh
+    from deepspeed_tpu.runtime.pipe import LayerSpec, PipelineModule
+
+    class Lin:
+        def __init__(self, d):
+            self.d = d
+
+        def init(self, rng):
+            return {"w": jax.random.normal(rng, (self.d, self.d)) * 0.1}
+
+        def apply(self, p, x):
+            return jnp.tanh(x @ p["w"])
+
+    mesh = make_mesh({"pipe": 2}, devices=cpu_devices[:2])
+    module = PipelineModule([LayerSpec(Lin, 8) for _ in range(4)],
+                            loss_fn=lambda o, l: jnp.mean((o - l) ** 2))
+    assert module.activation_checkpoint_interval == 0
+    config = {"train_micro_batch_size_per_gpu": 2,
+              "gradient_accumulation_steps": 2,
+              "steps_per_print": 10 ** 9,
+              "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+              "pipeline": {"activation_checkpoint_interval": 1}}
+    engine, *_ = deepspeed.initialize(model=module, config=config, mesh=mesh)
+    assert module.activation_checkpoint_interval == 1
+    x = np.random.default_rng(0).normal(size=(2, 8)).astype(np.float32)
+    loss = engine.train_batch(iter([(x, x), (x, x)]))
+    assert np.isfinite(float(jax.device_get(loss)))
