@@ -3,3 +3,4 @@ from .bert import (BertConfig, BertForPreTrainingTPU,
                    BertForSequenceClassificationTPU, BertModel)
 from .gpt2 import GPT2Config, GPT2LMHeadTPU
 from .layers import TransformerLayer, cross_entropy_with_logits
+from .moe import MoEFFN, MoETransformerLayer

@@ -25,7 +25,9 @@ class GPT2Config:
                  initializer_range=0.02, layer_norm_eps=1e-5, remat=False,
                  attn_impl="auto", sparsity_config=None,
                  gelu_checkpoint=False, attn_dropout_checkpoint=False,
-                 normalize_invertible=False):
+                 normalize_invertible=False,
+                 moe_experts=0, moe_every=2, moe_k=2,
+                 moe_capacity_factor=1.25, moe_aux_coef=0.01):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -39,6 +41,14 @@ class GPT2Config:
         self.gelu_checkpoint = gelu_checkpoint
         self.attn_dropout_checkpoint = attn_dropout_checkpoint
         self.normalize_invertible = normalize_invertible
+        # MoE (beyond-reference; expert parallelism over the 'expert' axis):
+        # moe_experts > 0 swaps the dense FFN for a routed-expert FFN on
+        # every moe_every-th block (GShard-style alternation)
+        self.moe_experts = moe_experts
+        self.moe_every = moe_every
+        self.moe_k = moe_k
+        self.moe_capacity_factor = moe_capacity_factor
+        self.moe_aux_coef = moe_aux_coef
         self.remat = remat
         self.attn_impl = attn_impl
         self.sparsity_config = sparsity_config
@@ -77,6 +87,27 @@ class GPT2LMHeadTPU:
             gelu_checkpoint=config.gelu_checkpoint,
             attn_dropout_checkpoint=config.attn_dropout_checkpoint,
             normalize_invertible=config.normalize_invertible)
+        self.moe_layer = None
+        if config.moe_experts:
+            from .moe import MoETransformerLayer
+
+            self.moe_layer = MoETransformerLayer(
+                hidden_size=config.hidden_size, heads=config.num_heads,
+                num_experts=config.moe_experts, causal=True,
+                k=config.moe_k, capacity_factor=config.moe_capacity_factor,
+                attn_dropout_ratio=config.attn_dropout,
+                hidden_dropout_ratio=config.resid_dropout,
+                initializer_range=config.initializer_range,
+                layer_norm_eps=config.layer_norm_eps,
+                attn_impl=config.attn_impl,
+                sparsity_config=config.sparsity_config,
+                gelu_checkpoint=config.gelu_checkpoint,
+                attn_dropout_checkpoint=config.attn_dropout_checkpoint,
+                normalize_invertible=config.normalize_invertible)
+
+    def _is_moe_layer(self, i):
+        c = self.config
+        return bool(c.moe_experts) and i % c.moe_every == c.moe_every - 1
 
     def init(self, rng):
         c = self.config
@@ -86,7 +117,9 @@ class GPT2LMHeadTPU:
                                   c.initializer_range),
             "wpe": embedding_init(keys[1], c.max_position_embeddings,
                                   c.hidden_size, c.initializer_range),
-            "blocks": {f"layer_{i}": self.layer.init(keys[2 + i])
+            "blocks": {f"layer_{i}": (self.moe_layer.init(keys[2 + i])
+                                      if self._is_moe_layer(i)
+                                      else self.layer.init(keys[2 + i]))
                        for i in range(c.num_layers)},
             "ln_f": {"scale": jnp.ones((c.hidden_size,), jnp.float32),
                      "bias": jnp.zeros((c.hidden_size,), jnp.float32)},
@@ -101,10 +134,17 @@ class GPT2LMHeadTPU:
         c = self.config
         has_model = "model" in mesh.axis_names
         layer_spec = TransformerLayer.partition_specs()
+        moe_spec = None
+        if c.moe_experts:
+            from .moe import MoETransformerLayer
+
+            moe_spec = MoETransformerLayer.partition_specs()
         return {
             "wte": P("model", None) if has_model else P(),
             "wpe": P(),
-            "blocks": {f"layer_{i}": layer_spec for i in range(c.num_layers)},
+            "blocks": {f"layer_{i}": (moe_spec if self._is_moe_layer(i)
+                                      else layer_spec)
+                       for i in range(c.num_layers)},
             "ln_f": {"scale": P(), "bias": P()},
         }
 
@@ -118,20 +158,39 @@ class GPT2LMHeadTPU:
             rng_e, rng = jax.random.split(rng)
             x = dropout(rng_e, x, c.embd_dropout, deterministic)
 
+        aux_losses = []
+
         def run_layer(layer_params, x, layer_rng):
             return self.layer.apply(layer_params, x, rng=layer_rng,
                                     deterministic=deterministic)
 
-        ck_layer = None
+        def run_moe_layer(layer_params, x, layer_rng):
+            return self.moe_layer.apply(layer_params, x, rng=layer_rng,
+                                        deterministic=deterministic)
+
+        ck_layer = ck_moe_layer = None
         if c.remat:
             from ..runtime.activation_checkpointing import checkpointing as ds_ckpt
 
             ck_layer = ds_ckpt.checkpoint_wrapper(run_layer)
+            if self.moe_layer is not None:
+                ck_moe_layer = ds_ckpt.checkpoint_wrapper(run_moe_layer)
 
         for i in range(c.num_layers):
             layer_rng = None
             if rng is not None and not deterministic:
                 rng, layer_rng = jax.random.split(rng)
+            if self._is_moe_layer(i):
+                fn = run_moe_layer
+                if ck_moe_layer is not None:
+                    from ..runtime.activation_checkpointing import checkpointing as ds_ckpt
+
+                    if ds_ckpt.should_checkpoint_layer(i, c.num_layers):
+                        fn = ck_moe_layer
+                with jax.named_scope(f"layer_{i}_moe"):
+                    x, aux = fn(params["blocks"][f"layer_{i}"], x, layer_rng)
+                    aux_losses.append(aux)
+                continue
             fn = run_layer
             if ck_layer is not None:
                 from ..runtime.activation_checkpointing import checkpointing as ds_ckpt
@@ -142,6 +201,8 @@ class GPT2LMHeadTPU:
                 x = fn(params["blocks"][f"layer_{i}"], x, layer_rng)
 
         x = layer_norm(params["ln_f"], x, c.layer_norm_eps)
+        self._last_moe_aux = (sum(aux_losses) / len(aux_losses)
+                              if aux_losses else None)
         return x @ params["wte"].T.astype(x.dtype)  # tied LM head
 
     def apply(self, params, batch, rng=None, train=True, **kw):
@@ -155,4 +216,9 @@ class GPT2LMHeadTPU:
             labels = jnp.concatenate(
                 [input_ids[:, 1:],
                  jnp.full((input_ids.shape[0], 1), -100, input_ids.dtype)], axis=1)
-        return cross_entropy_with_logits(logits, labels, ignore_index=-100)
+        loss = cross_entropy_with_logits(logits, labels, ignore_index=-100)
+        if train and getattr(self, "_last_moe_aux", None) is not None:
+            # Switch load-balancing aux loss (training-only regularizer),
+            # averaged over MoE blocks; eval loss stays comparable to dense
+            loss = loss + self.config.moe_aux_coef * self._last_moe_aux
+        return loss

@@ -146,6 +146,55 @@ class TransformerLayer:
         return {"qkv": col, "attn_out": row, "fc1": col, "fc2": row,
                 "ln_attn": ln, "ln_mlp": ln}
 
+    def attention_core(self, params, y, mask=None, key_padding_mask=None,
+                       attn_rng=None, deterministic=True):
+        """Fused-QKV attention → [b, s, h] context, honoring the configured
+        ``attn_impl`` (auto/ring/sparse) and attention dropout.  Shared by
+        the dense block and :class:`~deepspeed_tpu.models.moe.MoETransformerLayer`,
+        so every attention variant behaves identically in both."""
+        b, s, h = y.shape
+        r1 = attn_rng
+        qkv = dense(params["qkv"], y)  # [b, s, 3h] one fused GEMM
+        qkv = qkv.reshape(b, s, 3, self.heads, self.head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        kpm_add = None  # additive [b, s] form for ring/sparse cores
+        if self.attn_impl in ("ring", "sparse"):
+            if key_padding_mask is not None:
+                kpm_add = key_padding_to_additive(key_padding_mask)
+            elif mask is not None:
+                # the general additive [b, 1, 1, s] broadcast collapses
+                assert mask.size == b * s, (
+                    f"attn_impl={self.attn_impl!r} supports key-padding "
+                    f"masks ([b,1,1,s]), got mask shape {mask.shape}")
+                kpm_add = mask.reshape(b, s)
+        if self.attn_impl == "ring":
+            from ..ops.transformer.ring_attention import ring_attention
+
+            ctx = ring_attention(q, k, v, causal=self.causal,
+                                 key_padding_mask=kpm_add)
+        elif self.attn_impl == "sparse":
+            from ..ops.sparse_attention import block_sparse_attention
+
+            ctx = block_sparse_attention(
+                q, k, v, self._sparse_layout(s),
+                causal=self.causal or getattr(
+                    self.sparsity_config, "attention",
+                    "bidirectional") == "unidirectional",
+                key_padding_mask=kpm_add, attn_mask=None)
+        else:
+            ctx = dot_product_attention(
+                q, k, v, mask=mask, key_padding_mask=key_padding_mask,
+                causal=self.causal,
+                dropout_rate=self.attn_dropout_ratio, dropout_rng=r1,
+                deterministic=deterministic)
+        if self.attn_impl in ("ring", "sparse") and r1 is not None \
+                and self.attn_dropout_ratio > 0.0:
+            # ring/sparse cores have no in-core dropout; apply it to the
+            # attention output so attn_dropout_ratio is honored rather
+            # than silently ignored.
+            ctx = dropout(r1, ctx, self.attn_dropout_ratio, deterministic)
+        return ctx.reshape(b, s, h)
+
     def apply(self, params, x, mask=None, key_padding_mask=None, rng=None,
               deterministic=True):
         """x: [batch, seq, hidden]; mask: [batch, 1, 1, seq] additive or None;
@@ -160,46 +209,9 @@ class TransformerLayer:
 
         @jax.named_scope("attention")
         def attention_block(params, y):
-            qkv = dense(params["qkv"], y)  # [b, s, 3h] one fused GEMM
-            qkv = qkv.reshape(b, s, 3, self.heads, self.head_dim)
-            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-            kpm_add = None  # additive [b, s] form for ring/sparse cores
-            if self.attn_impl in ("ring", "sparse"):
-                if key_padding_mask is not None:
-                    kpm_add = key_padding_to_additive(key_padding_mask)
-                elif mask is not None:
-                    # the general additive [b, 1, 1, s] broadcast collapses
-                    assert mask.size == b * s, (
-                        f"attn_impl={self.attn_impl!r} supports key-padding "
-                        f"masks ([b,1,1,s]), got mask shape {mask.shape}")
-                    kpm_add = mask.reshape(b, s)
-            if self.attn_impl == "ring":
-                from ..ops.transformer.ring_attention import ring_attention
-
-                ctx = ring_attention(q, k, v, causal=self.causal,
-                                     key_padding_mask=kpm_add)
-            elif self.attn_impl == "sparse":
-                from ..ops.sparse_attention import block_sparse_attention
-
-                ctx = block_sparse_attention(
-                    q, k, v, self._sparse_layout(s),
-                    causal=self.causal or getattr(
-                        self.sparsity_config, "attention",
-                        "bidirectional") == "unidirectional",
-                    key_padding_mask=kpm_add, attn_mask=None)
-            else:
-                ctx = dot_product_attention(
-                    q, k, v, mask=mask, key_padding_mask=key_padding_mask,
-                    causal=self.causal,
-                    dropout_rate=self.attn_dropout_ratio, dropout_rng=r1,
-                    deterministic=deterministic)
-            if self.attn_impl in ("ring", "sparse") and r1 is not None \
-                    and self.attn_dropout_ratio > 0.0:
-                # ring/sparse cores have no in-core dropout; apply it to the
-                # attention output so attn_dropout_ratio is honored rather
-                # than silently ignored.
-                ctx = dropout(r1, ctx, self.attn_dropout_ratio, deterministic)
-            ctx = ctx.reshape(b, s, h)
+            ctx = self.attention_core(params, y, mask=mask,
+                                      key_padding_mask=key_padding_mask,
+                                      attn_rng=r1, deterministic=deterministic)
             out = dense(params["attn_out"], ctx)
             return dropout(r2, out, self.hidden_dropout_ratio, deterministic)
 

@@ -1,4 +1,4 @@
 from .topology import (ProcessTopology, PipeDataParallelTopology,
                        PipeModelDataParallelTopology)
 from .mesh import (make_mesh, available_devices, MeshGrid, PIPE_AXIS, DATA_AXIS,
-                   SEQ_AXIS, MODEL_AXIS, CANONICAL_AXES)
+                   SEQ_AXIS, MODEL_AXIS, EXPERT_AXIS, CANONICAL_AXES)

@@ -56,8 +56,9 @@ def data_parallel_process_info(mesh):
     return ncoord // len(mine), mine[0] // len(mine)
 SEQ_AXIS = "seq"
 MODEL_AXIS = "model"
+EXPERT_AXIS = "expert"
 
-CANONICAL_AXES = (PIPE_AXIS, DATA_AXIS, SEQ_AXIS, MODEL_AXIS)
+CANONICAL_AXES = (PIPE_AXIS, DATA_AXIS, SEQ_AXIS, MODEL_AXIS, EXPERT_AXIS)
 
 # Process-wide current mesh, set by the engine at init so mesh-aware ops
 # (ring attention's shard_map) can find it at trace time without plumbing a

@@ -171,10 +171,10 @@ class DeepSpeedEngine:
         # -- mesh (replaces process-group setup, reference engine.py:521-538) --
         if mesh is not None:
             self.mesh = mesh
+            mesh_shape = dict(zip(mesh.axis_names, mesh.devices.shape))
             world_size = int(np.prod(mesh.devices.shape)) // max(
-                1, dict(zip(mesh.axis_names, mesh.devices.shape)).get("model", 1)
-                * dict(zip(mesh.axis_names, mesh.devices.shape)).get("pipe", 1)
-                * dict(zip(mesh.axis_names, mesh.devices.shape)).get("seq", 1))
+                1, mesh_shape.get("model", 1) * mesh_shape.get("pipe", 1)
+                * mesh_shape.get("seq", 1) * mesh_shape.get("expert", 1))
             self._config = DeepSpeedConfig(config, mpu, world_size=world_size)
         else:
             self._config = DeepSpeedConfig(config, mpu)
