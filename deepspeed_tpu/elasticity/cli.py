@@ -1,0 +1,34 @@
+"""Elastic-config inspector CLI (reference ``bin/ds_elastic``): show the
+final batch size, valid accelerator counts, and micro-batch plan an
+elastic config resolves to.  Installed as the ``ds_elastic`` console
+script (see ``pyproject.toml``)."""
+import argparse
+import json
+
+from deepspeed_tpu.elasticity import compute_elastic_config
+
+
+def main():
+    parser = argparse.ArgumentParser(description="DeepSpeed-TPU elasticity")
+    parser.add_argument("-c", "--config", type=str, required=True,
+                        help="DeepSpeed config json with an elasticity block")
+    parser.add_argument("-w", "--world-size", type=int, default=0,
+                        help="resolve for this accelerator count")
+    args = parser.parse_args()
+    with open(args.config) as f:
+        ds_config = json.load(f)
+    res = compute_elastic_config(ds_config, target_deepspeed_version="0.3.11",
+                                 world_size=args.world_size)
+    if args.world_size:
+        final_batch, valid_gpus, micro_batch = res
+        print(f"final global batch:   {final_batch}")
+        print(f"valid chip counts:    {valid_gpus}")
+        print(f"micro batch @ w={args.world_size}: {micro_batch}")
+    else:
+        final_batch, valid_gpus = res
+        print(f"final global batch:   {final_batch}")
+        print(f"valid chip counts:    {valid_gpus}")
+
+
+if __name__ == "__main__":
+    main()

@@ -18,8 +18,9 @@ import shutil
 import subprocess
 import tempfile
 
-_REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__),
-                                          "..", ".."))
+# native sources ship inside the package (deepspeed_tpu/csrc/...) so an
+# installed wheel can JIT-build them, unlike the reference's repo-root csrc/
+_PKG_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 _CACHE_DIR = os.environ.get(
     "DS_BUILD_CACHE",
     os.path.join(os.path.expanduser("~"), ".cache", "deepspeed_tpu"))
@@ -34,7 +35,7 @@ def jit_build(name, sources, extra_flags=()):
     if gxx is None:
         raise RuntimeError(f"op {name!r} needs g++ to JIT-build its native "
                            "kernel; none found on PATH")
-    paths = [os.path.join(_REPO_ROOT, s) for s in sources]
+    paths = [os.path.join(_PKG_ROOT, s) for s in sources]
     h = hashlib.sha256()
     for p in paths:
         with open(p, "rb") as f:
