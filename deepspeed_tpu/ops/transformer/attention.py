@@ -109,10 +109,11 @@ def dot_product_attention(q, k, v, mask=None, key_padding_mask=None,
         seed, rate = None, 0.0
         if (not deterministic and dropout_rate >= 1.0 / 512.0
                 and dropout_rng is not None):
-            # in-kernel probs dropout: hand the kernel a 32-bit seed drawn
-            # from this call's rng stream
+            # in-kernel probs dropout: hand the kernel 64 bits of seed
+            # material from this call's rng stream (32 bits would
+            # birthday-collide across steps after ~65k draws)
             seed = jax.lax.bitcast_convert_type(
-                jax.random.bits(dropout_rng, (), jnp.uint32), jnp.int32)
+                jax.random.bits(dropout_rng, (2,), jnp.uint32), jnp.int32)
             rate = float(dropout_rate)
         return flash_attention(q, k, v, kv_mask=key_padding_mask,
                                dropout_seed=seed, causal=causal,

@@ -44,17 +44,11 @@ try:  # pallas TPU backend is unavailable on CPU-only builds
 
     _VMEM = pltpu.VMEM
 except Exception:  # pragma: no cover
+    # Without the pallas TPU package no scratch allocation works (even in
+    # interpret mode), so interpret calls fall back to the pure-jnp path
+    # (_jnp_flash_reference) and compiled calls raise in _flash_fwd.
     pltpu = None
-
-    def _VMEM(shape, dtype):
-        # no working scratch allocation exists without the pallas TPU
-        # package (ShapeDtypeStruct is rejected by scratch_shapes even in
-        # interpret mode) — fail with the real reason instead of a
-        # confusing trace-time AttributeError
-        raise RuntimeError(
-            "flash_attention needs jax.experimental.pallas.tpu, which this "
-            "jax build could not import — use attn_impl='auto' on a CPU "
-            "backend (XLA attention) instead")
+    _VMEM = None
 
 NEG_INF = -1e30
 # Running-max floor: keeps exp(NEG_INF - m) == 0 even for rows where every
@@ -100,16 +94,16 @@ def _dropout_thresh(rate):
 def _keep_mask(seed_ref, i, j, kb, shape, thresh):
     """Regenerable [Bq, Bk] keep mask for score tile (i, j, kb).
 
-    Seeding the hardware PRNG with (seed, tile hash) makes the draw a pure
-    function of the tile coordinates, so the backward kernels regenerate the
-    exact forward mask.  Mosaic takes at most two seed words, so the three
-    coordinates mix into one via a wraparound multiplicative hash —
-    deterministic, and identical across the fwd/dq/dkv kernels, which is
-    all that matters.
+    Seeding the hardware PRNG with (seed words, tile coordinates) makes the
+    draw a pure function of the tile, so the backward kernels regenerate the
+    exact forward mask.  Mosaic's ``prng_seed`` mixes any number of seed
+    words, so the 64-bit user seed (two int32 words — a single 32-bit
+    per-step seed would birthday-collide after ~65k steps) and the three
+    coordinates each get their own word: distinct tiles cannot alias the
+    way a single wraparound coordinate hash could.
     """
-    tile = (jnp.int32(i) * jnp.int32(1000003)
-            + jnp.int32(j)) * jnp.int32(1000003) + jnp.int32(kb)
-    pltpu.prng_seed(seed_ref[0], tile)
+    pltpu.prng_seed(seed_ref[0], seed_ref[1],
+                    jnp.int32(i), jnp.int32(j), jnp.int32(kb))
     bits = jax.lax.bitcast_convert_type(
         pltpu.prng_random_bits(shape), jnp.uint32)
     return bits >= jnp.uint32(thresh)
@@ -366,7 +360,10 @@ def _dropout_ops(dropout_rate, dropout_seed):
     assert dropout_seed is not None, (
         "flash_attention dropout_rate > 0 requires a dropout_seed")
     assert pltpu is not None, "in-kernel dropout needs the pallas TPU backend"
-    seed = jnp.asarray(dropout_seed, jnp.int32).reshape((1,))
+    seed = jnp.asarray(dropout_seed, jnp.int32).reshape(-1)
+    if seed.size == 1:  # legacy scalar seed: widen with a zero hi word
+        seed = jnp.concatenate([seed, jnp.zeros((1,), jnp.int32)])
+    assert seed.size == 2, f"dropout_seed must be 1 or 2 int32 words, got {seed.size}"
     return ((seed,), (pl.BlockSpec(memory_space=pltpu.SMEM),),
             float(dropout_rate))
 
@@ -398,8 +395,45 @@ def _grid_params(interpret):
         vmem_limit_bytes=100 * 1024 * 1024)}
 
 
+def _jnp_flash_reference(q, k, v, kv_mask, causal):
+    """Dense jnp forward with the kernels' exact masking semantics —
+    the scratch-free interpret-mode path for CPU-only jax builds where
+    ``jax.experimental.pallas.tpu`` is unimportable (O(s²) memory, test
+    shapes only).  Returns (out [b,s,h,d], lse [b·h, 1, s])."""
+    b, s, h, d = q.shape
+    kv_len = k.shape[1]
+    scale = 1.0 / math.sqrt(d)
+    sc = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                    k.astype(jnp.float32)) * scale
+    if causal:
+        q_idx = jnp.arange(s)[:, None]
+        k_idx = jnp.arange(kv_len)[None, :]
+        sc = jnp.where((q_idx >= k_idx)[None, None], sc, NEG_INF)
+    if kv_mask is not None:
+        sc = jnp.where(kv_mask.astype(jnp.float32)[:, None, None, :] > 0.0,
+                       sc, NEG_INF)
+    m = jnp.maximum(jnp.max(sc, axis=-1, keepdims=True), MAX_FLOOR)
+    p = jnp.exp(sc - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = jnp.einsum("bhqk,bkhd->bqhd", (p / l_safe).astype(v.dtype), v)
+    lse = (m + jnp.log(l_safe))[..., 0].reshape(b * h, 1, s)
+    return out.astype(q.dtype), lse
+
+
 def _flash_fwd(q, k, v, kv_mask, dropout_seed, causal, block_q, block_k,
                interpret, dropout_rate):
+    if pltpu is None:
+        if not interpret:
+            raise RuntimeError(
+                "flash_attention needs jax.experimental.pallas.tpu for "
+                "compiled kernels, which this jax build could not import — "
+                "use attn_impl='auto' on a CPU backend (XLA attention) "
+                "instead")
+        assert not dropout_rate, (
+            "in-kernel dropout needs the pallas TPU backend (hardware PRNG)")
+        out, lse = _jnp_flash_reference(q, k, v, kv_mask, causal)
+        return out, (q, k, v, kv_mask, dropout_seed, out, lse)
     b, s, h, d = q.shape
     kv_len = k.shape[1]
     block_q, block_k = _resolve_blocks(s, kv_len, d, block_q, block_k)
@@ -460,6 +494,14 @@ def _flash_fwd_rule(q, k, v, kv_mask, dropout_seed, causal, block_q, block_k,
 
 def _flash_bwd_rule(causal, block_q, block_k, interpret, dropout_rate, res, g):
     q, k, v, kv_mask, dropout_seed, out, lse = res
+    if pltpu is None:  # interpret fallback (see _flash_fwd); no dropout
+        dq, dk, dv = jax.vjp(
+            lambda q_, k_, v_: _jnp_flash_reference(q_, k_, v_, kv_mask,
+                                                    causal)[0],
+            q, k, v)[1](g)
+        return (dq, dk, dv,
+                jnp.zeros_like(kv_mask) if kv_mask is not None else None,
+                None)
     b, s, h, d = q.shape
     kv_len = k.shape[1]
     block_q, block_k = _resolve_blocks(s, kv_len, d, block_q, block_k)

@@ -257,9 +257,12 @@ class PipelineEngine(DeepSpeedEngine):
             model.activation_checkpoint_interval = ckpt_interval
             log_dist(f"pipeline config: activation_checkpoint_interval="
                      f"{ckpt_interval}", ranks=[0])
-        part = pipe_cfg.get("partition", "best")
-        if part not in ("best", None) and model.partition_method == "parameters":
-            model.partition_method = part
+        # None = key absent (distinct from any explicit value, so an
+        # explicit "best" is honored rather than read as the unset sentinel)
+        part = pipe_cfg.get("partition")
+        if part is not None and model.partition_method == "parameters":
+            # "best" is the config-level alias for parameter-balanced
+            model.partition_method = "parameters" if part == "best" else part
             log_dist(f"pipeline config: partition={part}", ranks=[0])
         self.micro_batches = self.gradient_accumulation_steps()
         # one pipelined forward/backward covers the whole global batch

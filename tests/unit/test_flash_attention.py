@@ -189,3 +189,38 @@ def test_flash_dropout_matches_explicit_mask_reference():
     other = flash_attention(q, k, v, dropout_seed=jnp.asarray(7, jnp.int32),
                             dropout_rate=RATE)
     assert not jnp.array_equal(out_f, other)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_interpret_without_pallas_tpu_package(monkeypatch, causal):
+    """CPU-only jax builds (no ``jax.experimental.pallas.tpu``) must still
+    serve interpret-mode flash attention — fwd and grads — via the
+    scratch-free jnp path, and compiled calls must raise the real reason."""
+    from deepspeed_tpu.ops.transformer import flash_attention as fa
+
+    monkeypatch.setattr(fa, "pltpu", None)
+    monkeypatch.setattr(fa, "_VMEM", None)
+    b, s = 2, 256
+    q, k, v = rand_qkv(b, s, 2, 64, seed=11)
+    kvm, additive = padding_masks(b, s, [256, 100])
+    out = fa.flash_attention(q, k, v, kv_mask=kvm, causal=causal,
+                             interpret=True)
+    out_ref = reference_attention(q, k, v, mask=additive, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out_ref),
+                               atol=2e-5, rtol=2e-5)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(
+            fn(q, k, v, kv_mask=kvm, causal=causal) ** 2)
+
+    g = jax.grad(loss(lambda *a, **kw: fa.flash_attention(
+        *a, interpret=True, **kw)), argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss(lambda *a, **kw: reference_attention(
+        a[0], a[1], a[2], mask=additive, causal=causal)), argnums=(0, 1, 2))(
+            q, k, v)
+    for gf, gr, name in zip(g, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   atol=5e-4, rtol=5e-4,
+                                   err_msg=f"d{name} mismatch")
+    with pytest.raises(RuntimeError, match="pallas.tpu"):
+        fa.flash_attention(q, k, v, interpret=False)
