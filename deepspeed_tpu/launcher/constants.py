@@ -3,6 +3,7 @@
 PDSH_LAUNCHER = "pdsh"
 SSH_LAUNCHER = "ssh"
 OPENMPI_LAUNCHER = "openmpi"
+MVAPICH_LAUNCHER = "mvapich"
 
 DEFAULT_HOSTFILE = "/job/hostfile"
 DEFAULT_MASTER_PORT = 29500

@@ -25,7 +25,9 @@ import sys
 
 from ..utils.logging import logger
 from .constants import (DEFAULT_HOSTFILE, DEFAULT_MASTER_PORT,
-                        DEFAULT_PROCS_PER_NODE, PDSH_LAUNCHER, SSH_LAUNCHER)
+                        DEFAULT_PROCS_PER_NODE, ENV_COORDINATOR,
+                        ENV_NUM_PROCESSES, MVAPICH_LAUNCHER,
+                        OPENMPI_LAUNCHER, PDSH_LAUNCHER, SSH_LAUNCHER)
 
 
 def parse_args(args=None):
@@ -48,7 +50,8 @@ def parse_args(args=None):
                         help="coordinator address (default: first node)")
     parser.add_argument("--master_port", type=int, default=DEFAULT_MASTER_PORT)
     parser.add_argument("--launcher", type=str, default=PDSH_LAUNCHER,
-                        choices=[PDSH_LAUNCHER, SSH_LAUNCHER])
+                        choices=[PDSH_LAUNCHER, SSH_LAUNCHER,
+                                 OPENMPI_LAUNCHER, MVAPICH_LAUNCHER])
     parser.add_argument("--force_multi", action="store_true",
                         help="treat as multi-node even for one host")
     parser.add_argument("user_script", type=str)
@@ -189,6 +192,104 @@ class SSHRunner(MultiNodeRunner):
         return cmds
 
 
+class MPIRunnerBase(MultiNodeRunner):
+    """MPI-scheduled transports (reference ``multinode_runner.py:77-190``).
+
+    Unlike pdsh/ssh, mpirun launches every RANK directly (no per-node
+    spawner): the user script runs once per process and
+    ``utils/distributed.init_distributed`` resolves its process id/count
+    from the MPI environment (``OMPI_COMM_WORLD_RANK`` / ``MV2_COMM_WORLD_
+    RANK``) while the coordinator address rides an exported ``DS_*`` var.
+    """
+
+    #: env exported to every rank ({} overridden per backend)
+    exports = {}
+
+    def __init__(self, args, active, master_addr):
+        super().__init__(args, active, master_addr)
+        assert not (args.include or args.exclude), (
+            f"{self.name} backend does not support worker include/exclusion "
+            "(mpirun owns placement via the hostfile)")
+
+    def backend_exists(self):
+        raise NotImplementedError
+
+    def rank_env(self):
+        total = sum(len(s) for s in self.active.values())
+        return {
+            ENV_COORDINATOR: f"{self.master_addr}:{self.args.master_port}",
+            ENV_NUM_PROCESSES: str(total),
+            **self.exports,
+        }
+
+    def _write_hostfile(self, line_fn):
+        """A per-invocation hostfile derived from the FILTERED resource set
+        (``--num_nodes``/``--num_procs`` trims and the no-hostfile hostname
+        fallback must reach mpirun, so the user's raw hostfile path can't be
+        passed through).  A mkstemp path, not a fixed /tmp name: concurrent
+        launches on one login host must not clobber each other's placement,
+        and a fixed world-writable path is a symlink hazard."""
+        import tempfile
+
+        fd, path = tempfile.mkstemp(prefix="deepspeed_mpi_hostfile_",
+                                    suffix=".txt", text=True)
+        with os.fdopen(fd, "w") as f:
+            for host, slots in self.active.items():
+                f.write(line_fn(host, len(slots)) + "\n")
+        return path
+
+
+class OpenMPIRunner(MPIRunnerBase):
+    name = OPENMPI_LAUNCHER
+    exports = {"UCX_TLS": "tcp"}
+
+    def backend_exists(self):
+        import shutil
+
+        return shutil.which("ompi_info") is not None
+
+    def commands(self):
+        total = sum(len(s) for s in self.active.values())
+        hostfile = self._write_hostfile(lambda h, n: f"{h} slots={n}")
+        cmd = ["mpirun", "-n", str(total), "-hostfile", hostfile,
+               "--mca", "btl", "^openib"]
+        for k, v in self.rank_env().items():
+            cmd += ["-x", f"{k}={v}"]
+        cmd += [sys.executable, "-u", self.args.user_script,
+                *self.args.user_args]
+        return [cmd]
+
+
+class MVAPICHRunner(MPIRunnerBase):
+    name = MVAPICH_LAUNCHER
+    # force TCP-over-IB semantics off; TPU pods rendezvous over plain TCP
+    exports = {"MV2_SMP_USE_CMA": "0", "MV2_DEBUG_SHOW_BACKTRACE": "1"}
+
+    def backend_exists(self):
+        import shutil
+
+        return shutil.which("mpiname") is not None
+
+    def commands(self):
+        counts = [len(s) for s in self.active.values()]
+        total = sum(counts)
+        assert all(c == counts[0] for c in counts), (
+            "mvapich requires the same process count on every node")
+        hostfile = self._write_hostfile(lambda h, n: h)
+        cmd = ["mpirun", "-np", str(total), "-ppn", str(counts[0]),
+               "--hostfile", hostfile]
+        for k, v in self.rank_env().items():
+            # Hydra's -env consumes TWO tokens: name, value
+            cmd += ["-env", k, v]
+        cmd += [sys.executable, "-u", self.args.user_script,
+                *self.args.user_args]
+        return [cmd]
+
+
+_RUNNERS = {PDSH_LAUNCHER: PDSHRunner, SSH_LAUNCHER: SSHRunner,
+            OPENMPI_LAUNCHER: OpenMPIRunner, MVAPICH_LAUNCHER: MVAPICHRunner}
+
+
 def main(argv=None):
     args = parse_args(argv)
     pool = fetch_hostfile(args.hostfile)
@@ -209,13 +310,17 @@ def main(argv=None):
     logger.info(f"launching on {active} (coordinator {master_addr}:"
                 f"{args.master_port})")
 
-    if len(active) == 1 and not args.force_multi:
+    if (len(active) == 1 and not args.force_multi
+            and args.launcher in (PDSH_LAUNCHER, SSH_LAUNCHER)):
         cmd = build_launch_cmd(args, active, 0, master_addr)
         result = subprocess.call(cmd)
         sys.exit(result)
 
-    runner = (PDSHRunner if args.launcher == PDSH_LAUNCHER else SSHRunner)(
-        args, active, master_addr)
+    runner = _RUNNERS[args.launcher](args, active, master_addr)
+    if isinstance(runner, MPIRunnerBase) and not runner.backend_exists():
+        raise RuntimeError(
+            f"--launcher={args.launcher} requested but its mpirun toolchain "
+            "was not found on PATH")
     procs = [subprocess.Popen(c) for c in runner.commands()]
     rc = 0
     for p in procs:

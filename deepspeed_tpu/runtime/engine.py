@@ -732,6 +732,15 @@ class DeepSpeedEngine:
             assert not (fp16 and dynamic), (
                 "OneBitAdam's compressed phase does not support fp16 dynamic "
                 "loss scaling; use bf16 (TPU-native) or a static scale")
+            if clip > 0.0:
+                # momentum consensus replaces the gradient exchange, so no
+                # global grad norm exists to clip against — silently
+                # different behavior from the dense phase unless flagged
+                logger.warning(
+                    "OneBitAdam: gradient_clipping=%s applies only to the "
+                    "warmup (dense) phase; the compressed phase exchanges "
+                    "1-bit momenta and cannot clip by global grad norm "
+                    "(matches reference onebit_adam.py behavior)", clip)
             self._train_step_compressed_fn = optimizer.build_compressed_step(
                 mesh=mesh, loss_fn=self._loss_fn, flat_coordinator=self.flat,
                 param_template=self._param_template,

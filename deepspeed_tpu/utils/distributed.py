@@ -14,6 +14,35 @@ from .logging import logger
 
 _initialized = False
 
+# MPI-scheduled launches (--launcher=openmpi|mvapich) skip the per-node
+# spawner: mpirun starts each rank directly, so rank/world-size come from
+# the MPI library's environment — the analog of the reference's mpi4py
+# discovery (``distributed.py:12-142``).  Ordered by specificity.
+_MPI_RANK_VARS = ("OMPI_COMM_WORLD_RANK", "MV2_COMM_WORLD_RANK", "PMI_RANK")
+_MPI_SIZE_VARS = ("OMPI_COMM_WORLD_SIZE", "MV2_COMM_WORLD_SIZE", "PMI_SIZE")
+
+
+def _first_env(names):
+    for n in names:
+        if n in os.environ:
+            return int(os.environ[n])
+    return None
+
+
+def _resolve_env(mpi=True):
+    """(coordinator, num_processes, process_id) from the launcher's DS_*
+    contract, falling back to MPI env for mpirun-scheduled ranks."""
+    coordinator = os.environ.get("DS_COORDINATOR")
+    num = int(os.environ.get("DS_NUM_PROCESSES", "0") or 0)
+    pid = (int(os.environ["DS_PROCESS_ID"])
+           if "DS_PROCESS_ID" in os.environ else None)
+    if mpi:
+        if not num:
+            num = _first_env(_MPI_SIZE_VARS) or 0
+        if pid is None:
+            pid = _first_env(_MPI_RANK_VARS)
+    return coordinator, num, pid
+
 
 def init_distributed(dist_backend: str = "xla",
                      auto_mpi_discovery: bool = True,
@@ -32,10 +61,10 @@ def init_distributed(dist_backend: str = "xla",
         return
     import jax
 
-    coordinator_address = coordinator_address or os.environ.get("DS_COORDINATOR")
-    num_processes = num_processes or int(os.environ.get("DS_NUM_PROCESSES", "0") or 0)
-    process_id = process_id if process_id is not None else (
-        int(os.environ["DS_PROCESS_ID"]) if "DS_PROCESS_ID" in os.environ else None)
+    env_c, env_n, env_p = _resolve_env(mpi=auto_mpi_discovery)
+    coordinator_address = coordinator_address or env_c
+    num_processes = num_processes or env_n
+    process_id = process_id if process_id is not None else env_p
 
     if coordinator_address and num_processes > 1:
         if verbose:

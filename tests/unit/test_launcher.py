@@ -126,3 +126,90 @@ def test_two_process_cli_launch(tmp_path):
     l0 = (tmp_path / "rank0.ok").read_text()
     l1 = (tmp_path / "rank1.ok").read_text()
     assert l0 == l1, f"ranks diverged: {l0} vs {l1}"
+
+
+def _mpi_args(hostfile, launcher, include=""):
+    from deepspeed_tpu.launcher.runner import parse_args
+
+    argv = ["-H", str(hostfile), "--launcher", launcher]
+    if include:
+        argv += ["--include", include]
+    argv += ["train.py", "--lr", "0.1"]
+    return parse_args(argv)
+
+
+def test_openmpi_runner_command(tmp_path):
+    """--launcher=openmpi builds one mpirun line that starts every RANK
+    directly (no per-node spawner) and exports the DS_* rendezvous env
+    (reference multinode_runner.py:77-107)."""
+    from deepspeed_tpu.launcher.runner import OpenMPIRunner
+
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("worker-0 slots=2\nworker-1 slots=2\n")
+    args = _mpi_args(hostfile, "openmpi")
+    # the DERIVED resource set (worker-1 trimmed to 1 slot) must reach
+    # mpirun, not the raw user hostfile
+    active = {"worker-0": [0, 1], "worker-1": [0]}
+    (cmd,) = OpenMPIRunner(args, active, "worker-0").commands()
+    assert cmd[:3] == ["mpirun", "-n", "3"]
+    derived = cmd[cmd.index("-hostfile") + 1]
+    assert derived != str(hostfile)
+    with open(derived) as f:
+        assert f.read().splitlines() == ["worker-0 slots=2",
+                                         "worker-1 slots=1"]
+    joined = " ".join(cmd)
+    assert "-x DS_COORDINATOR=worker-0:29500" in joined
+    assert "-x DS_NUM_PROCESSES=3" in joined
+    # ranks run the user script directly under python -u
+    assert cmd[-3:] == ["train.py", "--lr", "0.1"]
+    assert "deepspeed_tpu.launcher.launch" not in joined
+    os.unlink(derived)
+
+
+def test_mvapich_runner_command(tmp_path):
+    from deepspeed_tpu.launcher.runner import MVAPICHRunner
+
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("a slots=2\nb slots=2\n")
+    args = _mpi_args(hostfile, "mvapich")
+    (cmd,) = MVAPICHRunner(args, {"a": [0, 1], "b": [0, 1]},
+                           "a").commands()
+    assert cmd[:5] == ["mpirun", "-np", "4", "-ppn", "2"]
+    derived = cmd[cmd.index("--hostfile") + 1]
+    with open(derived) as f:
+        assert f.read().split() == ["a", "b"]
+    # Hydra's -env takes name and value as SEPARATE tokens
+    i = cmd.index("-env")
+    assert cmd[i + 1] == "DS_COORDINATOR" and cmd[i + 2] == "a:29500"
+    os.unlink(derived)
+
+
+def test_mpi_runner_rejects_include(tmp_path):
+    from deepspeed_tpu.launcher.runner import OpenMPIRunner
+
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("a slots=2\n")
+    args = _mpi_args(hostfile, "openmpi", include="a:0")
+    with pytest.raises(AssertionError, match="include"):
+        OpenMPIRunner(args, {"a": [0]}, "a")
+
+
+def test_init_distributed_mpi_env_fallback(monkeypatch):
+    """mpirun-scheduled ranks have no DS_PROCESS_ID; rank/size must come
+    from the MPI library env (the reference's mpi4py discovery analog)."""
+    from deepspeed_tpu.utils.distributed import _resolve_env
+
+    for var in ("DS_NUM_PROCESSES", "DS_PROCESS_ID"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("DS_COORDINATOR", "host0:29500")
+    monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "3")
+    monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "8")
+    assert _resolve_env() == ("host0:29500", 8, 3)
+    # DS_* takes precedence over MPI env when both are set
+    monkeypatch.setenv("DS_NUM_PROCESSES", "4")
+    monkeypatch.setenv("DS_PROCESS_ID", "1")
+    assert _resolve_env() == ("host0:29500", 4, 1)
+    # auto_mpi_discovery=False ignores the MPI env entirely
+    monkeypatch.delenv("DS_NUM_PROCESSES")
+    monkeypatch.delenv("DS_PROCESS_ID")
+    assert _resolve_env(mpi=False) == ("host0:29500", 0, None)
