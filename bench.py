@@ -48,7 +48,10 @@ DEFAULT_PEAK_TFLOPS = 990.0
 def bert_model_flops_per_sample(cfg, seq):
     """Analytic fwd+bwd model FLOPs per sample (2x for matmul, 3x total with
     backward), mirroring the accounting of the reference flops profiler
-    (``deepspeed/profiling/flops_profiler/profiler.py``)."""
+    (``deepspeed/profiling/flops_profiler/profiler.py``).  When the MLM
+    head gathers labeled positions (``max_predictions_per_seq``), the head
+    term counts only the gathered positions — the FLOPs actually executed —
+    so MFU stays honest as the model gets cheaper."""
     h, i, L, v = (cfg.hidden_size, cfg.intermediate_size,
                   cfg.num_hidden_layers, cfg.vocab_size)
     per_layer = (
@@ -57,9 +60,36 @@ def bert_model_flops_per_sample(cfg, seq):
         + 2 * seq * h * h          # attn out
         + 2 * seq * h * i * 2      # FC1 + FC2
     )
-    head = 2 * seq * h * h + 2 * seq * h * v  # MLM transform + vocab proj
+    n_head = min(cfg.max_predictions_per_seq or seq, seq)
+    head = 2 * n_head * h * h + 2 * n_head * h * v  # MLM transform + vocab proj
     fwd = L * per_layer + head
     return 3 * fwd  # bwd ~= 2x fwd
+
+
+def gpt2_model_flops_per_sample(cfg, seq):
+    """GPT-2 fwd+bwd model FLOPs per sample.  The causal flash kernel skips
+    upper-triangle score blocks, so attention score/context FLOPs count at
+    half the dense matmul — the FLOPs actually executed."""
+    h, L, v = cfg.hidden_size, cfg.num_layers, cfg.vocab_size
+    per_layer = (
+        2 * seq * h * 3 * h            # QKV
+        + 2 * seq * seq * h * 2 // 2   # scores + context (causal half)
+        + 2 * seq * h * h              # attn out
+        + 2 * seq * h * 4 * h * 2      # FC1 + FC2
+    )
+    head = 2 * seq * h * v  # tied LM head over every position
+    return 3 * (L * per_layer + head)
+
+
+def exact_count_mlm_labels(rng, ids, n_pred):
+    """Labels with EXACTLY n_pred masked positions per row — the bing_bert
+    data contract the gather head assumes (max_predictions_per_seq)."""
+    b, s = ids.shape
+    labels = np.full((b, s), -100, np.int32)
+    for r in range(b):
+        pos = rng.permutation(s)[:n_pred]
+        labels[r, pos] = ids[r, pos]
+    return labels
 
 
 def chip_peak_tflops(device):
@@ -94,9 +124,14 @@ def main():
         "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
         "bf16": {"enabled": True},
     }
+    # 20 = bing_bert's max_predictions_per_seq at seq 128; the MLM head
+    # gathers these positions before the vocab projection (~8% of step
+    # FLOPs saved vs projecting all 128)
+    n_pred = int(os.environ.get("BENCH_MAX_PRED", "20"))
     bert_cfg = BertConfig.bert_large(max_position_embeddings=512, vocab_size=VOCAB,
                                      hidden_dropout_prob=dropout_p,
-                                     attention_probs_dropout_prob=dropout_p)
+                                     attention_probs_dropout_prob=dropout_p,
+                                     max_predictions_per_seq=n_pred or None)
     model = BertForPreTrainingTPU(bert_cfg, compute_dtype=None)
     engine, *_ = deepspeed.initialize(model=model, config=config, mesh=mesh)
 
@@ -106,8 +141,8 @@ def main():
         "input_ids": ids,
         "attention_mask": np.ones((batch, SEQ), np.int32),
         "token_type_ids": np.zeros((batch, SEQ), np.int32),
-        "masked_lm_labels": np.where(rng.random((batch, SEQ)) < 0.15, ids,
-                                     -100).astype(np.int32),
+        "masked_lm_labels": exact_count_mlm_labels(rng, ids, n_pred or
+                                                   int(SEQ * 0.15)),
         "next_sentence_labels": rng.integers(0, 2, size=(batch,)).astype(np.int32),
     }
 
@@ -158,18 +193,90 @@ def main():
         "device": getattr(dev, "device_kind", str(dev)),
     }
 
+    # HBM discipline: each engine holds ~5 GB of master+optimizer state for
+    # these model sizes; three co-resident engines exhaust a 16 GB chip.
+    # Free the primary before the secondaries run.
+    import gc
+
+    del engine, model, b
+    gc.collect()
+
     # Secondary: the reference's seq-512 row (52 samples/s on V100).  The
     # flash kernel (tuned blocks + in-kernel PRNG dropout) carries this
     # config; BENCH_SEQ512=0 skips.  Guarded so a secondary failure (OOM on
     # a smaller chip, compile error) can never lose the validated primary
-    # metric above.
-    try:
-        _measure_seq512(record, deepspeed, BertConfig, BertForPreTrainingTPU,
-                        mesh, config, rng, steps, warmup, dropout_p, peak)
-    except Exception as e:  # pragma: no cover - depends on chip
-        record["seq512_error"] = f"secondary run failed: {e!r:.300}"
+    # metric above.  One retry: this environment's remote compile service
+    # sporadically 500s.
+    for attempt in (1, 2):
+        try:
+            _measure_seq512(record, deepspeed, BertConfig,
+                            BertForPreTrainingTPU, mesh, config, rng, steps,
+                            warmup, dropout_p, peak)
+            record.pop("seq512_exc", None)
+            break
+        except Exception as e:  # pragma: no cover - depends on chip
+            record["seq512_exc"] = f"secondary run failed (try {attempt}): {e!r:.300}"
+            gc.collect()
+
+    # Tertiary: a causal-LM row (3 of the 5 BASELINE configs are GPT-2
+    # class).  GPT-2-medium 355M, seq 1024, the BASELINE #3 shape: ZeRO
+    # stage 2 + Lamb + bf16 (degenerate but real at dp=1).  Same guard
+    # discipline as seq-512.
+    for attempt in (1, 2):
+        try:
+            _measure_gpt2(record, deepspeed, mesh, rng, steps, warmup,
+                          dropout_p, peak)
+            record.pop("gpt2_exc", None)
+            break
+        except Exception as e:  # pragma: no cover - depends on chip
+            record["gpt2_exc"] = f"gpt2 run failed (try {attempt}): {e!r:.300}"
+            gc.collect()
 
     print(json.dumps(record))
+
+
+def _measure_gpt2(record, deepspeed, mesh, rng, steps, warmup, dropout_p,
+                  peak):
+    import jax
+
+    from deepspeed_tpu.models import GPT2Config, GPT2LMHeadTPU
+
+    if os.environ.get("BENCH_GPT2", "1") == "0":
+        return
+    bg = int(os.environ.get("BENCH_GPT2_BATCH", "8"))
+    seq = 1024
+    g_steps = max(steps // 3, 5)
+    cfg = GPT2Config(hidden_size=1024, num_layers=24, num_heads=16,
+                     max_position_embeddings=seq,
+                     embd_dropout=dropout_p, attn_dropout=dropout_p,
+                     resid_dropout=dropout_p)
+    model = GPT2LMHeadTPU(cfg, compute_dtype=None)
+    engine, *_ = deepspeed.initialize(
+        model=model, mesh=mesh,
+        config={"train_batch_size": bg, "steps_per_print": 10 ** 9,
+                "optimizer": {"type": "Lamb", "params": {"lr": 1e-4}},
+                "zero_optimization": {"stage": 2},
+                "bf16": {"enabled": True}})
+    ids = rng.integers(0, cfg.vocab_size, size=(bg, seq)).astype(np.int32)
+    batch = {"input_ids": ids}
+    for _ in range(max(warmup // 2, 1)):
+        loss = engine.train_batch(iter([batch]))
+    float(jax.device_get(loss))
+    t0 = time.perf_counter()
+    for _ in range(g_steps):
+        loss = engine.train_batch(iter([batch]))
+    final = float(jax.device_get(loss))
+    dt = time.perf_counter() - t0
+    sps = bg * g_steps / dt
+    mfu = sps * gpt2_model_flops_per_sample(cfg, seq) / 1e12 / peak
+    if mfu > 1.0 or not math.isfinite(final):
+        record["gpt2_error"] = f"invalid measurement: mfu={mfu:.2f} loss={final}"
+    else:
+        record["gpt2_medium_seq1024_samples_per_sec"] = round(sps, 2)
+        record["gpt2_medium_tokens_per_sec"] = round(sps * seq, 0)
+        record["gpt2_mfu"] = round(mfu, 4)
+        record["gpt2_batch"] = bg
+    del engine, model
 
 
 def _measure_seq512(record, deepspeed, BertConfig, BertForPreTrainingTPU,
@@ -179,10 +286,12 @@ def _measure_seq512(record, deepspeed, BertConfig, BertForPreTrainingTPU,
     if os.environ.get("BENCH_SEQ512", "1") != "0":
         b512 = int(os.environ.get("BENCH_SEQ512_BATCH", "16"))
         s512_steps = max(steps // 3, 5)
+        # 80 = bing_bert's max_predictions_per_seq at seq 512
         cfg512 = BertConfig.bert_large(
             max_position_embeddings=512, vocab_size=VOCAB,
             hidden_dropout_prob=dropout_p,
-            attention_probs_dropout_prob=dropout_p)
+            attention_probs_dropout_prob=dropout_p,
+            max_predictions_per_seq=80)
         model512 = BertForPreTrainingTPU(cfg512, compute_dtype=None)
         eng512, *_ = deepspeed.initialize(
             model=model512, config=dict(config, train_batch_size=b512),
@@ -192,8 +301,7 @@ def _measure_seq512(record, deepspeed, BertConfig, BertForPreTrainingTPU,
             "input_ids": ids512,
             "attention_mask": np.ones((b512, 512), np.int32),
             "token_type_ids": np.zeros((b512, 512), np.int32),
-            "masked_lm_labels": np.where(rng.random((b512, 512)) < 0.15,
-                                         ids512, -100).astype(np.int32),
+            "masked_lm_labels": exact_count_mlm_labels(rng, ids512, 80),
             "next_sentence_labels": rng.integers(
                 0, 2, size=(b512,)).astype(np.int32),
         }
@@ -217,6 +325,7 @@ def _measure_seq512(record, deepspeed, BertConfig, BertForPreTrainingTPU,
             record["seq512_vs_baseline"] = round(
                 sps512 / BASELINE_SEQ512_SAMPLES_PER_SEC, 3)
             record["seq512_mfu"] = round(mfu512, 4)
+        del eng512, model512
 
 
 if __name__ == "__main__":

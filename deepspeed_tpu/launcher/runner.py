@@ -207,6 +207,7 @@ class MPIRunnerBase(MultiNodeRunner):
 
     def __init__(self, args, active, master_addr):
         super().__init__(args, active, master_addr)
+        self._tmp_files = []
         assert not (args.include or args.exclude), (
             f"{self.name} backend does not support worker include/exclusion "
             "(mpirun owns placement via the hostfile)")
@@ -236,7 +237,16 @@ class MPIRunnerBase(MultiNodeRunner):
         with os.fdopen(fd, "w") as f:
             for host, slots in self.active.items():
                 f.write(line_fn(host, len(slots)) + "\n")
+        self._tmp_files.append(path)
         return path
+
+    def cleanup(self):
+        for path in self._tmp_files:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        self._tmp_files = []
 
 
 class OpenMPIRunner(MPIRunnerBase):
@@ -326,6 +336,8 @@ def main(argv=None):
     for p in procs:
         p.wait()
         rc = rc or p.returncode
+    if hasattr(runner, "cleanup"):
+        runner.cleanup()
     sys.exit(rc)
 
 

@@ -34,7 +34,7 @@ class BertConfig:
                  layer_norm_eps=1e-12, remat=False,
                  attn_impl="auto", sparsity_config=None,
                  gelu_checkpoint=False, attn_dropout_checkpoint=False,
-                 normalize_invertible=False):
+                 normalize_invertible=False, max_predictions_per_seq=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_hidden_layers = num_hidden_layers
@@ -55,6 +55,14 @@ class BertConfig:
         self.gelu_checkpoint = gelu_checkpoint
         self.attn_dropout_checkpoint = attn_dropout_checkpoint
         self.normalize_invertible = normalize_invertible
+        # MLM head masked-position gather: when set, the transform + vocab
+        # projection run over only this many gathered positions per row
+        # instead of all of them (~15% of positions carry labels — the
+        # projection over the other 85% is wasted FLOPs, ~8% of the step at
+        # seq 128).  Must be >= the per-row masked count the data pipeline
+        # produces (bing_bert's max_predictions_per_seq contract); rows
+        # with more labels than this have the excess silently ignored.
+        self.max_predictions_per_seq = max_predictions_per_seq
 
     @staticmethod
     def bert_base(**kw):
@@ -198,9 +206,14 @@ class BertForPreTrainingTPU:
         return params
 
     def sparse_gradient_paths(self):
-        """Embedding leaves with row-sparse gradients (the reference's
-        nn.Embedding auto-detect, ``engine.py:180-185``)."""
-        return ("bert/embeddings/word", "bert/embeddings/token_type")
+        """Embedding leaves with genuinely row-sparse gradients (the
+        reference's nn.Embedding auto-detect, ``engine.py:180-185``).  The
+        word embedding does NOT qualify here: the MLM decoder ties to it
+        (``apply``), and the vocab projection's backward puts gradient on
+        EVERY vocab row — a row-sparse exchange would drop most of it (the
+        engine poisons such a step with NaN rather than train silently
+        wrong).  The untied heads (QA, classification) do declare it."""
+        return ("bert/embeddings/token_type",)
 
     def partition_specs(self, mesh):
         has_model = "model" in mesh.axis_names
@@ -225,17 +238,33 @@ class BertForPreTrainingTPU:
             dtype=self.compute_dtype)
 
         cls = params["cls"]
-        h = gelu(dense(cls["transform"], seq_out))
+        mlm_labels = batch.get("masked_lm_labels")
+        head_in = seq_out
+        n_pred = c.max_predictions_per_seq
+        if (mlm_labels is not None and n_pred
+                and n_pred < input_ids.shape[1]):
+            # Gather the labeled positions before the head: only ~15% of
+            # positions carry MLM labels, so the vocab projection over the
+            # rest is pure waste (the reference pays it; this is the
+            # fused-kernel philosophy applied to the head instead).  top_k
+            # of the label mask is stable, so it selects the FIRST n_pred
+            # labeled positions; unlabeled fill positions gather a -100
+            # label and are ignored by the loss.
+            is_masked = (mlm_labels != -100).astype(jnp.int32)
+            _, pos = jax.lax.top_k(is_masked, n_pred)  # [b, n_pred]
+            head_in = jnp.take_along_axis(seq_out, pos[..., None], axis=1)
+            mlm_labels = jnp.take_along_axis(mlm_labels, pos, axis=1)
+        h = gelu(dense(cls["transform"], head_in))
         h = layer_norm(cls["transform_ln"], h, c.layer_norm_eps)
         # decoder tied to word embeddings (standard BERT; the reference ties
         # them through TiedLayerSpec under pipelining, module.py:71)
         logits = h @ params["bert"]["embeddings"]["word"].T.astype(h.dtype) \
             + cls["decoder_bias"].astype(h.dtype)
 
-        if not train and "masked_lm_labels" not in batch:
+        if not train and mlm_labels is None:
             return logits
 
-        mlm_loss = cross_entropy_with_logits(logits, batch["masked_lm_labels"],
+        mlm_loss = cross_entropy_with_logits(logits, mlm_labels,
                                              ignore_index=-100)
         loss = mlm_loss
         if "next_sentence_labels" in batch:
@@ -260,6 +289,11 @@ class BertForQuestionAnsweringTPU:
         self.config = config
         self.bert = BertModel(config)
         self.compute_dtype = compute_dtype
+
+    def sparse_gradient_paths(self):
+        # no tied LM head here, so the word embedding's grad really is
+        # row-sparse (only token rows touched)
+        return ("bert/embeddings/word", "bert/embeddings/token_type")
 
     def init(self, rng):
         k1, k2 = jax.random.split(rng)
@@ -313,6 +347,10 @@ class BertForSequenceClassificationTPU:
         self.num_labels = num_labels
         self.bert = BertModel(config)
         self.compute_dtype = compute_dtype
+
+    def sparse_gradient_paths(self):
+        # untied trunk (see BertForQuestionAnsweringTPU)
+        return ("bert/embeddings/word", "bert/embeddings/token_type")
 
     def init(self, rng):
         k1, k2 = jax.random.split(rng)

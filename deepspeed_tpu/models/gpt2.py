@@ -126,9 +126,14 @@ class GPT2LMHeadTPU:
         }
 
     def sparse_gradient_paths(self):
-        """Embedding leaves with row-sparse gradients (the reference's
-        nn.Embedding auto-detect, ``engine.py:180-185``)."""
-        return ("wte", "wpe")
+        """Embedding leaves with genuinely row-sparse gradients (the
+        reference's nn.Embedding auto-detect, ``engine.py:180-185``).
+        ``wte`` does NOT qualify: the LM head ties to it, and the vocab
+        projection's backward puts gradient mass on EVERY vocab row, so a
+        row-sparse exchange would drop most of it (the engine would poison
+        the step with NaN).  ``wpe`` rows are all touched every step, so
+        there is nothing to compress either."""
+        return ()
 
     def partition_specs(self, mesh):
         c = self.config

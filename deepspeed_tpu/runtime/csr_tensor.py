@@ -26,10 +26,18 @@ class CSRTensor(NamedTuple):
     dense_shape: tuple    # (rows, cols)
 
     @classmethod
-    def from_dense(cls, dense, max_rows=None):
+    def from_dense(cls, dense, max_rows=None, return_dropped=False):
         """Compress a dense [rows, cols] tensor with few non-zero rows.
         ``max_rows`` fixes the nnz budget for jit-static shapes (defaults
-        to all rows — no compression, still valid)."""
+        to all rows — no compression, still valid).
+
+        A budget smaller than the true support keeps the top-``max_rows``
+        rows by mass and DROPS the rest — a silent gradient error unless
+        the caller sized the budget from a hard bound (e.g. tokens per
+        batch for embedding grads).  ``return_dropped=True`` additionally
+        returns the number of nonzero rows that did not fit, so callers
+        without such a bound can detect overflow (and e.g. fall back to
+        dense or grow the budget)."""
         rows, cols = dense.shape
         k = max_rows or rows
         norms = jnp.sum(jnp.abs(dense), axis=1)
@@ -37,9 +45,13 @@ class CSRTensor(NamedTuple):
         _, idx = jax.lax.top_k(norms, k)
         vals = jnp.take(dense, idx, axis=0)
         # mark all-zero rows as padding so duplicates of row 0 don't arise
-        pad = jnp.where(jnp.sum(jnp.abs(vals), axis=1) > 0, idx.astype(jnp.int32),
-                        jnp.int32(rows))
-        return cls(indices=pad, values=vals, dense_shape=(rows, cols))
+        kept_nz = jnp.sum(jnp.abs(vals), axis=1) > 0
+        pad = jnp.where(kept_nz, idx.astype(jnp.int32), jnp.int32(rows))
+        csr = cls(indices=pad, values=vals, dense_shape=(rows, cols))
+        if return_dropped:
+            dropped = jnp.sum(norms > 0) - jnp.sum(kept_nz)
+            return csr, dropped.astype(jnp.int32)
+        return csr
 
     def to_dense(self):
         rows, cols = self.dense_shape

@@ -85,16 +85,141 @@ def test_engine_sparse_gradients_wiring(cpu_devices):
 
 
 def test_model_declares_sparse_paths():
+    """Tied-head leaves must NOT be declared row-sparse: the vocab
+    projection's backward puts gradient mass on every row, so a CSR
+    exchange would drop most of it.  Only genuinely lookup-only embeddings
+    qualify."""
     from deepspeed_tpu.models import (BertConfig, BertForPreTrainingTPU,
                                       GPT2Config, GPT2LMHeadTPU)
+    from deepspeed_tpu.models.bert import (BertForQuestionAnsweringTPU,
+                                           BertForSequenceClassificationTPU)
 
-    bert = BertForPreTrainingTPU(BertConfig(vocab_size=64, hidden_size=16,
-                                            num_hidden_layers=1,
-                                            num_attention_heads=2,
-                                            intermediate_size=32,
-                                            max_position_embeddings=16))
-    assert "bert/embeddings/word" in bert.sparse_gradient_paths()
+    cfg = BertConfig(vocab_size=64, hidden_size=16, num_hidden_layers=1,
+                     num_attention_heads=2, intermediate_size=32,
+                     max_position_embeddings=16)
+    # pretraining head ties decoder → word embedding grad is dense
+    assert "bert/embeddings/word" not in BertForPreTrainingTPU(
+        cfg).sparse_gradient_paths()
+    # untied heads: the word embedding really is row-sparse
+    assert "bert/embeddings/word" in BertForQuestionAnsweringTPU(
+        cfg).sparse_gradient_paths()
+    assert "bert/embeddings/word" in BertForSequenceClassificationTPU(
+        cfg).sparse_gradient_paths()
     gpt = GPT2LMHeadTPU(GPT2Config(vocab_size=64, hidden_size=16,
                                    num_layers=1, num_heads=2,
                                    max_position_embeddings=16))
-    assert "wte" in gpt.sparse_gradient_paths()
+    assert "wte" not in gpt.sparse_gradient_paths()  # tied LM head
+
+
+def test_from_dense_overflow_detection():
+    """A budget smaller than the true support must be detectable: the
+    dropped-row count comes back alongside the compressed tensor."""
+    d = _sparse_dense(touched=(1, 5, 9, 13, 21))  # support = 5 rows
+    csr, dropped = CSRTensor.from_dense(jnp.asarray(d), max_rows=3,
+                                        return_dropped=True)
+    assert int(dropped) == 2
+    csr, dropped = CSRTensor.from_dense(jnp.asarray(d), max_rows=8,
+                                        return_dropped=True)
+    assert int(dropped) == 0
+    np.testing.assert_allclose(np.asarray(csr.to_dense()), d, rtol=1e-6)
+
+
+class TinyEmbModel:
+    """Embedding + linear readout: the smallest model whose word-embedding
+    gradient is genuinely row-sparse (only touched token rows are nonzero)."""
+
+    VOCAB, HID, SEQ = 64, 8, 4
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        return {"emb": jax.random.normal(k1, (self.VOCAB, self.HID)) * 0.1,
+                "w": jax.random.normal(k2, (self.HID,)) * 0.1}
+
+    def sparse_gradient_paths(self):
+        return ("emb",)
+
+    def apply(self, params, batch, rng=None, train=True, **kw):
+        x = jnp.take(params["emb"], batch["input_ids"], axis=0)  # [B,s,h]
+        pred = x @ params["w"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+
+def _emb_batches(n, b):
+    rng = np.random.default_rng(0)
+    out = []
+    for _ in range(n):
+        out.append({
+            "input_ids": rng.integers(
+                0, TinyEmbModel.VOCAB,
+                size=(b, TinyEmbModel.SEQ)).astype(np.int32),
+            "y": rng.normal(size=(b, TinyEmbModel.SEQ)).astype(np.float32),
+        })
+    return out
+
+
+def _train_emb(cpu_devices, sparse, steps=4, dp=4):
+    mesh = make_mesh({"data": dp}, devices=cpu_devices[:dp])
+    config = base_config(sparse_gradients=sparse)
+    engine, *_ = deepspeed.initialize(model=TinyEmbModel(), config=config,
+                                      mesh=mesh)
+    losses = []
+    for batch in _emb_batches(steps, 8):
+        losses.append(float(np.asarray(engine.train_batch(iter([batch])))))
+    return losses, np.asarray(engine.state["master"])
+
+
+def test_sparse_gradients_numerics_match_dense(cpu_devices, monkeypatch):
+    """sparse_gradients=True must change the PROGRAM (declared embedding
+    grads ride csr_allreduce with a tokens-sized nnz, not a vocab-sized
+    dense exchange) while matching the dense path's numerics."""
+    from deepspeed_tpu.runtime import csr_tensor
+
+    calls = []
+    real = csr_tensor.csr_allreduce
+
+    def spy(csr, axis_name):
+        calls.append((csr.nnz, csr.dense_shape))
+        return real(csr, axis_name)
+
+    monkeypatch.setattr(csr_tensor, "csr_allreduce", spy)
+
+    losses_dense, master_dense = _train_emb(cpu_devices, sparse=False)
+    assert not calls, "dense path must not touch the sparse exchange"
+    losses_sparse, master_sparse = _train_emb(cpu_devices, sparse=True)
+
+    # the traced program contained the sparse exchange, with the wire
+    # budget bounded by tokens-per-local-batch (8/4 rows * 4 tokens = 8),
+    # far under the 64-row dense exchange
+    assert calls, "sparse path never traced csr_allreduce"
+    nnz, shape = calls[0]
+    assert shape == (TinyEmbModel.VOCAB, TinyEmbModel.HID)
+    assert nnz == 8 < TinyEmbModel.VOCAB
+
+    np.testing.assert_allclose(losses_sparse, losses_dense, rtol=1e-5)
+    np.testing.assert_allclose(master_sparse, master_dense, rtol=1e-4,
+                               atol=1e-6)
+
+
+class TinyTiedModel(TinyEmbModel):
+    """Readout TIES to the embedding — its grad is dense over all rows, so
+    declaring it sparse is a model bug the engine must surface loudly."""
+
+    def apply(self, params, batch, rng=None, train=True, **kw):
+        x = jnp.take(params["emb"], batch["input_ids"], axis=0)  # [B,s,h]
+        logits = x @ params["emb"].T  # tied head: dense grad on emb
+        return jnp.mean(logits ** 2)
+
+
+def test_sparse_gradients_tied_head_fails_loud(cpu_devices):
+    """A declared-sparse leaf whose gradient overflows the token budget
+    must poison the step with NaN (loud) instead of silently training on
+    truncated gradients."""
+    mesh = make_mesh({"data": 4}, devices=cpu_devices[:4])
+    engine, *_ = deepspeed.initialize(model=TinyTiedModel(),
+                                      config=base_config(sparse_gradients=True),
+                                      mesh=mesh)
+    batch = _emb_batches(1, 8)[0]
+    engine.train_batch(iter([batch]))
+    master = np.asarray(engine.state["master"])
+    assert np.isnan(master).any(), (
+        "tied-head overflow was silently dropped instead of poisoning")

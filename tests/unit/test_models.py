@@ -228,3 +228,45 @@ def test_memory_knobs_preserve_loss():
     knobbed = losses(gelu_checkpoint=True, attn_dropout_checkpoint=True,
                      normalize_invertible=True)
     np.testing.assert_allclose(base, knobbed, rtol=2e-5)
+
+
+def test_bert_mlm_gather_head_loss_parity():
+    """`max_predictions_per_seq` gathers labeled positions before the vocab
+    projection (a pure-FLOPs saving); when every row's label count fits the
+    budget, the loss must be bit-comparable to the full-head computation."""
+    import jax.numpy as jnp
+
+    cfg_kw = dict(vocab_size=VOCAB, hidden_size=32, num_hidden_layers=2,
+                  num_attention_heads=4, max_position_embeddings=SEQ,
+                  hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    full = BertForPreTrainingTPU(BertConfig(**cfg_kw))
+    gathered = BertForPreTrainingTPU(
+        BertConfig(max_predictions_per_seq=8, **cfg_kw))
+    params = full.init(jax.random.PRNGKey(0))
+
+    rng = np.random.default_rng(3)
+    b = bert_batch(rng, 4)
+    # exactly 5 labeled positions per row (within the 8-position budget)
+    ids = b["input_ids"]
+    labels = np.full_like(ids, -100)
+    for r in range(ids.shape[0]):
+        pos = rng.permutation(SEQ)[:5]
+        labels[r, pos] = ids[r, pos]
+    b["masked_lm_labels"] = labels
+
+    loss_full = full.apply(params, b, train=True)
+    loss_gather = gathered.apply(params, b, train=True)
+    np.testing.assert_allclose(np.asarray(loss_gather),
+                               np.asarray(loss_full), rtol=1e-6)
+
+    # rows with MORE labels than the budget keep the first n_pred (stable
+    # top_k) — the loss stays finite and close, never NaN
+    over = BertForPreTrainingTPU(BertConfig(max_predictions_per_seq=4,
+                                            **cfg_kw))
+    loss_over = over.apply(params, b, train=True)
+    assert np.isfinite(np.asarray(loss_over))
+
+    # inference without labels still returns full-sequence logits
+    b_nolabel = {k: v for k, v in b.items() if k != "masked_lm_labels"}
+    logits = gathered.apply(params, b_nolabel, train=False)
+    assert logits.shape == (4, SEQ, VOCAB)
