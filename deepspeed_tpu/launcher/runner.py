@@ -331,13 +331,16 @@ def main(argv=None):
         raise RuntimeError(
             f"--launcher={args.launcher} requested but its mpirun toolchain "
             "was not found on PATH")
-    procs = [subprocess.Popen(c) for c in runner.commands()]
-    rc = 0
-    for p in procs:
-        p.wait()
-        rc = rc or p.returncode
-    if hasattr(runner, "cleanup"):
-        runner.cleanup()
+    try:
+        procs = [subprocess.Popen(c) for c in runner.commands()]
+        rc = 0
+        for p in procs:
+            p.wait()
+            rc = rc or p.returncode
+    finally:
+        # temp hostfiles must not leak on Ctrl-C / launch failure either
+        if hasattr(runner, "cleanup"):
+            runner.cleanup()
     sys.exit(rc)
 
 

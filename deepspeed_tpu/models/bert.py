@@ -212,8 +212,11 @@ class BertForPreTrainingTPU:
         (``apply``), and the vocab projection's backward puts gradient on
         EVERY vocab row — a row-sparse exchange would drop most of it (the
         engine poisons such a step with NaN rather than train silently
-        wrong).  The untied heads (QA, classification) do declare it."""
-        return ("bert/embeddings/token_type",)
+        wrong).  The 2-row token_type table can never beat its own exchange
+        overhead either, so the pretraining model declares NOTHING — the
+        engine then keeps the plain GSPMD path.  The untied heads (QA,
+        classification) do declare the word embedding."""
+        return ()
 
     def partition_specs(self, mesh):
         has_model = "model" in mesh.axis_names

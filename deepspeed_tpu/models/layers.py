@@ -173,14 +173,34 @@ class TransformerLayer:
             ctx = ring_attention(q, k, v, causal=self.causal,
                                  key_padding_mask=kpm_add)
         elif self.attn_impl == "sparse":
-            from ..ops.sparse_attention import block_sparse_attention
+            import os as _os
 
-            ctx = block_sparse_attention(
-                q, k, v, self._sparse_layout(s),
-                causal=self.causal or getattr(
-                    self.sparsity_config, "attention",
-                    "bidirectional") == "unidirectional",
-                key_padding_mask=kpm_add, attn_mask=None)
+            layout = self._sparse_layout(s)
+            causal_sp = self.causal or getattr(
+                self.sparsity_config, "attention",
+                "bidirectional") == "unidirectional"
+            # Pallas LUT-driven kernel on TPU when the layout blocks are
+            # MXU-shaped and no key-padding mask is needed; the gather
+            # implementation stays as the general/CPU path.
+            # DS_SPARSE_FLASH=never forces the gather path.
+            blk = s // layout.shape[1]
+            use_kernel = (kpm_add is None
+                          and jax.default_backend() == "tpu"
+                          and blk % 128 == 0 and q.shape[-1] % 64 == 0
+                          and _os.environ.get("DS_SPARSE_FLASH",
+                                              "auto") != "never")
+            if use_kernel:
+                from ..ops.sparse_attention.flash_block_sparse import (
+                    flash_block_sparse_attention)
+
+                ctx = flash_block_sparse_attention(q, k, v, layout,
+                                                   causal=causal_sp)
+            else:
+                from ..ops.sparse_attention import block_sparse_attention
+
+                ctx = block_sparse_attention(
+                    q, k, v, layout, causal=causal_sp,
+                    key_padding_mask=kpm_add, attn_mask=None)
         else:
             ctx = dot_product_attention(
                 q, k, v, mask=mask, key_padding_mask=key_padding_mask,

@@ -1,0 +1,351 @@
+"""Pallas block-sparse flash attention for TPU.
+
+TPU-native analog of the reference's Triton block-sparse kernel stack
+(``ops/sparse_attention/matmul.py`` SDD/DSD/DDS + ``softmax.py`` +
+``trsrc/*.tr``, with the C++ LUT builder ``csrc/sparse_attention/
+utils.cpp``).  The reference compiles look-up tables that map nonzero
+layout blocks to kernel work items; here the same LUTs are built host-side
+from the ``[H, nb, nb]`` layout and fed to the Mosaic kernel as
+scalar-prefetch operands: the grid's streaming dimension runs over the
+per-(head, q-block) ACTIVE key blocks only, and each ``BlockSpec`` index
+map reads the LUT to decide which K/V block to DMA next.  Compute and HBM
+traffic scale with the number of active blocks — O(s·w) — while the inner
+loop is the flash-attention online softmax on MXU-shaped ``[blk, blk]``
+tiles (the dense flash kernel's recurrence, ``ops/transformer/
+flash_attention.py``, restricted to the layout).
+
+Backward runs the standard flash recurrence with the same LUT trick; the
+dk/dv kernel streams over a host-side TRANSPOSED LUT (for each key block,
+the q-blocks that attend to it).
+
+No in-kernel dropout (compose ``TransformerLayer``'s output dropout) and
+no key-padding mask in v1 — the gather-based ``block_sparse.py`` remains
+the fully-general reference implementation and the CPU path.
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from ..transformer.flash_attention import (MAX_FLOOR, NEG_INF, _VMEM,
+                                           _flatten_heads, _unflatten_heads,
+                                           pltpu)
+
+
+def build_block_luts(layout):
+    """Host-side LUTs from a ``[H, nb, nb]`` 0/1 layout (the analog of the
+    reference's ``make_lut``, ``softmax.py:22`` / ``matmul.py:27``).
+
+    Returns ``(lut, cnt, tlut, tcnt)``:
+      - ``lut[h, qb, t]``: t-th active key-block for query block qb
+        (``cnt[h, qb]`` valid entries, zero-padded);
+      - ``tlut[h, kb, t]``: t-th query block attending to key block kb
+        (``tcnt[h, kb]`` valid entries) — the transposed layout, for dk/dv.
+    """
+    layout = np.asarray(layout) != 0
+    h, nb, nb2 = layout.shape
+    assert nb == nb2, f"layout must be square, got {layout.shape}"
+    kmax = max(1, int(layout.sum(-1).max()))
+    qmax = max(1, int(layout.sum(-2).max()))
+    lut = np.zeros((h, nb, kmax), np.int32)
+    cnt = np.zeros((h, nb), np.int32)
+    tlut = np.zeros((h, nb, qmax), np.int32)
+    tcnt = np.zeros((h, nb), np.int32)
+    for hi in range(h):
+        for qb in range(nb):
+            cols = np.nonzero(layout[hi, qb])[0]
+            lut[hi, qb, :len(cols)] = cols
+            cnt[hi, qb] = len(cols)
+        for kb in range(nb):
+            rows = np.nonzero(layout[hi, :, kb])[0]
+            tlut[hi, kb, :len(rows)] = rows
+            tcnt[hi, kb] = len(rows)
+    return lut, cnt, tlut, tcnt
+
+
+def _layout_head(i, heads, n_layout_heads):
+    """Layout-head index for flat batch·head grid index ``i``."""
+    if n_layout_heads == 1:
+        return 0
+    return jax.lax.rem(i, heads)
+
+
+def _tile_scores(q_blk, k_blk, scale, causal, j, kb, blk):
+    s = jax.lax.dot_general(q_blk, k_blk, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_idx = j * blk + jax.lax.broadcasted_iota(jnp.int32, (blk, blk), 0)
+        k_idx = kb * blk + jax.lax.broadcasted_iota(jnp.int32, (blk, blk), 1)
+        s = jnp.where(q_idx >= k_idx, s, NEG_INF)
+    return s
+
+
+def _fwd_kernel(lut_ref, cnt_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_sc, l_sc, acc_sc, *, scale, causal, heads, n_layout_heads,
+                blk):
+    i, j, t = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    n_t = pl.num_programs(2)
+    lh = _layout_head(i, heads, n_layout_heads)
+
+    @pl.when(t == 0)
+    def _init():
+        m_sc[...] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[...] = jnp.zeros_like(l_sc)
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+
+    @pl.when(t < cnt_ref[lh, j])
+    def _step():
+        kb = lut_ref[lh, j, t]
+        s = _tile_scores(q_ref[0], k_ref[0], scale, causal, j, kb, blk)
+        m, l = m_sc[...], l_sc[...]
+        m_new = jnp.maximum(jnp.maximum(m, jnp.max(s, axis=1, keepdims=True)),
+                            MAX_FLOOR)
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l_sc[...] = l * corr + jnp.sum(p, axis=1, keepdims=True)
+        m_sc[...] = m_new
+        acc_sc[...] = acc_sc[...] * corr + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(t == n_t - 1)
+    def _finalize():
+        # rows with no active key block (cnt == 0, or causal-masked away)
+        # produce zero output, matching the gather reference's guard
+        l = l_sc[...]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_sc[...] / l_safe).astype(o_ref.dtype)
+        lse_ref[0, 0] = (m_sc[...] + jnp.log(l_safe))[:, 0]
+
+
+def _bwd_dq_kernel(lut_ref, cnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                   delta_ref, dq_ref, dq_sc, *, scale, causal, heads,
+                   n_layout_heads, blk):
+    i, j, t = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    n_t = pl.num_programs(2)
+    lh = _layout_head(i, heads, n_layout_heads)
+
+    @pl.when(t == 0)
+    def _init():
+        dq_sc[...] = jnp.zeros_like(dq_sc)
+
+    @pl.when(t < cnt_ref[lh, j])
+    def _step():
+        kb = lut_ref[lh, j, t]
+        s = _tile_scores(q_ref[0], k_ref[0], scale, causal, j, kb, blk)
+        p = jnp.exp(s - lse_ref[0, 0][:, None])
+        dp = jax.lax.dot_general(do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta_ref[0, 0][:, None])).astype(k_ref.dtype)
+        dq_sc[...] = dq_sc[...] + jax.lax.dot_general(
+            ds, k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(t == n_t - 1)
+    def _finalize():
+        dq_ref[0] = (dq_sc[...] * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(tlut_ref, tcnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                    delta_ref, dk_ref, dv_ref, dk_sc, dv_sc, *, scale, causal,
+                    heads, n_layout_heads, blk):
+    # grid (bh, k blocks, q slots): q streams via the transposed LUT
+    i, kb, t = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    n_t = pl.num_programs(2)
+    lh = _layout_head(i, heads, n_layout_heads)
+
+    @pl.when(t == 0)
+    def _init():
+        dk_sc[...] = jnp.zeros_like(dk_sc)
+        dv_sc[...] = jnp.zeros_like(dv_sc)
+
+    @pl.when(t < tcnt_ref[lh, kb])
+    def _step():
+        j = tlut_ref[lh, kb, t]
+        s = _tile_scores(q_ref[0], k_ref[0], scale, causal, j, kb, blk)
+        p = jnp.exp(s - lse_ref[0, 0][:, None])  # [blk_q, blk_k] fp32
+        dp = jax.lax.dot_general(do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        dv_sc[...] = dv_sc[...] + jax.lax.dot_general(
+            p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta_ref[0, 0][:, None])).astype(q_ref.dtype)
+        dk_sc[...] = dk_sc[...] + jax.lax.dot_general(
+            ds, q_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(t == n_t - 1)
+    def _finalize():
+        dk_ref[0] = (dk_sc[...] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_sc[...].astype(dv_ref.dtype)
+
+
+def _grid_params(interpret):
+    if pltpu is None or interpret:
+        return {}
+    return {"compiler_params": pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"),
+        vmem_limit_bytes=100 * 1024 * 1024)}
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8))
+def _fbs_attention(q, k, v, lut, cnt, tlut, tcnt, causal, interpret):
+    out, _ = _fbs_fwd(q, k, v, lut, cnt, tlut, tcnt, causal, interpret)
+    return out
+
+
+def _fbs_fwd(q, k, v, lut, cnt, tlut, tcnt, causal, interpret):
+    b, s, h, d = q.shape
+    H, nb, kmax = lut.shape
+    blk = s // nb
+    scale = 1.0 / math.sqrt(d)
+    qf, kf, vf = _flatten_heads(q), _flatten_heads(k), _flatten_heads(v)
+    bh = b * h
+
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               heads=h, n_layout_heads=H, blk=blk)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(bh, nb, kmax),
+            in_specs=[
+                pl.BlockSpec((1, blk, d), lambda i, j, t, lut_r, cnt_r: (i, j, 0)),
+                pl.BlockSpec((1, blk, d), lambda i, j, t, lut_r, cnt_r:
+                             (i, lut_r[_layout_head(i, h, H), j, t], 0)),
+                pl.BlockSpec((1, blk, d), lambda i, j, t, lut_r, cnt_r:
+                             (i, lut_r[_layout_head(i, h, H), j, t], 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, blk, d), lambda i, j, t, lut_r, cnt_r: (i, j, 0)),
+                pl.BlockSpec((1, 1, blk), lambda i, j, t, lut_r, cnt_r: (i, 0, j)),
+            ],
+            scratch_shapes=[
+                _VMEM((blk, 1), jnp.float32),
+                _VMEM((blk, 1), jnp.float32),
+                _VMEM((blk, d), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, s), jnp.float32),
+        ],
+        interpret=interpret,
+        **_grid_params(interpret),
+    )(lut, cnt, qf, kf, vf)
+    outh = _unflatten_heads(out, b, h)
+    return outh, (q, k, v, lut, cnt, tlut, tcnt, outh, lse)
+
+
+def _fbs_bwd(causal, interpret, res, g):
+    q, k, v, lut, cnt, tlut, tcnt, out, lse = res
+    b, s, h, d = q.shape
+    H, nb, kmax = lut.shape
+    qmax = tlut.shape[-1]
+    blk = s // nb
+    scale = 1.0 / math.sqrt(d)
+    bh = b * h
+
+    qf, kf, vf = _flatten_heads(q), _flatten_heads(k), _flatten_heads(v)
+    dof, of = _flatten_heads(g), _flatten_heads(out)
+    delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32), axis=-1,
+                    keepdims=True).transpose(0, 2, 1)  # [bh, 1, s]
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          heads=h, n_layout_heads=H, blk=blk),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(bh, nb, kmax),
+            in_specs=[
+                pl.BlockSpec((1, blk, d), lambda i, j, t, lut_r, cnt_r: (i, j, 0)),
+                pl.BlockSpec((1, blk, d), lambda i, j, t, lut_r, cnt_r:
+                             (i, lut_r[_layout_head(i, h, H), j, t], 0)),
+                pl.BlockSpec((1, blk, d), lambda i, j, t, lut_r, cnt_r:
+                             (i, lut_r[_layout_head(i, h, H), j, t], 0)),
+                pl.BlockSpec((1, blk, d), lambda i, j, t, lut_r, cnt_r: (i, j, 0)),
+                pl.BlockSpec((1, 1, blk), lambda i, j, t, lut_r, cnt_r: (i, 0, j)),
+                pl.BlockSpec((1, 1, blk), lambda i, j, t, lut_r, cnt_r: (i, 0, j)),
+            ],
+            out_specs=pl.BlockSpec((1, blk, d),
+                                   lambda i, j, t, lut_r, cnt_r: (i, j, 0)),
+            scratch_shapes=[_VMEM((blk, d), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        interpret=interpret,
+        **_grid_params(interpret),
+    )(lut, cnt, qf, kf, vf, dof, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          heads=h, n_layout_heads=H, blk=blk),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(bh, nb, qmax),
+            in_specs=[
+                pl.BlockSpec((1, blk, d), lambda i, kb, t, tlut_r, tcnt_r:
+                             (i, tlut_r[_layout_head(i, h, H), kb, t], 0)),
+                pl.BlockSpec((1, blk, d), lambda i, kb, t, tlut_r, tcnt_r: (i, kb, 0)),
+                pl.BlockSpec((1, blk, d), lambda i, kb, t, tlut_r, tcnt_r: (i, kb, 0)),
+                pl.BlockSpec((1, blk, d), lambda i, kb, t, tlut_r, tcnt_r:
+                             (i, tlut_r[_layout_head(i, h, H), kb, t], 0)),
+                pl.BlockSpec((1, 1, blk), lambda i, kb, t, tlut_r, tcnt_r:
+                             (i, 0, tlut_r[_layout_head(i, h, H), kb, t])),
+                pl.BlockSpec((1, 1, blk), lambda i, kb, t, tlut_r, tcnt_r:
+                             (i, 0, tlut_r[_layout_head(i, h, H), kb, t])),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, blk, d),
+                             lambda i, kb, t, tlut_r, tcnt_r: (i, kb, 0)),
+                pl.BlockSpec((1, blk, d),
+                             lambda i, kb, t, tlut_r, tcnt_r: (i, kb, 0)),
+            ],
+            scratch_shapes=[
+                _VMEM((blk, d), jnp.float32),
+                _VMEM((blk, d), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, s, d), v.dtype),
+        ],
+        interpret=interpret,
+        **_grid_params(interpret),
+    )(tlut, tcnt, qf, kf, vf, dof, lse, delta)
+
+    return (_unflatten_heads(dq, b, h), _unflatten_heads(dk, b, h),
+            _unflatten_heads(dv, b, h), None, None, None, None)
+
+
+_fbs_attention.defvjp(_fbs_fwd, _fbs_bwd)
+
+
+def flash_block_sparse_attention(q, k, v, layout, causal=False,
+                                 interpret=False):
+    """Block-sparse flash attention on ``[b, s, h, d]`` inputs.
+
+    ``layout`` is the ``[H, nb, nb]`` 0/1 block layout (H == heads, or 1 for
+    a shared layout) produced by ``sparsity_config.make_layout``.  Layout
+    block size should be >= 128 for MXU efficiency (the reference's Triton
+    kernels use 16/32/64 blocks; TPU tiles want 128 lanes).
+
+    Requires the Mosaic PRNG-free feature set only; on CPU builds without
+    ``jax.experimental.pallas.tpu``, use the gather-based
+    ``block_sparse_attention`` instead.
+    """
+    assert pltpu is not None, (
+        "flash_block_sparse_attention needs jax.experimental.pallas.tpu; "
+        "use block_sparse_attention (gather-based) on CPU-only builds")
+    b, s, h, d = q.shape
+    layout = np.asarray(layout)
+    nb = layout.shape[1]
+    assert s % nb == 0, f"seq {s} not divisible into {nb} blocks"
+    assert layout.shape[0] in (1, h), (
+        f"layout heads {layout.shape[0]} incompatible with {h} heads")
+    lut, cnt, tlut, tcnt = (jnp.asarray(a) for a in build_block_luts(layout))
+    return _fbs_attention(q, k, v, lut, cnt, tlut, tcnt, bool(causal),
+                          bool(interpret))

@@ -96,14 +96,17 @@ def _keep_mask(seed_ref, i, j, kb, shape, thresh):
 
     Seeding the hardware PRNG with (seed words, tile coordinates) makes the
     draw a pure function of the tile, so the backward kernels regenerate the
-    exact forward mask.  Mosaic's ``prng_seed`` mixes any number of seed
-    words, so the 64-bit user seed (two int32 words — a single 32-bit
-    per-step seed would birthday-collide after ~65k steps) and the three
-    coordinates each get their own word: distinct tiles cannot alias the
-    way a single wraparound coordinate hash could.
+    exact forward mask.  This Mosaic toolchain accepts AT MOST two seed
+    words (a third reliably crashes its compiler — measured), so the 64-bit
+    user seed (two int32 words; a single 32-bit per-step seed would
+    birthday-collide after ~65k steps) XOR-folds with the coordinates:
+    ``bh`` into word 0 and ``(j, kb)`` packed EXACTLY into word 1
+    (``j*2^15 + kb`` — both block counts stay far below 2^15 for every
+    supported shape), so distinct tiles cannot alias the way a wraparound
+    multiplicative hash could.
     """
-    pltpu.prng_seed(seed_ref[0], seed_ref[1],
-                    jnp.int32(i), jnp.int32(j), jnp.int32(kb))
+    tile = jnp.int32(j) * jnp.int32(1 << 15) + jnp.int32(kb)
+    pltpu.prng_seed(seed_ref[0] ^ jnp.int32(i), seed_ref[1] ^ tile)
     bits = jax.lax.bitcast_convert_type(
         pltpu.prng_random_bits(shape), jnp.uint32)
     return bits >= jnp.uint32(thresh)

@@ -593,6 +593,16 @@ class DeepSpeedEngine:
         dp_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get(
             DATA_AXIS, 1)
 
+        if sparse_paths:
+            # fp16's overflow-skip machinery reads any non-finite gradient
+            # as an ordinary overflow and silently skips the step — it
+            # would swallow the loud-NaN overflow poison below forever.
+            # bf16/fp32 (the TPU-native paths) propagate NaN to the loss.
+            assert not fp16, (
+                "sparse_gradients does not compose with fp16 loss scaling "
+                "(overflow-skip would mask budget-overflow detection); use "
+                "bf16 or fp32")
+
         def sparse_loss_and_flat_grads(params, batch, rng, cur_scale, extra):
             """The ``sparse_gradients`` step path (reference
             ``engine.py:1203-1241``): fwd+bwd run rank-local under shard_map
@@ -600,7 +610,14 @@ class DeepSpeedEngine:
             row-sparse (indices, values) pairs — ``tokens-per-local-batch``
             rows on the wire instead of ``vocab`` rows — while every other
             leaf takes an ordinary pmean.  GSPMD can't express this (its
-            gradient reduction is implicit), hence the manual region."""
+            gradient reduction is implicit), hence the manual region.
+
+            Semantics note: the step loss is the equal-weight pmean of the
+            per-rank means.  For losses normalized by a data-dependent
+            count (e.g. MLM cross entropy over per-row masked counts) this
+            differs from the dense path's single global normalization
+            unless every rank carries the same count — which the bing_bert
+            ``max_predictions_per_seq`` data contract guarantees."""
             from .csr_tensor import CSRTensor, csr_allreduce
 
             def exchange(grads, batch_):
@@ -624,7 +641,11 @@ class DeepSpeedEngine:
                         csr, dropped = CSRTensor.from_dense(
                             g, max_rows=budget, return_dropped=True)
                         summed = csr_allreduce(csr, DATA_AXIS) / dp_size
-                        poison = jnp.where(dropped > 0, jnp.nan, 0.0)
+                        # psum first: the poison must be REPLICATED (the
+                        # out_specs claim it), even when only a subset of
+                        # ranks overflowed their local budget
+                        any_dropped = jax.lax.psum(dropped, DATA_AXIS)
+                        poison = jnp.where(any_dropped > 0, jnp.nan, 0.0)
                         out.append(summed + poison.astype(summed.dtype))
                     else:
                         out.append(jax.lax.pmean(g, DATA_AXIS))

@@ -24,10 +24,35 @@ import pytest  # noqa: E402
 # os.environ here is too late — jax.config.update is the only switch
 # that still takes effect, and it avoids initializing (and dialing) the
 # TPU backend at all.  An explicit non-axon JAX_PLATFORMS (e.g. a
-# developer running the suite on real hardware) is honored.
-if os.environ.get("JAX_PLATFORMS", "axon") in ("axon", "", "axon,cpu"):
+# developer running the suite on real hardware) is honored, and
+# DS_TEST_TPU=1 opts in to the real accelerator for the ``-m tpu``
+# compiled-kernel suite (``DS_TEST_TPU=1 pytest -m tpu``).
+_want_tpu = os.environ.get("DS_TEST_TPU") == "1"
+if (not _want_tpu
+        and os.environ.get("JAX_PLATFORMS", "axon") in ("axon", "",
+                                                        "axon,cpu")):
     jax.config.update("jax_platforms", "cpu")
     os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses tests spawn
+
+
+def _tpu_usable():
+    """Whether a real TPU device can actually run work — gate for the
+    ``tpu`` marker (checking devices, not jax.default_backend(): the
+    platform pinning above makes the default backend CPU either way)."""
+    try:
+        return len(jax.devices("tpu")) > 0
+    except Exception:
+        return False
+
+
+def pytest_collection_modifyitems(config, items):
+    if _tpu_usable():
+        return
+    skip_tpu = pytest.mark.skip(
+        reason="needs a usable TPU (run: DS_TEST_TPU=1 pytest -m tpu)")
+    for item in items:
+        if "tpu" in item.keywords:
+            item.add_marker(skip_tpu)
 
 
 @pytest.fixture(scope="session")
@@ -38,8 +63,15 @@ def cpu_devices():
 
 
 @pytest.fixture(autouse=True)
-def _default_cpu():
-    """Run unsharded computations on CPU regardless of the default backend."""
+def _default_cpu(request):
+    """Run unsharded computations on CPU regardless of the default backend —
+    EXCEPT for ``-m tpu`` tests, which exist precisely to exercise compiled
+    kernels on the real chip (pinning them to CPU made pallas_call fail
+    with 'Only interpret mode is supported on CPU backend')."""
+    if request.node.get_closest_marker("tpu"):
+        with jax.default_device(jax.devices("tpu")[0]):
+            yield
+        return
     cpu0 = jax.devices("cpu")[0]
     with jax.default_device(cpu0):
         yield
