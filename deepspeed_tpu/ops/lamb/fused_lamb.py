@@ -4,10 +4,12 @@ TPU-native equivalent of the reference's 3-phase LAMB CUDA kernel
 (``csrc/lamb/fused_lamb_cuda_kernel.cu:186-312``; Python wrapper
 ``deepspeed/ops/lamb/fused_lamb.py:12``).  The reference computes per-tensor
 weight/update norms in kernel phases 1-2 and applies the trust-ratio-scaled
-update in phase 3.  Here per-tensor norms over the flat buffer come from one
-scatter-add ``segment_sum`` pass (MXU-free, single HBM sweep), and the
-update is one fused elementwise computation — same math, two XLA kernels
-total.
+update in phase 3.  Here per-tensor norms exploit the flat layout's row
+alignment (every tensor owns whole rows): one lane-axis reduction plus a
+static slice+sum per tensor (``segment_l2_norms_rows`` — no scatter; the
+earlier element-level scatter-add ran 40x slower on TPU), and the update is
+one fused elementwise computation with a row-level trust-ratio gather —
+same math, a single HBM sweep.
 
 Under ZeRO the segment norms must span shards; the engine computes them
 under ``jit`` over the global (logically unsharded) buffer so GSPMD inserts
@@ -18,7 +20,7 @@ from typing import NamedTuple
 
 import jax.numpy as jnp
 
-from ..op_common import segment_l2_norms
+from ..op_common import segment_l2_norms_rows
 
 
 class LambState(NamedTuple):
@@ -72,7 +74,9 @@ class FusedLamb:
 
     def update(self, state: LambState, flat_master, flat_grads, hp, segments=None,
                segment_ids=None):
-        assert segments is not None and segment_ids is not None, (
+        # segment_ids (the element-level device buffer) is unused: the
+        # static row layout in `segments` carries everything needed
+        assert segments is not None, (
             "FusedLamb needs the segment descriptor for per-tensor trust ratios")
         lr, beta1, beta2, wd = hp["lr"], hp["beta1"], hp["beta2"], hp["weight_decay"]
         g = jnp.asarray(flat_grads, jnp.float32)
@@ -96,16 +100,24 @@ class FusedLamb:
         update = m_hat / denom + wd * p
 
         num_seg = segments.num_segments
-        w_norms = segment_l2_norms(p, segment_ids, num_seg)
-        u_norms = segment_l2_norms(update, segment_ids, num_seg)
+        # row-aligned fast path: the element-level scatter version ran a
+        # GPT-2-medium LAMB step 40x slower on TPU (huge scatters serialize)
+        w_norms = segment_l2_norms_rows(p, segments)
+        u_norms = segment_l2_norms_rows(update, segments)
         # trust ratio per tensor: ||w||/||u||, clamped; 1.0 where degenerate
         # (reference kernel phase 3, fused_lamb_cuda_kernel.cu:252-310).
         ratio = jnp.where((w_norms > 0) & (u_norms > 0),
                           jnp.clip(w_norms / u_norms, self.min_coeff, self.max_coeff),
                           jnp.ones_like(w_norms))
-        # Padding tail (segment id == num_seg) gets ratio 1.
+        # Padding tail (row segment id == num_seg) gets ratio 1.
         ratio_full = jnp.concatenate([ratio, jnp.ones((1,), jnp.float32)])
-        scale = ratio_full[segment_ids]
+        # Row-level gather, broadcast over lanes: an element-level
+        # ratio_full[segment_ids] gather sweeps the whole flat buffer
+        # through a variable-index gather (measured 2.6 samples/s on
+        # GPT-2-medium vs 30+ this way).  Rows are segment-pure, and
+        # intra-row padding has update == 0, so its (wrong) per-tensor
+        # ratio multiplies zero.
+        scale = ratio_full[jnp.asarray(segments.row_segment_ids())][:, None]
 
         new_p = p - lr * scale * update
         return new_p, LambState(exp_avg=m, exp_avg_sq=v, step=step)

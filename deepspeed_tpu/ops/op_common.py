@@ -56,6 +56,17 @@ class Segments(NamedTuple):
             flat[start:start + n] = i
         return ids
 
+    def row_segment_ids(self) -> np.ndarray:
+        """i32[rows] mapping each ROW to its tensor index (rows are
+        segment-pure by construction); trailing pad rows map to
+        ``num_segments``.  The row-granular analog of ``segment_ids`` —
+        1/LANES the size, enough for any per-tensor scaling that can
+        tolerate intra-row padding picking up its tensor's value."""
+        ids = np.full((self.rows,), self.num_segments, dtype=np.int32)
+        for i, (ro, rc) in enumerate(zip(self.row_offsets, self.row_counts)):
+            ids[ro:ro + rc] = i
+        return ids
+
 
 def build_segments(sizes: List[int], pad_to: int = 1) -> Segments:
     """Row-aligned segment layout; ``pad_to`` pads total rows to a multiple
@@ -75,11 +86,29 @@ def build_segments(sizes: List[int], pad_to: int = 1) -> Segments:
 
 
 def segment_l2_norms(flat: jnp.ndarray, segment_ids: jnp.ndarray, num_segments: int):
-    """Per-tensor L2 norms of the (rows, LANES) buffer in one scatter-add."""
+    """Per-tensor L2 norms of the (rows, LANES) buffer in one scatter-add.
+
+    Generic path for arbitrary id layouts.  NOTE: an element-level scatter
+    over the whole flat buffer is catastrophically slow on TPU (XLA
+    serializes large variable-index scatters — measured 0.86 samples/s on
+    GPT-2-medium LAMB vs 30+ with the row path below); flat-space callers
+    should use :func:`segment_l2_norms_rows`."""
     sq = (jnp.asarray(flat, jnp.float32) ** 2).reshape(-1)
     ids = segment_ids.reshape(-1)
     sums = jnp.zeros((num_segments + 1,), jnp.float32).at[ids].add(sq)
     return jnp.sqrt(sums[:num_segments])
+
+
+def segment_l2_norms_rows(flat: jnp.ndarray, segments) -> jnp.ndarray:
+    """Per-tensor L2 norms exploiting the flat layout's ROW alignment
+    (``build_segments``: every tensor owns whole rows; intra-row tail
+    padding is zero in params, grads, and updates).  One lane-axis
+    reduction then a static slice+sum per tensor — no scatter anywhere,
+    one sweep of HBM."""
+    row_sq = jnp.sum(jnp.asarray(flat, jnp.float32) ** 2, axis=1)
+    sums = [jnp.sum(row_sq[ro:ro + rc])
+            for ro, rc in zip(segments.row_offsets, segments.row_counts)]
+    return jnp.sqrt(jnp.stack(sums))
 
 
 def random_keep(rng, shape, rate):

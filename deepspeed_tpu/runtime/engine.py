@@ -548,9 +548,13 @@ class DeepSpeedEngine:
         dynamic = self.dynamic_loss_scale_enabled
         optimizer = self.optimizer
         segments = self.segments
-        seg_ids_needed = isinstance(optimizer, FusedLamb)
+        # No built-in optimizer needs the element-level segment_ids buffer
+        # on device anymore (FusedLamb reads the static row layout from the
+        # segments descriptor — an int32 buffer the size of the master copy
+        # was ~33% extra optimizer-state HBM); client optimizers that ask
+        # for it via a `needs_segment_ids` attribute still get it.
         self._segment_ids = None
-        if seg_ids_needed:
+        if getattr(optimizer, "needs_segment_ids", False):
             self._segment_ids = jax.device_put(
                 segments.segment_ids(), self.flat.master_sharding)
 

@@ -114,8 +114,18 @@ class OnebitAdam:
     def update(self, state: OnebitAdamState, flat_master, flat_grads, hp,
                segments=None, segment_ids=None):
         """Warmup-phase (dense) update: plain Adam without bias correction,
-        error-feedback buffers untouched (reference ``:262-304``).  The
-        engine switches to the compressed program at ``freeze_step``."""
+        error-feedback buffers untouched (reference ``:262-304``; the
+        reference skips bias correction in both phases too).  The engine
+        switches to the compressed program at ``freeze_step``.
+
+        Sharp edge (inherent to the algorithm, reference included): the
+        frozen ``exp_avg_sq`` is whatever accumulated by ``freeze_step`` —
+        with β₂ = 0.999 that is only ``1 − 0.999^t`` of the true second
+        moment, so freezing early makes every compressed-phase update
+        ``~1/sqrt(1 − β₂^t)`` times too hot and training can diverge.
+        Choose ``freeze_step`` so β₂-accumulation has saturated (the
+        reference's recipes freeze after ~23k steps), or lower β₂.
+        """
         lr, beta1, beta2, wd = hp["lr"], hp["beta1"], hp["beta2"], hp["weight_decay"]
         g = jnp.asarray(flat_grads, jnp.float32)
         p = flat_master

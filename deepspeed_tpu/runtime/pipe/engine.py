@@ -66,6 +66,9 @@ class _PipelinedModel:
 
     # -- stage partitioning (trace-time, from param shapes) --
     def _ensure_parts(self, params):
+        """Partition into ``stages × interleave`` LOGICAL stages; logical
+        stage l lives on physical rank ``l % stages`` (Megatron's cyclic
+        virtual-stage assignment)."""
         if self._parts is not None:
             return self._parts
         stages = self.engine.pipe_world_size
@@ -74,7 +77,8 @@ class _PipelinedModel:
                 f"PipelineModule(num_stages={self.module.num_stages}) but mesh "
                 f"pipe axis is {stages}")
         counts = self.module.layer_param_counts(params)
-        self._parts = self.module.partition_layers(stages, param_counts=counts)
+        self._parts = self.module.partition_layers(
+            stages * self.module.interleave, param_counts=counts)
         return self._parts
 
     def apply(self, params, batch, rng=None, train=False, **kw):
@@ -102,17 +106,28 @@ class _PipelinedModel:
             return jnp.mean(losses)
 
         parts = self._ensure_parts(params)
+        v = module.interleave
+        L = stages * v  # logical stages; logical l lives on rank l % stages
+        if v > 1:
+            assert mb_count % stages == 0, (
+                f"interleave={v} needs micro_batches ({mb_count}) divisible "
+                f"by stages ({stages}) — the schedule works in groups of "
+                f"one micro-batch per rank")
+            assert len(module.layer_specs) >= L, (
+                f"interleave={v} with {stages} stages needs >= {L} layers "
+                f"(got {len(module.layer_specs)}) — empty logical stages "
+                "would silently forfeit the bubble reduction")
 
-        # Boundary activation structure: chase shapes through the stage
-        # slices and check they agree.  Boundaries may be any PYTREE of
-        # arrays (uniform across stages) — multi-tensor carries like
+        # Boundary activation structure: chase shapes through the logical
+        # stage slices and check they agree.  Boundaries may be any PYTREE
+        # of arrays (uniform across stages) — multi-tensor carries like
         # (hidden, attention_bias) work; the reference's meta handshake
         # (pipe/engine.py:657-768) is this check, done at trace time.
         sample_in = jax.tree_util.tree_map(lambda a: a[0], inputs)
         btree = jax.eval_shape(
             lambda p, x: module.apply_range(p, 0, parts[1], x), params, sample_in)
         bstruct = jax.tree_util.tree_structure(btree)
-        for s in range(1, stages - 1):
+        for s in range(1, L - 1):
             nxt = jax.eval_shape(
                 lambda p, x: module.apply_range(p, parts[s], parts[s + 1], x),
                 params, btree)
@@ -121,8 +136,9 @@ class _PipelinedModel:
                 for a, b2 in zip(jax.tree_util.tree_leaves(nxt),
                                  jax.tree_util.tree_leaves(btree))))
             assert same, (
-                f"stage {s} boundary {nxt} != previous boundary {btree}; "
-                "pipeline stages must exchange one uniform activation pytree")
+                f"logical stage {s} boundary {nxt} != previous boundary "
+                f"{btree}; pipeline stages must exchange one uniform "
+                "activation pytree")
             btree = nxt
 
         def zeros_boundary():
@@ -134,29 +150,50 @@ class _PipelinedModel:
                 lambda a, sd: a.astype(sd.dtype), y, btree)
 
         def branch_fn(s):
-            first, last = s == 0, s == stages - 1
+            def chunk_fn(c):
+                l = c * stages + s
+                first, last = l == 0, l == L - 1
 
-            def branch(params, x_in, mb_inputs, mb_labels, valid, tick_rng):
-                x = mb_inputs if first else x_in
-                layer_kw = {"deterministic": not train}
-                if tick_rng is not None:
-                    layer_kw["rng"] = tick_rng
-                # interval=0: the engine remats whole ticks (below);
-                # nesting apply_range's per-chunk remat inside would
-                # recompute the forward twice in backward
-                y = module.apply_range(params, parts[s], parts[s + 1], x,
-                                       interval=0, **layer_kw)
-                if last:
-                    loss = module.loss_fn(y, mb_labels)
-                    loss = jnp.where(valid, loss.astype(jnp.float32), 0.0)
-                    return zeros_boundary(), loss
-                return cast_boundary(y), jnp.asarray(0.0, jnp.float32)
+                def chunk(params, x_in, mb_inputs, mb_labels, valid, tick_rng):
+                    x = mb_inputs if first else x_in
+                    layer_kw = {"deterministic": not train}
+                    if tick_rng is not None:
+                        layer_kw["rng"] = tick_rng
+                    # interval=0: the engine remats whole ticks (below);
+                    # nesting apply_range's per-chunk remat inside would
+                    # recompute the forward twice in backward
+                    y = module.apply_range(params, parts[l], parts[l + 1], x,
+                                           interval=0, **layer_kw)
+                    if last:
+                        loss = module.loss_fn(y, mb_labels)
+                        loss = jnp.where(valid, loss.astype(jnp.float32), 0.0)
+                        return zeros_boundary(), loss
+                    return cast_boundary(y), jnp.asarray(0.0, jnp.float32)
+
+                return chunk
+
+            chunks = [chunk_fn(c) for c in range(v)]
+
+            def branch(params, x_in, mb_inputs, mb_labels, valid, tick_rng, c):
+                if v == 1:
+                    return chunks[0](params, x_in, mb_inputs, mb_labels,
+                                     valid, tick_rng)
+                return jax.lax.switch(c, chunks, params, x_in, mb_inputs,
+                                      mb_labels, valid, tick_rng)
 
             return branch
 
         branches = [branch_fn(s) for s in range(stages)]
         perm = [(i, (i + 1) % stages) for i in range(stages)]
-        ticks = mb_count + stages - 1
+        # Interleaved (v > 1): ticks are CHUNK-granularity.  Work index
+        # w = t - rank; chunk c = (w//p) % v, micro = (w//(p·v))·p + w%p
+        # (groups of one micro-batch per rank).  Every producer-consumer
+        # pair is exactly one tick apart on the same ring, so one carry
+        # per rank and one ppermute per tick serve all v virtual stages.
+        # Executed ticks: v·mb + p − 1 chunk-ticks vs GPipe's (mb + p −1)·v
+        # — the fill/drain bubble (which this compiled schedule EXECUTES,
+        # masked) shrinks by ~v.
+        ticks = v * mb_count + stages - 1
 
         # Per-tick rematerialization: differentiate-through-scan saves every
         # tick's layer-internal activations by default (O(ticks·layers)
@@ -171,33 +208,35 @@ class _PipelinedModel:
             s = jax.lax.axis_index(PIPE_AXIS)
 
             def tick_compute(params, x_state, mb_inputs, mb_labels, valid,
-                             tick_rng):
+                             tick_rng, c):
                 return jax.lax.switch(s, branches, params, x_state,
-                                      mb_inputs, mb_labels, valid, tick_rng)
+                                      mb_inputs, mb_labels, valid, tick_rng, c)
 
             if per_tick_remat:
                 tick_compute = jax.checkpoint(tick_compute)
 
             def tick(carry, t):
                 x_state, loss_sum = carry
-                my_mb = t - s
-                valid = jnp.logical_and(my_mb >= 0, my_mb < mb_count)
-                in_idx = jnp.clip(t, 0, mb_count - 1)
-                lab_idx = jnp.clip(t - (stages - 1), 0, mb_count - 1)
+                w = t - s  # this rank's work index this tick
+                valid = jnp.logical_and(w >= 0, w < v * mb_count)
+                wc = jnp.clip(w, 0, v * mb_count - 1)
+                c = (wc // stages) % v
+                micro = (wc // (stages * v)) * stages + (wc % stages)
                 mb_inputs = jax.tree_util.tree_map(
-                    lambda a: jax.lax.dynamic_index_in_dim(a, in_idx, 0,
+                    lambda a: jax.lax.dynamic_index_in_dim(a, micro, 0,
                                                            keepdims=False),
                     inputs)
                 mb_labels = jax.tree_util.tree_map(
-                    lambda a: jax.lax.dynamic_index_in_dim(a, lab_idx, 0,
+                    lambda a: jax.lax.dynamic_index_in_dim(a, micro, 0,
                                                            keepdims=False),
                     labels)
-                # per-(micro-batch, stage) dropout rng, like the reference's
-                # per-buffer RNG state
-                tick_rng = (jax.random.fold_in(jax.random.fold_in(rng, my_mb), s)
+                # per-(micro-batch, logical stage) dropout rng, like the
+                # reference's per-buffer RNG state
+                tick_rng = (jax.random.fold_in(
+                    jax.random.fold_in(rng, micro), c * stages + s)
                             if rng is not None else None)
                 y, loss = tick_compute(params, x_state, mb_inputs, mb_labels,
-                                       valid, tick_rng)
+                                       valid, tick_rng, c)
                 x_next = jax.tree_util.tree_map(
                     lambda a: jax.lax.ppermute(a, PIPE_AXIS, perm), y)
                 return (x_next, loss_sum + loss), None
@@ -264,6 +303,11 @@ class PipelineEngine(DeepSpeedEngine):
             # "best" is the config-level alias for parameter-balanced
             model.partition_method = "parameters" if part == "best" else part
             log_dist(f"pipeline config: partition={part}", ranks=[0])
+        il = pipe_cfg.get("interleave")
+        if il is not None and model.interleave == 1:
+            model.interleave = max(int(il), 1)
+            log_dist(f"pipeline config: interleave={il} (virtual stages)",
+                     ranks=[0])
         self.micro_batches = self.gradient_accumulation_steps()
         # one pipelined forward/backward covers the whole global batch
         self.tput_timer.batch_size = self.train_batch_size()

@@ -85,13 +85,20 @@ class PipelineModule:
             (reference ``_partition_layers``, ``module.py:348-403``).
         activation_checkpoint_interval: remat every N layers (reference
             ``forward``, ``module.py:292-346``).
+        interleave: virtual-stage chunks per physical stage (Megatron's
+            virtual pipeline / interleaved schedule).  The layer list
+            partitions into ``stages × interleave`` logical stages mapped
+            cyclically onto the physical ranks; the compiled schedule's
+            tick count drops from ``(mb + p - 1)·v`` to ``v·mb + p - 1``
+            chunk-ticks, shrinking the fill/drain bubble by ~v.  Requires
+            micro_batches % stages == 0.
     """
 
     def __init__(self, layers, num_stages=None, topology=None,
                  loss_fn=None, seed_layers=False, seed_fn=None, base_seed=1234,
                  partition_method="parameters",
                  activation_checkpoint_interval=0,
-                 activation_checkpoint_func=None):
+                 activation_checkpoint_func=None, interleave=1):
         self.layer_specs = []
         for layer in layers:
             if isinstance(layer, LayerSpec):
@@ -110,6 +117,7 @@ class PipelineModule:
         self.partition_method = partition_method
         self.activation_checkpoint_interval = activation_checkpoint_interval
         self.activation_checkpoint_func = activation_checkpoint_func
+        self.interleave = max(int(interleave or 1), 1)
         self._parts = None
         self._build()
 

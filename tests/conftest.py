@@ -35,6 +35,16 @@ if (not _want_tpu
     os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses tests spawn
 
 
+# Persistent XLA compilation cache: the suite's cost is overwhelmingly
+# compiling the same tiny programs over and over; warm runs skip it.
+_cache_dir = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+try:
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+except Exception:  # older jax without the knobs — run uncached
+    pass
+
+
 def _tpu_usable():
     """Whether a real TPU device can actually run work — gate for the
     ``tpu`` marker (checking devices, not jax.default_backend(): the

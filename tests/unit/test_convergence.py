@@ -12,6 +12,7 @@ from deepspeed_tpu.parallel import make_mesh
 
 
 @pytest.mark.parametrize("zero_stage", [0, 2])
+@pytest.mark.slow
 def test_gpt2_overfits(zero_stage, cpu_devices):
     mesh = make_mesh({"data": 4}, devices=cpu_devices[:4])
     model = GPT2LMHeadTPU(GPT2Config(

@@ -175,6 +175,7 @@ def test_eval_batch(cpu_devices):
     assert out.shape == (16, HIDDEN)
 
 
+@pytest.mark.slow
 def test_zero3_shards_resident_state_compile_time():
     """ZeRO-3's memory claim, checked at compile time: the train step's
     persistent buffers (master + optimizer state, no resident params) are
@@ -213,3 +214,26 @@ def test_zero3_shards_resident_state_compile_time():
     # the gather is per-leaf in compute dtype: temps must stay well under a
     # replicated fp32 master copy per device (= args0 fp32 master+opt)
     assert temp3 < args0, (args0, temp3)
+
+
+def test_segment_norm_rows_matches_scatter():
+    """The row-aligned segment-norm fast path must equal the generic
+    scatter implementation on a real flat layout (incl. padding rows)."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.ops.op_common import (LANES, build_segments,
+                                             segment_l2_norms,
+                                             segment_l2_norms_rows)
+
+    sizes = [7, LANES, 3 * LANES + 5, 1]
+    segs = build_segments(sizes, pad_to=4)
+    rng = np.random.default_rng(0)
+    flat = np.zeros(segs.shape, np.float32)
+    ids = segs.segment_ids()
+    # fill only real elements; padding stays zero (the layout contract)
+    flat[ids < segs.num_segments] = rng.normal(
+        size=int((ids < segs.num_segments).sum())).astype(np.float32)
+    flat = jnp.asarray(flat)
+    a = segment_l2_norms(flat, jnp.asarray(ids), segs.num_segments)
+    b = segment_l2_norms_rows(flat, segs)
+    np.testing.assert_allclose(np.asarray(b), np.asarray(a), rtol=1e-6)

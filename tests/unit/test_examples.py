@@ -3,6 +3,7 @@
 import os
 import subprocess
 import sys
+import pytest
 
 REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
 
@@ -21,12 +22,14 @@ def _run(script, *args):
     return proc.stdout
 
 
+@pytest.mark.slow
 def test_bert_example():
     _run("bert_pretraining.py", "--steps", "3", "--batch", "8",
          "--seq", "32", "--model", "tiny", "--zero", "2",
          "--data_parallel", "4")
 
 
+@pytest.mark.slow
 def test_gpt2_pipeline_example():
     _run("gpt2_pipeline.py", "--steps", "2", "--pipe", "2", "--data", "2",
          "--layers", "4", "--micro_batch", "2", "--grad_acc", "2",

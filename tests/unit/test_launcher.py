@@ -100,6 +100,7 @@ def test_dataloader_process_slicing():
         np.testing.assert_array_equal(np.concatenate([a, b]), fb)
 
 
+@pytest.mark.slow
 def test_two_process_cli_launch(tmp_path):
     """End-to-end: CLI -> spawner -> 2 processes -> jax.distributed
     rendezvous -> sliced dataloader -> 3 engine steps on a global mesh."""

@@ -59,6 +59,7 @@ def run_engine(model, config, mesh, batch_fn, steps=4, seed=0):
 
 
 @pytest.mark.parametrize("remat", [False, True])
+@pytest.mark.slow
 def test_bert_trains(cpu_devices, remat):
     mesh = make_mesh({"data": 4}, devices=cpu_devices[:4])
     config = {"train_batch_size": 8,
@@ -76,6 +77,7 @@ def test_gpt2_trains(cpu_devices):
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_gpt2_tensor_parallel_parity(cpu_devices):
     """data×model mesh must match the data-only trajectory (Megatron-style
     TP correctness; reference relies on the external mpu for this)."""
@@ -88,6 +90,7 @@ def test_gpt2_tensor_parallel_parity(cpu_devices):
     np.testing.assert_allclose(l_tp, l_dp, rtol=2e-4)
 
 
+@pytest.mark.slow
 def test_bert_pld(cpu_devices):
     """Progressive layer drop wiring (engine injects pld_theta)."""
     mesh = make_mesh({"data": 2}, devices=cpu_devices[:2])
@@ -144,6 +147,7 @@ def test_transformer_memory_knobs():
         np.testing.assert_allclose(out, base_out, rtol=1e-6, err_msg=knob)
 
 
+@pytest.mark.slow
 def test_bert_qa_head_trains():
     """SQuAD-style span head (reference BingBertSquad parity): loss is
     finite, decreases, and logits mode returns [b, s] pairs."""
@@ -172,6 +176,7 @@ def test_bert_qa_head_trains():
     assert logits[0].shape == (4, 32) and logits[1].shape == (4, 32)
 
 
+@pytest.mark.slow
 def test_bert_classifier_head_trains():
     import deepspeed_tpu as deepspeed
     from deepspeed_tpu.models import (BertConfig,
@@ -198,6 +203,7 @@ def test_bert_classifier_head_trains():
     assert logits.shape == (4, 3)
 
 
+@pytest.mark.slow
 def test_memory_knobs_preserve_loss():
     """gelu_checkpoint/attn_dropout_checkpoint/normalize_invertible change
     what is stored for backward, never the math (reference kernel knobs,

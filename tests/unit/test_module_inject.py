@@ -30,6 +30,7 @@ def _hf_layer_and_params(seed=0):
     return layer, params
 
 
+@pytest.mark.slow
 def test_injected_layer_matches_hf():
     hf_layer, hf_params = _hf_layer_and_params()
     rng = np.random.default_rng(0)

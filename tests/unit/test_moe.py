@@ -55,6 +55,7 @@ def test_moe_e1_matches_dense_ffn():
 
 @pytest.mark.parametrize("mesh_shape", [{"data": 2, "expert": 4},
                                         {"data": 1, "expert": 2, "model": 2}])
+@pytest.mark.slow
 def test_gpt2_moe_trains_expert_parallel(mesh_shape, cpu_devices):
     import deepspeed_tpu as deepspeed
     from deepspeed_tpu.models import GPT2Config, GPT2LMHeadTPU
@@ -84,6 +85,7 @@ def test_gpt2_moe_trains_expert_parallel(mesh_shape, cpu_devices):
     assert spec[0] == "expert"
 
 
+@pytest.mark.slow
 def test_gpt2_moe_honors_attn_impl_and_remat(cpu_devices):
     """MoE blocks share TransformerLayer's attention core (sparse/ring
     configs apply) and participate in config-driven remat."""

@@ -409,3 +409,56 @@ def test_pipeline_config_section_fills_module_defaults(cpu_devices):
     x = np.random.default_rng(0).normal(size=(2, 8)).astype(np.float32)
     loss = engine.train_batch(iter([(x, x), (x, x)]))
     assert np.isfinite(float(jax.device_get(loss)))
+
+
+@pytest.mark.parametrize("interleave", [2, 4])
+def test_pipe_interleaved_matches_plain(interleave, cpu_devices):
+    """Interleaved (virtual-stage) schedule must train identically to the
+    plain fill-drain schedule: same layers, same data, same seeds →
+    bit-comparable losses over several steps."""
+    micro_batches, mb_size, steps = 4, 8, 3
+    n_layers = 4 * interleave  # every logical stage must own >= 1 layer
+    data = _data(micro_batches, mb_size)
+    mesh = make_mesh({"pipe": 4}, devices=cpu_devices[:4])
+
+    module1 = PipelineModule(_specs(n_layers), loss_fn=mse_loss)
+    eng1, *_ = deepspeed.initialize(
+        model=module1, config=_config(mb_size, micro_batches, 1), mesh=mesh)
+    losses1 = _train(eng1, data, steps)
+
+    module2 = PipelineModule(_specs(n_layers), loss_fn=mse_loss,
+                             interleave=interleave)
+    eng2, *_ = deepspeed.initialize(
+        model=module2, config=_config(mb_size, micro_batches, 1), mesh=mesh)
+    losses2 = _train(eng2, data, steps)
+
+    np.testing.assert_allclose(losses2, losses1, rtol=1e-5, atol=1e-6)
+
+
+def test_pipe_interleave_rejects_too_few_layers(cpu_devices):
+    mesh = make_mesh({"pipe": 4}, devices=cpu_devices[:4])
+    module = PipelineModule(_specs(8), loss_fn=mse_loss, interleave=4)
+    engine, *_ = deepspeed.initialize(
+        model=module, config=_config(8, 4, 1), mesh=mesh)
+    with pytest.raises(AssertionError, match="logical stages"):
+        _train(engine, _data(4, 8), 1)
+
+
+def test_pipe_interleave_config_knob(cpu_devices):
+    mesh = make_mesh({"pipe": 2}, devices=cpu_devices[:2])
+    module = PipelineModule(_specs(4), loss_fn=mse_loss)
+    config = dict(_config(4, 2, 1), pipeline={"interleave": 2})
+    engine, *_ = deepspeed.initialize(model=module, config=config, mesh=mesh)
+    assert module.interleave == 2
+    data = _data(2, 4)
+    loss = _train(engine, data, 1)
+    assert np.isfinite(loss[0])
+
+
+def test_pipe_interleave_rejects_ragged_microbatches(cpu_devices):
+    mesh = make_mesh({"pipe": 4}, devices=cpu_devices[:4])
+    module = PipelineModule(_specs(8), loss_fn=mse_loss, interleave=2)
+    engine, *_ = deepspeed.initialize(
+        model=module, config=_config(8, 3, 1), mesh=mesh)  # 3 % 4 != 0
+    with pytest.raises(AssertionError, match="divisible"):
+        _train(engine, _data(3, 8), 1)

@@ -78,6 +78,7 @@ def test_ring_attention_single_shard_fallback(cpu_devices):
                                rtol=1e-6, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_gpt2_engine_with_ring_attention(cpu_devices):
     """Full engine train step with sequence-parallel attention on a
     data×seq mesh (long-context path end-to-end)."""
@@ -112,6 +113,7 @@ def test_gpt2_engine_with_ring_attention(cpu_devices):
     np.testing.assert_allclose([l0, l1], [d0, d1], rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_gpt2_engine_with_sparse_attention(cpu_devices):
     """Full engine train step with block-sparse attention."""
     import deepspeed_tpu as deepspeed
