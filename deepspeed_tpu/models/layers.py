@@ -80,7 +80,7 @@ class TransformerLayer:
                  pre_layer_norm=False, initializer_range=0.02, layer_norm_eps=1e-12,
                  attn_impl="auto", sparsity_config=None,
                  gelu_checkpoint=False, attn_dropout_checkpoint=False,
-                 normalize_invertible=False):
+                 normalize_invertible=False, stochastic_mode=False):
         assert hidden_size % heads == 0
         self.hidden_size = hidden_size
         self.heads = heads
@@ -100,6 +100,21 @@ class TransformerLayer:
         self.gelu_checkpoint = gelu_checkpoint
         self.attn_dropout_checkpoint = attn_dropout_checkpoint
         self.normalize_invertible = normalize_invertible
+        # Reference knob parity: stochastic_mode trades run-to-run
+        # determinism for ~2% speed via non-deterministic CUDA atomics
+        # (ops/transformer/transformer.py:93-107,
+        # op_builder/stochastic_transformer.py).  XLA:TPU execution is
+        # deterministic by construction — there is no atomics-ordering
+        # speed to buy back — so the knob is accepted for config
+        # compatibility and logged as a no-op.
+        self.stochastic_mode = stochastic_mode
+        if stochastic_mode:
+            from ..utils.logging import logger
+
+            logger.warning(
+                "stochastic_mode=True accepted for reference config parity "
+                "but is a no-op on TPU: XLA execution is deterministic and "
+                "there is no non-deterministic-atomics fast path to enable")
         # attention core selection:
         #   'auto'   — flash kernel on TPU / jnp reference elsewhere
         #   'ring'   — sequence-parallel ring attention over the 'seq' mesh

@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Pipeline schedule cost on the virtual 8-device CPU mesh.
+
+The compiled fill-drain schedule EXECUTES its bubble ticks (masked work),
+so the interleaved schedule's tick reduction — ``(mb + p − 1)·v`` →
+``v·mb + p − 1`` chunk-ticks — shows up directly as less executed work and
+less wall time, even on CPU devices.  This prints analytic tick counts,
+bubble fractions, and measured wall time per train_batch for
+interleave ∈ {1, 2, 4} at pipe=4.
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+     python examples/bench_pipeline_bubble.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu as deepspeed
+from deepspeed_tpu.parallel import make_mesh
+from deepspeed_tpu.runtime.pipe.module import LayerSpec, PipelineModule
+
+HIDDEN, MB, MB_SIZE, LAYERS, P_STAGES = 512, 8, 4, 16, 4
+STEPS = 8
+
+
+class Linear:
+    def __init__(self, d):
+        self.d = d
+
+    def init(self, rng):
+        return {"w": jax.random.normal(rng, (self.d, self.d),
+                                       jnp.float32) * 0.05}
+
+    def apply(self, p, x):
+        return jnp.tanh(x @ p["w"])
+
+
+def mse(out, lab):
+    return jnp.mean((out - lab) ** 2)
+
+
+def run(interleave):
+    mesh = make_mesh({"pipe": P_STAGES}, devices=jax.devices("cpu")[:P_STAGES])
+    module = PipelineModule([LayerSpec(Linear, HIDDEN) for _ in range(LAYERS)],
+                            loss_fn=mse, partition_method="uniform",
+                            interleave=interleave)
+    engine, *_ = deepspeed.initialize(
+        model=module, mesh=mesh,
+        config={"train_micro_batch_size_per_gpu": MB_SIZE,
+                "gradient_accumulation_steps": MB,
+                "steps_per_print": 10 ** 9,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}})
+    rng = np.random.default_rng(0)
+    data = [(rng.normal(size=(MB_SIZE, HIDDEN)).astype(np.float32),
+             rng.normal(size=(MB_SIZE, HIDDEN)).astype(np.float32))
+            for _ in range(MB)]
+    loss = engine.train_batch(iter(data))  # compile
+    float(np.asarray(loss))
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        loss = engine.train_batch(iter(data))
+    float(np.asarray(loss))
+    dt = (time.perf_counter() - t0) / STEPS
+
+    v, p = interleave, P_STAGES
+    chunk_ticks = v * MB + p - 1
+    work_ticks = v * MB
+    bubble = (chunk_ticks - work_ticks) / chunk_ticks
+    # normalize to stage-equivalents so v=1 and v>1 compare directly
+    stage_equiv = chunk_ticks / v
+    return dt, chunk_ticks, bubble, stage_equiv
+
+
+def main():
+    print(f"# pipe={P_STAGES} micro_batches={MB} layers={LAYERS} "
+          f"hidden={HIDDEN} (8-device virtual CPU mesh)")
+    base = None
+    for v in (1, 2, 4):
+        dt, ticks, bubble, se = run(v)
+        base = base or dt
+        print(f"interleave={v}: {ticks:3d} chunk-ticks "
+              f"({se:5.2f} stage-equivalents, bubble {bubble:.1%})  "
+              f"wall {dt * 1e3:7.1f} ms/batch  ({base / dt:.2f}x vs v=1)")
+
+
+if __name__ == "__main__":
+    main()
