@@ -54,15 +54,22 @@ def bert_model_flops_per_sample(cfg, seq):
     so MFU stays honest as the model gets cheaper."""
     h, i, L, v = (cfg.hidden_size, cfg.intermediate_size,
                   cfg.num_hidden_layers, cfg.vocab_size)
-    per_layer = (
-        2 * seq * h * 3 * h        # QKV
-        + 2 * seq * seq * h * 2    # scores + context
-        + 2 * seq * h * h          # attn out
-        + 2 * seq * h * i * 2      # FC1 + FC2
-    )
-    n_head = min(cfg.max_predictions_per_seq or seq, seq)
-    head = 2 * n_head * h * h + 2 * n_head * h * v  # MLM transform + vocab proj
-    fwd = L * per_layer + head
+
+    def layer_flops(q_len):
+        """One encoder layer with q_len query/output positions (kv = seq)."""
+        return (
+            2 * q_len * h * h + 2 * seq * h * 2 * h  # Q proj + KV proj
+            + 2 * q_len * seq * h * 2                # scores + context
+            + 2 * q_len * h * h                      # attn out
+            + 2 * q_len * h * i * 2                  # FC1 + FC2
+        )
+
+    n_pred = min(cfg.max_predictions_per_seq or seq, seq)
+    # with the gather head, the FINAL layer computes only the n_pred label
+    # positions + CLS (queries gathered; kv full) — count what executes
+    n_last = seq if n_pred == seq else n_pred + 1
+    head = 2 * n_pred * h * h + 2 * n_pred * h * v  # MLM transform + vocab proj
+    fwd = (L - 1) * layer_flops(seq) + layer_flops(n_last) + head
     return 3 * fwd  # bwd ~= 2x fwd
 
 

@@ -127,7 +127,14 @@ class BertModel:
         }
 
     def encode(self, params, input_ids, attention_mask=None, token_type_ids=None,
-               rng=None, deterministic=True, pld_theta=None, dtype=None):
+               rng=None, deterministic=True, pld_theta=None, dtype=None,
+               final_positions=None):
+        """``final_positions`` [b, K]: compute the LAST encoder layer only
+        at these positions (queries gathered, K/V full — see
+        ``TransformerLayer.apply``); the returned sequence output is
+        [b, K, hidden] and the pooler reads row 0, so callers must put
+        position 0 first.  Ignored under Progressive Layer Drop (the
+        keep/passthrough select needs uniform shapes)."""
         c = self.config
         b, s = input_ids.shape
         emb = params["embeddings"]
@@ -156,16 +163,28 @@ class BertModel:
 
             ck_layer = ds_ckpt.checkpoint_wrapper(run_layer)
 
+        if pld_theta is not None:
+            final_positions = None  # PLD's select needs uniform shapes
+
+        def run_last_layer(layer_params, x, layer_rng):
+            return self.layer.apply(layer_params, x, key_padding_mask=kpm,
+                                    rng=layer_rng, deterministic=deterministic,
+                                    positions=final_positions)
+
         for i in range(c.num_hidden_layers):
             layer_rng = None
             if rng is not None and not deterministic:
                 rng, layer_rng = jax.random.split(rng)
-            fn = run_layer
+            last = (i == c.num_hidden_layers - 1)
+            fn = run_last_layer if (last and final_positions is not None) \
+                else run_layer
             if ck_layer is not None:
                 from ..runtime.activation_checkpointing import checkpointing as ds_ckpt
 
                 if ds_ckpt.should_checkpoint_layer(i, c.num_hidden_layers):
-                    fn = ck_layer
+                    fn = (ds_ckpt.checkpoint_wrapper(run_last_layer)
+                          if (last and final_positions is not None)
+                          else ck_layer)
             with jax.named_scope(f"layer_{i}"):
                 y = fn(params["encoder"][f"layer_{i}"], x, layer_rng)
             if pld_theta is not None and not deterministic and layer_rng is not None:
@@ -235,28 +254,43 @@ class BertForPreTrainingTPU:
         input_ids = batch["input_ids"]
         attention_mask = batch.get("attention_mask")
         token_type_ids = batch.get("token_type_ids")
+        mlm_labels = batch.get("masked_lm_labels")
+        n_pred = c.max_predictions_per_seq
+        # Gather the labeled positions before the head — and, when PLD is
+        # off, before the FINAL encoder layer too (its outputs at other
+        # positions feed nothing): only ~15% of positions carry MLM
+        # labels, so the last layer + vocab projection over the rest is
+        # pure waste (the reference pays it; this is the fused-kernel
+        # philosophy applied at the model level).  top_k of the label mask
+        # is stable, so it selects the FIRST n_pred labeled positions;
+        # unlabeled fill positions gather a -100 label and are ignored by
+        # the loss.  Position 0 rides along for the pooler/NSP head.
+        gather = (mlm_labels is not None and n_pred
+                  and n_pred < input_ids.shape[1])
+        final_positions = None
+        if gather:
+            is_masked = (mlm_labels != -100).astype(jnp.int32)
+            _, pos = jax.lax.top_k(is_masked, n_pred)  # [b, n_pred]
+            mlm_labels = jnp.take_along_axis(mlm_labels, pos, axis=1)
+            # final-layer query gather needs the dense bidirectional
+            # attention core and uniform shapes (no PLD select); other
+            # configs keep the full final layer + post-encode head gather
+            if pld_theta is None and c.attn_impl == "auto":
+                final_positions = jnp.concatenate(
+                    [jnp.zeros((pos.shape[0], 1), pos.dtype), pos], axis=1)
         seq_out, pooled = self.bert.encode(
             params["bert"], input_ids, attention_mask, token_type_ids,
             rng=rng, deterministic=not train, pld_theta=pld_theta,
-            dtype=self.compute_dtype)
+            dtype=self.compute_dtype, final_positions=final_positions)
 
         cls = params["cls"]
-        mlm_labels = batch.get("masked_lm_labels")
         head_in = seq_out
-        n_pred = c.max_predictions_per_seq
-        if (mlm_labels is not None and n_pred
-                and n_pred < input_ids.shape[1]):
-            # Gather the labeled positions before the head: only ~15% of
-            # positions carry MLM labels, so the vocab projection over the
-            # rest is pure waste (the reference pays it; this is the
-            # fused-kernel philosophy applied to the head instead).  top_k
-            # of the label mask is stable, so it selects the FIRST n_pred
-            # labeled positions; unlabeled fill positions gather a -100
-            # label and are ignored by the loss.
-            is_masked = (mlm_labels != -100).astype(jnp.int32)
-            _, pos = jax.lax.top_k(is_masked, n_pred)  # [b, n_pred]
-            head_in = jnp.take_along_axis(seq_out, pos[..., None], axis=1)
-            mlm_labels = jnp.take_along_axis(mlm_labels, pos, axis=1)
+        if gather:
+            if final_positions is not None:
+                # encode returned [b, 1 + n_pred, h]: CLS row + label rows
+                head_in = seq_out[:, 1:]
+            else:  # PLD active — encode ran full-length; gather here
+                head_in = jnp.take_along_axis(seq_out, pos[..., None], axis=1)
         h = gelu(dense(cls["transform"], head_in))
         h = layer_norm(cls["transform_ln"], h, c.layer_norm_eps)
         # decoder tied to word embeddings (standard BERT; the reference ties

@@ -162,13 +162,36 @@ class TransformerLayer:
                 "ln_attn": ln, "ln_mlp": ln}
 
     def attention_core(self, params, y, mask=None, key_padding_mask=None,
-                       attn_rng=None, deterministic=True):
+                       attn_rng=None, deterministic=True, positions=None):
         """Fused-QKV attention → [b, s, h] context, honoring the configured
         ``attn_impl`` (auto/ring/sparse) and attention dropout.  Shared by
         the dense block and :class:`~deepspeed_tpu.models.moe.MoETransformerLayer`,
-        so every attention variant behaves identically in both."""
+        so every attention variant behaves identically in both.
+
+        ``positions`` [b, K]: compute QUERIES (and hence output rows) only
+        at these positions while keys/values cover the full sequence — the
+        final-layer optimization for heads that consume a few positions
+        (MLM gather).  Identical math for the computed rows."""
         b, s, h = y.shape
         r1 = attn_rng
+        if positions is not None:
+            assert self.attn_impl == "auto" and not self.causal, (
+                "query-gathered attention supports the dense bidirectional "
+                "core only")
+            K = positions.shape[1]
+            w = params["qkv"]["kernel"].astype(y.dtype)
+            bias = params["qkv"]["bias"].astype(y.dtype)
+            y_sel = jnp.take_along_axis(y, positions[..., None], axis=1)
+            q = (y_sel @ w[:, :h] + bias[:h]).reshape(b, K, self.heads,
+                                                      self.head_dim)
+            kv = (y @ w[:, h:] + bias[h:]).reshape(b, s, 2, self.heads,
+                                                   self.head_dim)
+            ctx = dot_product_attention(
+                q, kv[:, :, 0], kv[:, :, 1], mask=mask,
+                key_padding_mask=key_padding_mask,
+                causal=False, dropout_rate=self.attn_dropout_ratio,
+                dropout_rng=r1, deterministic=deterministic)
+            return ctx.reshape(b, K, h)
         qkv = dense(params["qkv"], y)  # [b, s, 3h] one fused GEMM
         qkv = qkv.reshape(b, s, 3, self.heads, self.head_dim)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
@@ -231,10 +254,16 @@ class TransformerLayer:
         return ctx.reshape(b, s, h)
 
     def apply(self, params, x, mask=None, key_padding_mask=None, rng=None,
-              deterministic=True):
+              deterministic=True, positions=None):
         """x: [batch, seq, hidden]; mask: [batch, 1, 1, seq] additive or None;
         key_padding_mask: [batch, seq] with 1 at visible tokens (routed to the
-        fused flash kernel's mask operand on TPU)."""
+        fused flash kernel's mask operand on TPU).
+
+        ``positions`` [b, K]: produce outputs only at these positions
+        (attention queries gathered; K/V over the full sequence; FFN and
+        layernorms on the K gathered rows).  For the FINAL layer of models
+        whose heads consume few positions — identical math for those rows,
+        ~(s−K)/s of the layer's FLOPs saved.  Returns [b, K, hidden]."""
         b, s, h = x.shape
         assert mask is None or key_padding_mask is None, (
             "pass either an additive mask or a key_padding_mask, not both")
@@ -246,7 +275,8 @@ class TransformerLayer:
         def attention_block(params, y):
             ctx = self.attention_core(params, y, mask=mask,
                                       key_padding_mask=key_padding_mask,
-                                      attn_rng=r1, deterministic=deterministic)
+                                      attn_rng=r1, deterministic=deterministic,
+                                      positions=positions)
             out = dense(params["attn_out"], ctx)
             return dropout(r2, out, self.hidden_dropout_ratio, deterministic)
 
@@ -272,11 +302,19 @@ class TransformerLayer:
             # re-derives them; recompute is the XLA-friendly equivalent)
             ln = jax.checkpoint(ln)
 
+        if positions is not None:
+            # residuals use the gathered input rows; attention_block already
+            # returns [b, K, h]
+            def sel(t):
+                return jnp.take_along_axis(t, positions[..., None], axis=1)
+        else:
+            sel = lambda t: t
+
         if self.pre_layer_norm:
-            x = x + attention_block(params, ln(params["ln_attn"], x))
+            x = sel(x) + attention_block(params, ln(params["ln_attn"], x))
             x = x + mlp_block(params, ln(params["ln_mlp"], x))
         else:
-            x = ln(params["ln_attn"], x + attention_block(params, x))
+            x = ln(params["ln_attn"], sel(x) + attention_block(params, x))
             x = ln(params["ln_mlp"], x + mlp_block(params, x))
         return x
 

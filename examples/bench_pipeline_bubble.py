@@ -83,7 +83,7 @@ def run(interleave):
 
 def main():
     print(f"# pipe={P_STAGES} micro_batches={MB} layers={LAYERS} "
-          f"hidden={HIDDEN} (8-device virtual CPU mesh)")
+          f"hidden={HIDDEN} ({P_STAGES}-device virtual CPU mesh)")
     base = None
     for v in (1, 2, 4):
         dt, ticks, bubble, se = run(v)

@@ -276,3 +276,25 @@ def test_bert_mlm_gather_head_loss_parity():
     b_nolabel = {k: v for k, v in b.items() if k != "masked_lm_labels"}
     logits = gathered.apply(params, b_nolabel, train=False)
     assert logits.shape == (4, SEQ, VOCAB)
+
+
+def test_bert_mlm_gather_composes_with_sparse_and_ring():
+    """max_predictions_per_seq must not crash the non-dense attention
+    cores: the final-layer query gather requires attn_impl='auto', so
+    sparse/ring configs fall back to the post-encode head gather."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.ops.sparse_attention import FixedSparsityConfig
+
+    rng = np.random.default_rng(5)
+    b = bert_batch(rng, 2)
+    for impl, extra in (("sparse", dict(sparsity_config=FixedSparsityConfig(
+            num_heads=4, block=8))), ):
+        model = BertForPreTrainingTPU(BertConfig(
+            vocab_size=VOCAB, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, max_position_embeddings=SEQ,
+            hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+            attn_impl=impl, max_predictions_per_seq=8, **extra))
+        params = model.init(jax.random.PRNGKey(0))
+        loss = model.apply(params, b, train=True)
+        assert np.isfinite(np.asarray(loss))
