@@ -27,7 +27,8 @@ class GPT2Config:
                  gelu_checkpoint=False, attn_dropout_checkpoint=False,
                  normalize_invertible=False,
                  moe_experts=0, moe_every=2, moe_k=2,
-                 moe_capacity_factor=1.25, moe_aux_coef=0.01):
+                 moe_capacity_factor=1.25, moe_aux_coef=0.01,
+                 loss_chunk=0):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -41,6 +42,10 @@ class GPT2Config:
         self.gelu_checkpoint = gelu_checkpoint
         self.attn_dropout_checkpoint = attn_dropout_checkpoint
         self.normalize_invertible = normalize_invertible
+        # loss_chunk > 0: fused LM-head + CE over sequence chunks of this
+        # size (never materializes the full [b, s, vocab] logits; backward
+        # recomputes per chunk).  Loss is exactly the full-logits value.
+        self.loss_chunk = loss_chunk
         # MoE (beyond-reference; expert parallelism over the 'expert' axis):
         # moe_experts > 0 swaps the dense FFN for a routed-expert FFN on
         # every moe_every-th block (GShard-style alternation)
@@ -153,7 +158,8 @@ class GPT2LMHeadTPU:
             "ln_f": {"scale": P(), "bias": P()},
         }
 
-    def logits(self, params, input_ids, rng=None, deterministic=True):
+    def hidden(self, params, input_ids, rng=None, deterministic=True):
+        """Trunk + final layernorm → [b, s, h] (pre-LM-head hidden states)."""
         c = self.config
         b, s = input_ids.shape
         x = jnp.take(params["wte"], input_ids, axis=0) + params["wpe"][None, :s]
@@ -208,20 +214,80 @@ class GPT2LMHeadTPU:
         x = layer_norm(params["ln_f"], x, c.layer_norm_eps)
         self._last_moe_aux = (sum(aux_losses) / len(aux_losses)
                               if aux_losses else None)
-        return x @ params["wte"].T.astype(x.dtype)  # tied LM head
+        return x
+
+    @staticmethod
+    def _lm_head(params, x):
+        """Tied LM head (wte shared with the input embedding; the
+        reference ties them through TiedLayerSpec under pipelining)."""
+        return x @ params["wte"].T.astype(x.dtype)
+
+    def logits(self, params, input_ids, rng=None, deterministic=True):
+        x = self.hidden(params, input_ids, rng=rng, deterministic=deterministic)
+        return self._lm_head(params, x)
+
+    def _chunked_lm_loss(self, params, x, labels, chunk):
+        """Fused LM-head + cross entropy over sequence chunks.
+
+        The full-logits path materializes [b, s, V] (824 MB bf16 at
+        GPT-2-medium bench shape) and upcasts it to fp32 for the
+        logsumexp (3.3 GB) — the single biggest tensor in the step.  Here
+        each chunk's logits [b, chunk, V] live only inside one
+        ``lax.map`` iteration and the backward recomputes them
+        (``jax.checkpoint``), the reference's fused-kernel philosophy
+        (``csrc/transformer/gelu_kernels.cu``-class fusion) applied to
+        the head: HBM high-water drops by ~the logits tensor.
+        """
+        b, s, h = x.shape
+        n = s // chunk
+        assert s % chunk == 0, f"seq {s} not divisible by loss_chunk {chunk}"
+        w = params["wte"]
+        xs = x.reshape(b, n, chunk, h).swapaxes(0, 1)        # [n,b,chunk,h]
+        ls = labels.reshape(b, n, chunk).swapaxes(0, 1)
+
+        @jax.checkpoint
+        def one(args):
+            xc, lc = args
+            logits = (xc @ w.T.astype(xc.dtype)).astype(jnp.float32)
+            mask = lc != -100
+            lse = jax.scipy.special.logsumexp(logits, axis=-1)
+            gold = jnp.take_along_axis(
+                logits, jnp.where(mask, lc, 0)[..., None], axis=-1)[..., 0]
+            return jnp.sum((lse - gold) * mask), jnp.sum(mask)
+
+        sums, counts = jax.lax.map(one, (xs, ls))
+        return jnp.sum(sums) / jnp.maximum(jnp.sum(counts), 1)
 
     def apply(self, params, batch, rng=None, train=True, **kw):
+        c = self.config
         input_ids = batch["input_ids"] if isinstance(batch, dict) else batch
-        logits = self.logits(params, input_ids, rng=rng, deterministic=not train)
-        if not train and not (isinstance(batch, dict) and "labels" in batch):
-            return logits
+        want_logits = not train and not (isinstance(batch, dict)
+                                         and "labels" in batch)
+        chunk = getattr(c, "loss_chunk", 0)
+        use_chunked = (not want_logits and chunk
+                       and input_ids.shape[1] % chunk == 0)
+        if chunk and not want_logits and not use_chunked:
+            from ..utils.logging import logger
+
+            logger.warning(
+                "loss_chunk=%s does not divide seq %s — falling back to the "
+                "FULL-logits loss (the [b, s, vocab] tensor this knob exists "
+                "to avoid WILL be materialized); pick a divisor",
+                chunk, input_ids.shape[1])
+        x = self.hidden(params, input_ids, rng=rng, deterministic=not train)
+        if want_logits:
+            return self._lm_head(params, x)
         if isinstance(batch, dict) and "labels" in batch:
             labels = batch["labels"]
         else:
             labels = jnp.concatenate(
                 [input_ids[:, 1:],
                  jnp.full((input_ids.shape[0], 1), -100, input_ids.dtype)], axis=1)
-        loss = cross_entropy_with_logits(logits, labels, ignore_index=-100)
+        if use_chunked:
+            loss = self._chunked_lm_loss(params, x, labels, int(chunk))
+        else:
+            loss = cross_entropy_with_logits(self._lm_head(params, x), labels,
+                                             ignore_index=-100)
         if train and getattr(self, "_last_moe_aux", None) is not None:
             # Switch load-balancing aux loss (training-only regularizer),
             # averaged over MoE blocks; eval loss stays comparable to dense

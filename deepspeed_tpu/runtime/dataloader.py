@@ -100,8 +100,52 @@ class DeepSpeedDataLoader:
         if self.shuffle:
             rng = np.random.default_rng(self.seed + self.epoch)
             rng.shuffle(order)
+        self._verify_shared_order(order)
         for i in order:
             yield self.dataset[int(i)]
+
+    @staticmethod
+    def order_fingerprint(order) -> int:
+        """Deterministic 32-bit fingerprint of an iteration order (CRC-32
+        over the index bytes — vectorized, microseconds even for
+        million-sample epochs); identical across processes iff the orders
+        are identical."""
+        import zlib
+
+        return zlib.crc32(np.ascontiguousarray(
+            np.asarray(order, np.int64)).tobytes()) & 0xFFFFFFFF
+
+    def _verify_shared_order(self, order):
+        """Multi-host contract check (runs once per epoch, multi-process
+        only): every process must iterate the dataset in the SAME order —
+        each keeps its 1/world slice of every global batch, so silent
+        order drift (e.g. a process seeded differently, or a dataset with
+        nondeterministic ordering) trains on duplicated/missing shards
+        with no error.  An all-gathered fingerprint turns that into a
+        loud failure on step 0 of the epoch."""
+        if self.world <= 1:
+            # the shared-order contract only binds loaders that split
+            # batches across processes; a world-1 loader (e.g. a rank-0
+            # validation loader) must NOT dial a collective other hosts
+            # never enter — that would deadlock the job
+            return
+        try:
+            import jax
+
+            if jax.process_count() <= 1:
+                return
+            from jax.experimental import multihost_utils
+
+            fp = np.asarray([self.order_fingerprint(order)], np.uint32)
+            all_fps = np.asarray(multihost_utils.process_allgather(fp))
+            if not (all_fps == all_fps.reshape(-1)[0]).all():
+                raise RuntimeError(
+                    f"multi-host dataloader order drift: per-process order "
+                    f"fingerprints differ ({all_fps.reshape(-1).tolist()}); "
+                    f"every process must construct the loader with the same "
+                    f"dataset, seed, and shuffle flag")
+        except ImportError:  # pragma: no cover
+            pass
 
     def _process_slice(self, samples):
         """This process's contiguous slice of one global batch's samples."""

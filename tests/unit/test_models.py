@@ -278,10 +278,11 @@ def test_bert_mlm_gather_head_loss_parity():
     assert logits.shape == (4, SEQ, VOCAB)
 
 
-def test_bert_mlm_gather_composes_with_sparse_and_ring():
-    """max_predictions_per_seq must not crash the non-dense attention
-    cores: the final-layer query gather requires attn_impl='auto', so
-    sparse/ring configs fall back to the post-encode head gather."""
+def test_bert_mlm_gather_composes_with_sparse():
+    """max_predictions_per_seq must not crash non-dense attention cores:
+    the final-layer query gather requires attn_impl='auto', so the sparse
+    config (and by the same code path, ring) falls back to the
+    post-encode head gather."""
     import jax.numpy as jnp
 
     from deepspeed_tpu.ops.sparse_attention import FixedSparsityConfig
@@ -298,3 +299,30 @@ def test_bert_mlm_gather_composes_with_sparse_and_ring():
         params = model.init(jax.random.PRNGKey(0))
         loss = model.apply(params, b, train=True)
         assert np.isfinite(np.asarray(loss))
+
+
+def test_gpt2_chunked_lm_loss_matches_full():
+    """loss_chunk computes exactly the full-logits loss without ever
+    materializing [b, s, vocab]."""
+    model_full = tiny_gpt2()
+    cfg = GPT2Config(vocab_size=VOCAB, hidden_size=32, num_layers=2,
+                     num_heads=4, max_position_embeddings=SEQ,
+                     embd_dropout=0.0, attn_dropout=0.0, resid_dropout=0.0,
+                     loss_chunk=8)
+    model_chunk = GPT2LMHeadTPU(cfg)
+    params = model_full.init(jax.random.PRNGKey(2))
+    rng = np.random.default_rng(7)
+    ids = rng.integers(0, VOCAB, size=(2, SEQ)).astype(np.int32)
+    labels = np.where(rng.random((2, SEQ)) < 0.8, ids, -100).astype(np.int32)
+    batch = {"input_ids": ids, "labels": labels}
+    loss_full = model_full.apply(params, batch, train=True)
+    loss_chunk = model_chunk.apply(params, batch, train=True)
+    np.testing.assert_allclose(np.asarray(loss_chunk), np.asarray(loss_full),
+                               rtol=1e-6)
+    # grads must match too (the chunked head has its own backward)
+    g_full = jax.grad(lambda p: model_full.apply(p, batch, train=True))(params)
+    g_chunk = jax.grad(lambda p: model_chunk.apply(p, batch, train=True))(params)
+    for a, b in zip(jax.tree_util.tree_leaves(g_full),
+                    jax.tree_util.tree_leaves(g_chunk)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=2e-5, atol=1e-7)
