@@ -153,6 +153,24 @@ class SparseAttentionBuilder(OpBuilder):
     ENTRY = "block_sparse_attention"
 
 
+class SparseFlashAttentionBuilder(OpBuilder):
+    """LUT-driven Pallas block-sparse flash kernel (the reference's Triton
+    SDD/DSD/DDS + softmax stack as one Mosaic kernel family)."""
+
+    NAME = "sparse_flash_attention"
+    MODULE = "ops.sparse_attention.flash_block_sparse"
+    ENTRY = "flash_block_sparse_attention"
+
+    def compatibility(self):
+        try:
+            from jax.experimental.pallas import tpu  # noqa: F401
+        except Exception:
+            return False, "Pallas TPU backend not importable"
+        if _backend() != "tpu":
+            return False, "compiled Mosaic kernels need a TPU (gather path elsewhere)"
+        return True, "engaged for 128-multiple layout blocks (block >= 512 advised)"
+
+
 class RingAttentionBuilder(OpBuilder):
     NAME = "ring_attention"
     MODULE = "ops.transformer.ring_attention"
@@ -207,7 +225,8 @@ class TransformerBuilder(OpBuilder):
 
 ALL_OPS = {b.NAME: b for b in (
     FusedAdamBuilder(), FusedLambBuilder(), FlashAttentionBuilder(),
-    SparseAttentionBuilder(), RingAttentionBuilder(), OnebitAdamBuilder(),
+    SparseAttentionBuilder(), SparseFlashAttentionBuilder(),
+    RingAttentionBuilder(), OnebitAdamBuilder(),
     CPUAdamBuilder(), ActivationOffloadBuilder(), TransformerBuilder(),
 )}
 
