@@ -239,7 +239,51 @@ def main():
             record["gpt2_exc"] = f"gpt2 run failed (try {attempt}): {e!r:.300}"
             gc.collect()
 
+    # Quaternary: block-sparse attention kernel vs dense flash at seq 16k
+    # (the reference's sparse-attention SPEED claim, measured on-chip
+    # every round instead of living in PERF.md prose).
+    try:
+        _measure_sparse_attention(record)
+    except Exception as e:  # pragma: no cover - depends on chip
+        record["sparse_attn_exc"] = f"sparse run failed: {e!r:.300}"
+
     print(json.dumps(record))
+
+
+def _measure_sparse_attention(record):
+    if os.environ.get("BENCH_SPARSE", "1") == "0":
+        return
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_sparse_attention",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "examples",
+                     "bench_sparse_attention.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.ops.sparse_attention import (
+        BigBirdSparsityConfig, flash_block_sparse_attention)
+    from deepspeed_tpu.ops.transformer.flash_attention import flash_attention
+
+    s = int(os.environ.get("BENCH_SPARSE_SEQ", "16384"))
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (1, s, mod.H, mod.D), jnp.bfloat16)
+               for kk in ks)
+    layout = BigBirdSparsityConfig(
+        num_heads=mod.H, block=512, num_random_blocks=1,
+        num_sliding_window_blocks=3, num_global_blocks=1).make_layout(s)
+    t_dense = mod.timed_fwd_bwd(lambda a, b_, c: flash_attention(a, b_, c),
+                                q, k, v, 6)
+    t_sparse = mod.timed_fwd_bwd(
+        lambda a, b_, c: flash_block_sparse_attention(a, b_, c, layout),
+        q, k, v, 6)
+    record["sparse_attn_seq"] = s
+    record["sparse_attn_dense_ms"] = round(t_dense * 1e3, 2)
+    record["sparse_attn_sparse_ms"] = round(t_sparse * 1e3, 2)
+    record["sparse_attn_speedup_vs_dense"] = round(t_dense / t_sparse, 2)
 
 
 def _measure_gpt2(record, deepspeed, mesh, rng, steps, warmup, dropout_p,
