@@ -21,17 +21,24 @@ from deepspeed_tpu.models import BertConfig, BertForPreTrainingTPU  # noqa: E402
 from deepspeed_tpu.parallel import make_mesh  # noqa: E402
 
 
-def synthetic_dataset(n, seq, vocab, seed=0):
+def synthetic_dataset(n, seq, vocab, seed=0, n_pred=0):
+    """Exactly ``n_pred`` masked positions per sample when set — the
+    bing_bert max_predictions_per_seq contract the gather head assumes."""
     rng = np.random.default_rng(seed)
     out = []
     for _ in range(n):
         ids = rng.integers(0, vocab, size=(seq,)).astype(np.int32)
+        labels = np.full((seq,), -100, np.int32)
+        if n_pred:
+            pos = rng.permutation(seq)[:n_pred]
+            labels[pos] = ids[pos]
+        else:
+            labels = np.where(rng.random(seq) < 0.15, ids, -100).astype(np.int32)
         out.append({
             "input_ids": ids,
             "attention_mask": np.ones((seq,), np.int32),
             "token_type_ids": np.zeros((seq,), np.int32),
-            "masked_lm_labels": np.where(rng.random(seq) < 0.15, ids,
-                                         -100).astype(np.int32),
+            "masked_lm_labels": labels,
             "next_sentence_labels": np.int32(rng.integers(0, 2)),
         })
     return out
@@ -47,6 +54,9 @@ def main():
     parser.add_argument("--zero", type=int, default=0)
     parser.add_argument("--data_parallel", type=int, default=-1)
     parser.add_argument("--ckpt_dir", type=str, default="")
+    parser.add_argument("--max_predictions", type=int, default=20,
+                        help="MLM positions per sample; the head + final "
+                             "encoder layer compute only these (0 = full)")
     deepspeed.add_config_arguments(parser)
     args = parser.parse_args()
 
@@ -63,18 +73,21 @@ def main():
         "gradient_clipping": 1.0,
     }
 
+    n_pred = max(args.max_predictions, 0) or None
     if args.model == "tiny":
         bert_cfg = BertConfig(vocab_size=1024, hidden_size=128,
                               num_hidden_layers=2, num_attention_heads=4,
-                              max_position_embeddings=max(args.seq, 128))
+                              max_position_embeddings=max(args.seq, 128),
+                              max_predictions_per_seq=n_pred)
     elif args.model == "base":
-        bert_cfg = BertConfig.bert_base()
+        bert_cfg = BertConfig.bert_base(max_predictions_per_seq=n_pred)
     else:
-        bert_cfg = BertConfig.bert_large()
+        bert_cfg = BertConfig.bert_large(max_predictions_per_seq=n_pred)
 
     mesh = make_mesh({"data": args.data_parallel})
     model = BertForPreTrainingTPU(bert_cfg)
-    dataset = synthetic_dataset(args.batch * 4, args.seq, bert_cfg.vocab_size)
+    dataset = synthetic_dataset(args.batch * 4, args.seq, bert_cfg.vocab_size,
+                                n_pred=min(n_pred or 0, args.seq))
     engine, _, loader, _ = deepspeed.initialize(
         args=args, model=model, config=config, mesh=mesh,
         training_data=dataset)

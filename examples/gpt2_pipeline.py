@@ -69,6 +69,9 @@ def main():
     parser.add_argument("--grad_acc", type=int, default=4)
     parser.add_argument("--seq", type=int, default=64)
     parser.add_argument("--vocab", type=int, default=1024)
+    parser.add_argument("--interleave", type=int, default=1,
+                        help="virtual stages per rank (Megatron interleaved "
+                             "schedule; needs grad_acc %% pipe == 0)")
     args = parser.parse_args()
 
     specs = (
@@ -87,7 +90,8 @@ def main():
 
     module = PipelineModule(specs, loss_fn=loss_fn, seed_layers=True,
                             partition_method="uniform",
-                            activation_checkpoint_interval=1)
+                            activation_checkpoint_interval=1,
+                            interleave=args.interleave)
     config = {
         "train_micro_batch_size_per_gpu": args.micro_batch,
         "gradient_accumulation_steps": args.grad_acc,
