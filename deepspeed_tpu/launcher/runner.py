@@ -19,6 +19,7 @@ import argparse
 import base64
 import json
 import os
+import re
 import shlex
 import subprocess
 import sys
@@ -28,6 +29,52 @@ from .constants import (DEFAULT_HOSTFILE, DEFAULT_MASTER_PORT,
                         DEFAULT_PROCS_PER_NODE, ENV_COORDINATOR,
                         ENV_NUM_PROCESSES, MVAPICH_LAUNCHER,
                         OPENMPI_LAUNCHER, PDSH_LAUNCHER, SSH_LAUNCHER)
+
+#: env-var name prefixes forwarded to every worker process (reference
+#: ``runner.py:27`` exports NCCL/PYTHON/MV2/UCX; the TPU runtime's knobs
+#: live under JAX_*/XLA_*/LIBTPU_*/TPU_* instead, and the framework's own
+#: DS_* feature toggles must reach workers too)
+EXPORT_ENVS = ("JAX", "XLA", "LIBTPU", "TPU", "PYTHON", "MV2", "UCX", "DS_")
+DEEPSPEED_ENVIRONMENT_NAME = ".deepspeed_env"
+DEEPSPEED_ENVIRONMENT_PATHS = (os.path.expanduser("~"), ".")
+
+#: per-process rendezvous vars the spawners own — forwarding a stale copy
+#: from the launcher's shell would make every rank claim the same id (the
+#: MPI path has no per-child override, unlike launch.py)
+_NO_FORWARD = frozenset(("DS_COORDINATOR", "DS_NUM_PROCESSES",
+                         "DS_PROCESS_ID", "DS_LOCAL_RANK"))
+
+_ENV_KEY_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*$")
+
+
+def collect_exports(environ=None, paths=DEEPSPEED_ENVIRONMENT_PATHS):
+    """Env vars that must travel to worker processes: every var whose name
+    starts with an ``EXPORT_ENVS`` prefix, then ``KEY=VALUE`` lines from
+    ``.deepspeed_env`` files (reference ``runner.py:341-356``; file entries
+    override inherited env, later files override earlier ones)."""
+    environ = os.environ if environ is None else environ
+    exports = {k: v for k, v in environ.items()
+               if any(k.startswith(p) for p in EXPORT_ENVS)
+               and k not in _NO_FORWARD}
+    for d in paths:
+        path = os.path.join(d, DEEPSPEED_ENVIRONMENT_NAME)
+        if not os.path.isfile(path):
+            continue
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                key, sep, val = line.partition("=")
+                key = key.strip()
+                # fail at parse time, not as a shell error on remote hosts
+                if not sep or not _ENV_KEY_RE.match(key):
+                    raise ValueError(
+                        f"malformed line in {path}: {line!r} "
+                        "(expected SHELL_IDENTIFIER=value)")
+                if key not in _NO_FORWARD:
+                    exports[key] = val.strip()
+    return exports
 
 
 def parse_args(args=None):
@@ -154,10 +201,17 @@ class MultiNodeRunner:
     """Base for remote fan-out backends (reference
     ``multinode_runner.py:47-75``)."""
 
-    def __init__(self, args, active, master_addr):
+    def __init__(self, args, active, master_addr, exports=None):
         self.args = args
         self.active = active
         self.master_addr = master_addr
+        self.user_exports = dict(exports or {})
+
+    def export_prefix(self):
+        """``export K=V; `` prelude for ssh/pdsh remote shells (reference
+        ``multinode_runner.py:57-62``)."""
+        return "".join(f"export {k}={shlex.quote(v)}; "
+                       for k, v in self.user_exports.items())
 
     def commands(self):
         raise NotImplementedError
@@ -173,8 +227,9 @@ class PDSHRunner(MultiNodeRunner):
         # hostname against the world info
         cmd = build_launch_cmd(self.args, self.active, "auto", self.master_addr)
         return [["pdsh", "-S", "-f", "1024", "-w", hosts,
-                 "cd {}; {}".format(shlex.quote(os.getcwd()),
-                                    " ".join(shlex.quote(c) for c in cmd))]]
+                 "{}cd {}; {}".format(self.export_prefix(),
+                                      shlex.quote(os.getcwd()),
+                                      " ".join(shlex.quote(c) for c in cmd))]]
 
 
 class SSHRunner(MultiNodeRunner):
@@ -186,7 +241,8 @@ class SSHRunner(MultiNodeRunner):
             cmd = build_launch_cmd(self.args, self.active, rank,
                                    self.master_addr)
             cmds.append(["ssh", host,
-                         "cd {}; {}".format(
+                         "{}cd {}; {}".format(
+                             self.export_prefix(),
                              shlex.quote(os.getcwd()),
                              " ".join(shlex.quote(c) for c in cmd))])
         return cmds
@@ -205,8 +261,8 @@ class MPIRunnerBase(MultiNodeRunner):
     #: env exported to every rank ({} overridden per backend)
     exports = {}
 
-    def __init__(self, args, active, master_addr):
-        super().__init__(args, active, master_addr)
+    def __init__(self, args, active, master_addr, exports=None):
+        super().__init__(args, active, master_addr, exports)
         self._tmp_files = []
         assert not (args.include or args.exclude), (
             f"{self.name} backend does not support worker include/exclusion "
@@ -217,10 +273,12 @@ class MPIRunnerBase(MultiNodeRunner):
 
     def rank_env(self):
         total = sum(len(s) for s in self.active.values())
+        # backend defaults < user/.deepspeed_env exports < rendezvous contract
         return {
+            **self.exports,
+            **self.user_exports,
             ENV_COORDINATOR: f"{self.master_addr}:{self.args.master_port}",
             ENV_NUM_PROCESSES: str(total),
-            **self.exports,
         }
 
     def _write_hostfile(self, line_fn):
@@ -320,13 +378,16 @@ def main(argv=None):
     logger.info(f"launching on {active} (coordinator {master_addr}:"
                 f"{args.master_port})")
 
+    exports = collect_exports()
     if (len(active) == 1 and not args.force_multi
             and args.launcher in (PDSH_LAUNCHER, SSH_LAUNCHER)):
         cmd = build_launch_cmd(args, active, 0, master_addr)
-        result = subprocess.call(cmd)
+        # local spawns inherit the env already; merging applies any
+        # .deepspeed_env file entries so both paths see the same contract
+        result = subprocess.call(cmd, env={**os.environ, **exports})
         sys.exit(result)
 
-    runner = _RUNNERS[args.launcher](args, active, master_addr)
+    runner = _RUNNERS[args.launcher](args, active, master_addr, exports)
     if isinstance(runner, MPIRunnerBase) and not runner.backend_exists():
         raise RuntimeError(
             f"--launcher={args.launcher} requested but its mpirun toolchain "

@@ -180,8 +180,9 @@ def test_mvapich_runner_command(tmp_path):
     with open(derived) as f:
         assert f.read().split() == ["a", "b"]
     # Hydra's -env takes name and value as SEPARATE tokens
-    i = cmd.index("-env")
-    assert cmd[i + 1] == "DS_COORDINATOR" and cmd[i + 2] == "a:29500"
+    env_pairs = {cmd[i + 1]: cmd[i + 2]
+                 for i, tok in enumerate(cmd) if tok == "-env"}
+    assert env_pairs["DS_COORDINATOR"] == "a:29500"
     os.unlink(derived)
 
 
@@ -214,6 +215,75 @@ def test_init_distributed_mpi_env_fallback(monkeypatch):
     monkeypatch.delenv("DS_NUM_PROCESSES")
     monkeypatch.delenv("DS_PROCESS_ID")
     assert _resolve_env(mpi=False) == ("host0:29500", 0, None)
+
+
+def test_collect_exports(tmp_path):
+    """Prefix-matched env + .deepspeed_env files travel to workers
+    (reference runner.py:27-29, 341-356); file entries need no prefix and
+    override inherited env; later files override earlier ones."""
+    from deepspeed_tpu.launcher.runner import collect_exports
+
+    environ = {"LIBTPU_INIT_ARGS": "--mega", "JAX_PLATFORMS": "tpu",
+               "DS_FLASH_ATTENTION": "1", "HOME": "/root", "PATH": "/bin"}
+    assert collect_exports(environ, paths=()) == {
+        "LIBTPU_INIT_ARGS": "--mega", "JAX_PLATFORMS": "tpu",
+        "DS_FLASH_ATTENTION": "1"}
+    d1, d2 = tmp_path / "a", tmp_path / "b"
+    d1.mkdir(), d2.mkdir()
+    (d1 / ".deepspeed_env").write_text(
+        "# comment\nMY_CUSTOM_FLAG=from_file\nJAX_PLATFORMS=cpu\n")
+    (d2 / ".deepspeed_env").write_text("MY_CUSTOM_FLAG=second_wins\n")
+    out = collect_exports(environ, paths=(str(d1), str(d2)))
+    assert out["MY_CUSTOM_FLAG"] == "second_wins"
+    assert out["JAX_PLATFORMS"] == "cpu"  # file overrides inherited env
+    assert out["LIBTPU_INIT_ARGS"] == "--mega"
+
+
+def test_remote_commands_carry_exports(tmp_path):
+    """pdsh/ssh remote shells get an 'export K=V;' prelude; MPI backends
+    put the same vars on the rank env (reference multinode_runner.py)."""
+    from deepspeed_tpu.launcher.runner import (OpenMPIRunner, PDSHRunner,
+                                               SSHRunner)
+
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("a slots=1\nb slots=1\n")
+    args = _mpi_args(hostfile, "pdsh")
+    active = {"a": [0], "b": [0]}
+    exports = {"LIBTPU_INIT_ARGS": "--x=1 --y", "DS_MARK": "7"}
+    (pdsh_cmd,) = PDSHRunner(args, active, "a", exports).commands()
+    assert "export LIBTPU_INIT_ARGS='--x=1 --y'; " in pdsh_cmd[-1]
+    assert "export DS_MARK=7; " in pdsh_cmd[-1]
+    ssh_cmds = SSHRunner(args, active, "a", exports).commands()
+    assert all("export DS_MARK=7; " in c[-1] for c in ssh_cmds)
+    args = _mpi_args(hostfile, "openmpi")
+    (mpi_cmd,) = OpenMPIRunner(args, active, "a", exports).commands()
+    assert "-x DS_MARK=7" in " ".join(mpi_cmd)
+    os.unlink(mpi_cmd[mpi_cmd.index("-hostfile") + 1])
+
+
+def test_env_reaches_spawned_process(tmp_path):
+    """End-to-end: a prefix-matched parent env var AND a .deepspeed_env
+    entry both reach the worker process through the single-node path."""
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text(f"{socket.gethostname()} slots=1\n")
+    (tmp_path / ".deepspeed_env").write_text("MY_CUSTOM_FLAG=from_file\n")
+    script = tmp_path / "probe.py"
+    script.write_text(
+        "import os, sys\n"
+        "open(sys.argv[1], 'w').write(\n"
+        "    os.environ.get('LIBTPU_INIT_ARGS', '?') + '|' +\n"
+        "    os.environ.get('MY_CUSTOM_FLAG', '?'))\n")
+    out = tmp_path / "probe.out"
+    env = dict(os.environ)
+    env["LIBTPU_INIT_ARGS"] = "--marker=42"
+    env["HOME"] = str(tmp_path)  # hermetic: ignore any real ~/.deepspeed_env
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
+         "--hostfile", str(hostfile), str(script), str(out)],
+        cwd=tmp_path, env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert out.read_text() == "--marker=42|from_file"
 
 
 def test_dataloader_order_fingerprint():
