@@ -53,9 +53,17 @@ def collect_exports(environ=None, paths=DEEPSPEED_ENVIRONMENT_PATHS):
     ``.deepspeed_env`` files (reference ``runner.py:341-356``; file entries
     override inherited env, later files override earlier ones)."""
     environ = os.environ if environ is None else environ
-    exports = {k: v for k, v in environ.items()
-               if any(k.startswith(p) for p in EXPORT_ENVS)
-               and k not in _NO_FORWARD}
+    exports = {}
+    for k, v in environ.items():
+        if not any(k.startswith(p) for p in EXPORT_ENVS) or k in _NO_FORWARD:
+            continue
+        # names with shell-illegal chars (legal in the process environment)
+        # would break the remote `export` silently — skip them loudly
+        if not _ENV_KEY_RE.match(k):
+            logger.warning(f"not forwarding env var {k!r}: name is not a "
+                           "shell identifier")
+            continue
+        exports[k] = v
     for d in paths:
         path = os.path.join(d, DEEPSPEED_ENVIRONMENT_NAME)
         if not os.path.isfile(path):

@@ -64,6 +64,11 @@ class _PipelinedModel:
     def init(self, rng):
         return self.module.init(rng)
 
+    def partition_specs(self, mesh):
+        # TP rules from the layers (3D hybrid: the `model` axis stays in
+        # GSPMD auto mode under the pipe-manual shard_map)
+        return self.module.partition_specs(mesh)
+
     # -- stage partitioning (trace-time, from param shapes) --
     def _ensure_parts(self, params):
         """Partition into ``stages × interleave`` LOGICAL stages; logical

@@ -33,6 +33,7 @@ import re
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec as P
 
 from ...runtime.utils import partition_balanced, partition_uniform, tree_path_key
 from ...utils.logging import logger
@@ -237,7 +238,61 @@ class PipelineModule:
                     layer_params.append({})
             else:
                 layer_params.append(layer.init(key))
-        return {"layers": tuple(layer_params), "tied": tied}
+        out = {"layers": tuple(layer_params), "tied": tied}
+        # abstract skeleton for partition_specs (struct only, no arrays kept)
+        self._param_struct = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x)),
+            out)
+        return out
+
+    def partition_specs(self, mesh=None):
+        """Tensor-parallel sharding rules for the param pytree (the engine's
+        TP hook, reference 3D hybrid ``topology.py:246`` + ``engine.py:527``).
+
+        A layer object may declare ``partition_specs()`` returning a pytree
+        of ``PartitionSpec`` matching its ``init()`` params (the
+        ``models/layers.TransformerLayer`` convention); undeclared layers
+        are replicated.  Tied keys inherit the owning layer's spec, split
+        exactly like ``init()`` splits the params in subset mode."""
+        if getattr(self, "_param_struct", None) is None:
+            jax.eval_shape(self.init, jax.random.PRNGKey(0))
+        specs = jax.tree_util.tree_map(lambda _: P(), self._param_struct)
+        layers_out = list(specs["layers"])
+        tied_out = dict(specs["tied"])
+        tied_declared = {}  # key -> (declaring layer idx, shared-weight spec)
+        for idx, layer in enumerate(self.layers):
+            decl = getattr(layer, "partition_specs", None)
+            if decl is None or not self.has_params(idx):
+                continue
+            s = decl()
+            tkey = self._tied_key_of.get(idx)
+            if tkey is None:
+                layers_out[idx] = s
+                continue
+            attr = self._tied_attr_of.get(idx)
+            if getattr(self, "_tied_subset_mode", {}).get(tkey):
+                assert isinstance(s, dict) and attr in s, (
+                    f"tied key {tkey!r} (subset mode): partition_specs() of "
+                    f"layer {idx} must be a dict containing {attr!r}")
+                layers_out[idx] = {k: v for k, v in s.items() if k != attr}
+                shared = s[attr]
+            else:
+                shared = s
+            # any use site may declare the shared weight's layout, but all
+            # declaring sites must agree — a dropped conflicting spec would
+            # leave a huge tied embedding silently replicated
+            if tkey in tied_declared:
+                prev_idx, prev = tied_declared[tkey]
+                assert jax.tree_util.tree_structure(prev) == \
+                    jax.tree_util.tree_structure(shared) and \
+                    jax.tree_util.tree_leaves(prev) == \
+                    jax.tree_util.tree_leaves(shared), (
+                        f"tied key {tkey!r}: layer {idx} declares spec "
+                        f"{shared} but layer {prev_idx} declared {prev}")
+            else:
+                tied_declared[tkey] = (idx, shared)
+                tied_out[tkey] = shared
+        return {"layers": tuple(layers_out), "tied": tied_out}
 
     def layer_param_counts(self, params):
         """Per-layer parameter counts for 'parameters' partitioning

@@ -69,7 +69,8 @@ def _train(engine, data, steps):
 
 
 @pytest.mark.parametrize("topo", [dict(pipe=4, data=2), dict(pipe=2, data=2),
-                                  dict(pipe=8, data=1)])
+                                  dict(pipe=8, data=1),
+                                  dict(pipe=2, model=2, data=2)])
 def test_pipe_matches_sequential(topo, cpu_devices):
     micro_batches, mb_size, steps = 4, 8, 3
     data = _data(micro_batches, mb_size)
@@ -81,7 +82,7 @@ def test_pipe_matches_sequential(topo, cpu_devices):
         model=base_module, config=_config(mb_size, micro_batches, 1), mesh=mesh1)
     base_losses = _train(base_engine, data, steps)
 
-    n = topo["pipe"] * topo["data"]
+    n = topo["pipe"] * topo["data"] * topo.get("model", 1)
     mesh = make_mesh(topo, devices=cpu_devices[:n])
     module = PipelineModule(_specs(), loss_fn=mse_loss)
     engine, *_ = deepspeed.initialize(
@@ -92,6 +93,65 @@ def test_pipe_matches_sequential(topo, cpu_devices):
     assert np.allclose(base_losses, pipe_losses, rtol=2e-4, atol=2e-5), (
         f"pipeline {topo} losses {pipe_losses} != sequential {base_losses}")
     assert pipe_losses[-1] < pipe_losses[0], "training did not reduce loss"
+
+
+class TPBlock:
+    """Megatron-style column→row parallel MLP block declaring its own TP
+    sharding (the layer-level partition_specs contract)."""
+
+    def __init__(self, hidden, inner):
+        self.hidden, self.inner = hidden, inner
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        return {
+            "w1": jax.random.normal(k1, (self.hidden, self.inner),
+                                    jnp.float32) * 0.1,
+            "w2": jax.random.normal(k2, (self.inner, self.hidden),
+                                    jnp.float32) * 0.1,
+        }
+
+    def apply(self, params, x):
+        return x + jnp.tanh(x @ params["w1"]) @ params["w2"]
+
+    @staticmethod
+    def partition_specs():
+        from jax.sharding import PartitionSpec as P
+        return {"w1": P(None, "model"), "w2": P("model", None)}
+
+
+def test_pipe_3d_tensor_parallel_parity(cpu_devices):
+    """True 3D hybrid: pipe×model×data with the layers' declared TP
+    sharding actually applied to the params (reference
+    PipeModelDataParallelTopology, topology.py:246 + engine.py:527-538).
+    Loss trajectory must match the same model trained sequentially."""
+    micro_batches, mb_size, steps = 4, 8, 3
+    data = _data(micro_batches, mb_size)
+
+    def specs():
+        return [LayerSpec(TPBlock, HIDDEN, 4 * HIDDEN) for _ in range(4)]
+
+    mesh1 = make_mesh({"data": 1}, devices=cpu_devices[:1])
+    base, *_ = deepspeed.initialize(
+        model=PipelineModule(specs(), loss_fn=mse_loss),
+        config=_config(mb_size, micro_batches, 1), mesh=mesh1)
+    base_losses = _train(base, data, steps)
+
+    mesh3d = make_mesh({"pipe": 2, "model": 2, "data": 2},
+                       devices=cpu_devices[:8])
+    module = PipelineModule(specs(), loss_fn=mse_loss)
+    engine, *_ = deepspeed.initialize(
+        model=module, config=_config(mb_size, micro_batches, 2), mesh=mesh3d)
+    # the layers' TP rules reached the engine's param shardings
+    from jax.sharding import PartitionSpec as P
+    eng_specs = engine._param_specs
+    assert eng_specs["layers"][0]["w1"] == P(None, "model")
+    assert eng_specs["layers"][0]["w2"] == P("model", None)
+    pipe_losses = _train(engine, data, steps)
+
+    assert np.allclose(base_losses, pipe_losses, rtol=2e-4, atol=2e-5), (
+        f"3D losses {pipe_losses} != sequential {base_losses}")
+    assert pipe_losses[-1] < pipe_losses[0]
 
 
 def test_pipe_tied_layers(cpu_devices):
@@ -173,6 +233,38 @@ def test_pipe_engine_checkpoint_roundtrip(tmp_path, cpu_devices):
     engine2.load_checkpoint(str(tmp_path))
     resumed = _train(engine2, data, 1)
     assert np.allclose(expected, resumed, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("topo2", [dict(pipe=2, data=2), dict(pipe=1, data=2),
+                                   dict(data=2)])
+def test_pipe_checkpoint_restores_across_stage_counts(topo2, tmp_path,
+                                                      cpu_devices):
+    """The reference keeps per-layer checkpoint files precisely so a ckpt
+    saved at S stages loads at S' (module.py:526-567, tested at
+    tests/unit/test_checkpointing.py:567).  Here the params pytree is
+    stage-layout-independent, so the same flat checkpoint must restore into
+    pipe=2, pipe=1, and a plain data-parallel engine — with loss continuity
+    against the saving engine's own next step."""
+    micro_batches, mb_size = 2, 8
+    data = _data(micro_batches, mb_size)
+    mesh4 = make_mesh({"pipe": 4, "data": 2}, devices=cpu_devices[:8])
+    module = PipelineModule(_specs(4), loss_fn=mse_loss)
+    engine, *_ = deepspeed.initialize(
+        model=module, config=_config(mb_size, micro_batches, 2), mesh=mesh4)
+    _train(engine, data, 2)
+    engine.save_checkpoint(str(tmp_path))
+    expected = _train(engine, data, 2)
+
+    n = topo2.get("pipe", 1) * topo2["data"]
+    mesh2 = make_mesh(topo2, devices=cpu_devices[:n])
+    module2 = PipelineModule(_specs(4), loss_fn=mse_loss)
+    engine2, *_ = deepspeed.initialize(
+        model=module2, config=_config(mb_size, micro_batches, topo2["data"]),
+        mesh=mesh2)
+    engine2.load_checkpoint(str(tmp_path))
+    resumed = _train(engine2, data, 2)
+    assert np.allclose(expected, resumed, rtol=2e-4, atol=2e-5), (
+        f"restore at {topo2} diverged: {resumed} vs {expected}")
 
 
 def test_pipe_schedule_trace(cpu_devices):
