@@ -19,6 +19,7 @@ CUDA ``DeepSpeedTransformerLayer`` plays in the reference
   ``ops/transformer/transformer.py:39-154``).
 """
 
+import os
 from typing import Any, Dict, Optional
 
 import jax
@@ -211,8 +212,6 @@ class TransformerLayer:
             ctx = ring_attention(q, k, v, causal=self.causal,
                                  key_padding_mask=kpm_add)
         elif self.attn_impl == "sparse":
-            import os as _os
-
             layout = self._sparse_layout(s)
             causal_sp = self.causal or getattr(
                 self.sparsity_config, "attention",
@@ -220,13 +219,16 @@ class TransformerLayer:
             # Pallas LUT-driven kernel on TPU when the layout blocks are
             # MXU-shaped and no key-padding mask is needed; the gather
             # implementation stays as the general/CPU path.
-            # DS_SPARSE_FLASH=never forces the gather path.
+            # DS_SPARSE_FLASH=never forces the gather path.  Read at TRACE
+            # time (like DS_FLASH_ATTENTION, ops/transformer/attention.py):
+            # set it before the first jitted call — flipping it afterwards
+            # has no effect on already-compiled programs (jit cache).
             blk = s // layout.shape[1]
             use_kernel = (kpm_add is None
                           and jax.default_backend() == "tpu"
                           and blk % 128 == 0 and q.shape[-1] % 64 == 0
-                          and _os.environ.get("DS_SPARSE_FLASH",
-                                              "auto") != "never")
+                          and os.environ.get("DS_SPARSE_FLASH",
+                                             "auto") != "never")
             if use_kernel:
                 from ..ops.sparse_attention.flash_block_sparse import (
                     flash_block_sparse_attention)

@@ -104,7 +104,13 @@ def segment_l2_norms_rows(flat: jnp.ndarray, segments) -> jnp.ndarray:
     (``build_segments``: every tensor owns whole rows; intra-row tail
     padding is zero in params, grads, and updates).  One lane-axis
     reduction then a static slice+sum per tensor — no scatter anywhere,
-    one sweep of HBM."""
+    one sweep of HBM.
+
+    The per-tensor Python loop emits one slice+reduce pair of HLO per
+    tensor; at very high tensor counts (thousands of leaves) that inflates
+    program size and compile time.  If that bites, a single
+    ``jax.ops.segment_sum`` over ``row_sq`` keyed by row-granular segment
+    ids stays scatter-light while emitting O(1) HLO."""
     row_sq = jnp.sum(jnp.asarray(flat, jnp.float32) ** 2, axis=1)
     sums = [jnp.sum(row_sq[ro:ro + rc])
             for ro, rc in zip(segments.row_offsets, segments.row_counts)]

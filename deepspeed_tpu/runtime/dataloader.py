@@ -12,6 +12,8 @@ its ``jax.process_index()``-th slice of every global batch
 with ``jax.make_array_from_process_local_data``.
 """
 
+import os
+
 import numpy as np
 
 from ..utils.logging import logger
@@ -116,8 +118,9 @@ class DeepSpeedDataLoader:
             np.asarray(order, np.int64)).tobytes()) & 0xFFFFFFFF
 
     def _verify_shared_order(self, order):
-        """Multi-host contract check (runs once per epoch, multi-process
-        only): every process must iterate the dataset in the SAME order —
+        """Multi-host contract check (by default runs on the FIRST epoch
+        only — see DS_VERIFY_DATA_ORDER below; multi-process only): every
+        process must iterate the dataset in the SAME order —
         each keeps its 1/world slice of every global batch, so silent
         order drift (e.g. a process seeded differently, or a dataset with
         nondeterministic ordering) trains on duplicated/missing shards
@@ -128,6 +131,20 @@ class DeepSpeedDataLoader:
             # batches across processes; a world-1 loader (e.g. a rank-0
             # validation loader) must NOT dial a collective other hosts
             # never enter — that would deadlock the job
+            return
+        # DS_VERIFY_DATA_ORDER: "epoch0" (default) checks the first epoch
+        # only — construction/seed mismatches are caught before training
+        # commits, and later epochs skip the sync point (a process that
+        # died mid-epoch would otherwise strand the others in this
+        # collective instead of surfacing its own failure); "always"
+        # re-checks every epoch; "never" disables.
+        mode = os.environ.get("DS_VERIFY_DATA_ORDER", "epoch0")
+        if mode not in ("epoch0", "always", "never"):
+            logger.warning(
+                f"DS_VERIFY_DATA_ORDER={mode!r} is not one of "
+                "epoch0/always/never; treating as 'epoch0'")
+            mode = "epoch0"
+        if mode == "never" or (mode == "epoch0" and self.epoch > 1):
             return
         try:
             import jax

@@ -629,6 +629,7 @@ class DeepSpeedEngine:
                     else None
                 flat, treedef = jax.tree_util.tree_flatten_with_path(grads)
                 out = []
+                drops = {}
                 for path, g in flat:
                     key = tree_path_key(path)
                     if (key in sparse_paths and g.ndim == 2
@@ -649,11 +650,12 @@ class DeepSpeedEngine:
                         # out_specs claim it), even when only a subset of
                         # ranks overflowed their local budget
                         any_dropped = jax.lax.psum(dropped, DATA_AXIS)
+                        drops[key] = any_dropped
                         poison = jnp.where(any_dropped > 0, jnp.nan, 0.0)
                         out.append(summed + poison.astype(summed.dtype))
                     else:
                         out.append(jax.lax.pmean(g, DATA_AXIS))
-                return jax.tree_util.tree_unflatten(treedef, out)
+                return jax.tree_util.tree_unflatten(treedef, out), drops
 
             def body(batch_, rng_, cur_scale_, extra_, params_):
                 key = jax.random.fold_in(rng_, jax.lax.axis_index(DATA_AXIS))
@@ -664,15 +666,32 @@ class DeepSpeedEngine:
                     return (loss.astype(jnp.float32) * cur_scale_) / grad_acc
 
                 sloss, grads = jax.value_and_grad(scaled_loss)(params_)
-                return jax.lax.pmean(sloss, DATA_AXIS), exchange(grads, batch_)
+                exchanged, drops = exchange(grads, batch_)
+                return jax.lax.pmean(sloss, DATA_AXIS), exchanged, drops
 
             rep = P()
-            sloss, grads = jax.shard_map(
+            sloss, grads, drops = jax.shard_map(
                 body, mesh=mesh,
                 in_specs=(P(DATA_AXIS), rep, rep, rep, rep),
-                out_specs=(rep, rep),
+                out_specs=(rep, rep, rep),
                 axis_names={DATA_AXIS}, check_vma=False)(
                 batch, rng, cur_scale, extra, params)
+            # attribution OUTSIDE the manual region (debug callbacks don't
+            # compose with partial-auto shard_map): name the overflowed
+            # leaf so the NaN loss is traceable.  Optimizer moments are
+            # corrupted once the poison fires — restart from the last
+            # checkpoint after removing the leaf from sparse_gradients (or
+            # raising the token budget via a bigger micro-batch).
+            for leaf_key, d in drops.items():
+                jax.lax.cond(
+                    d > 0,
+                    lambda dd, k=leaf_key: jax.debug.print(
+                        "sparse_gradients budget overflow on leaf '{k}': "
+                        "{dd} rows dropped across ranks — gradient poisoned "
+                        "with NaN (loss will be NaN); restart from the last "
+                        "checkpoint with this leaf removed from "
+                        "sparse_gradients", k=k, dd=dd),
+                    lambda dd, k=leaf_key: None, d)
             flat_g = self.flat.flatten_grads(grads)
             flat_g = jax.lax.with_sharding_constraint(flat_g, grad_sharding)
             return sloss * grad_acc / cur_scale, flat_g

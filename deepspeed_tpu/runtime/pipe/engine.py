@@ -313,6 +313,14 @@ class PipelineEngine(DeepSpeedEngine):
             model.interleave = max(int(il), 1)
             log_dist(f"pipeline config: interleave={il} (virtual stages)",
                      ranks=[0])
+        elif il is not None and int(il) != model.interleave:
+            # module constructor wins; say so instead of silently dropping
+            # the JSON value
+            log_dist(
+                f"pipeline config: interleave={il} ignored — the "
+                f"PipelineModule was constructed with "
+                f"interleave={model.interleave}, which takes precedence",
+                ranks=[0])
         self.micro_batches = self.gradient_accumulation_steps()
         # one pipelined forward/backward covers the whole global batch
         self.tput_timer.batch_size = self.train_batch_size()

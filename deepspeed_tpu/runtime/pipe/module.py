@@ -260,6 +260,18 @@ class PipelineModule:
         layers_out = list(specs["layers"])
         tied_out = dict(specs["tied"])
         tied_declared = {}  # key -> (declaring layer idx, shared-weight spec)
+
+        def check_struct(idx, spec_slot, param_slot):
+            # fail HERE with the layer named, not as an opaque tree_map
+            # structure mismatch deep in the engine's step construction
+            a = jax.tree_util.tree_structure(spec_slot)
+            b = jax.tree_util.tree_structure(param_slot)
+            assert a == b, (
+                f"layer {idx} ({type(self.layers[idx]).__name__}): "
+                f"partition_specs() structure {a} does not match the "
+                f"layer's init() params structure {b}")
+            return spec_slot
+
         for idx, layer in enumerate(self.layers):
             decl = getattr(layer, "partition_specs", None)
             if decl is None or not self.has_params(idx):
@@ -267,17 +279,21 @@ class PipelineModule:
             s = decl()
             tkey = self._tied_key_of.get(idx)
             if tkey is None:
-                layers_out[idx] = s
+                layers_out[idx] = check_struct(
+                    idx, s, self._param_struct["layers"][idx])
                 continue
             attr = self._tied_attr_of.get(idx)
             if getattr(self, "_tied_subset_mode", {}).get(tkey):
                 assert isinstance(s, dict) and attr in s, (
                     f"tied key {tkey!r} (subset mode): partition_specs() of "
                     f"layer {idx} must be a dict containing {attr!r}")
-                layers_out[idx] = {k: v for k, v in s.items() if k != attr}
-                shared = s[attr]
+                layers_out[idx] = check_struct(
+                    idx, {k: v for k, v in s.items() if k != attr},
+                    self._param_struct["layers"][idx])
+                shared = check_struct(idx, s[attr],
+                                      self._param_struct["tied"][tkey])
             else:
-                shared = s
+                shared = check_struct(idx, s, self._param_struct["tied"][tkey])
             # any use site may declare the shared weight's layout, but all
             # declaring sites must agree — a dropped conflicting spec would
             # leave a huge tied embedding silently replicated
