@@ -74,6 +74,69 @@ def _layout_head(i, heads, n_layout_heads):
     return jax.lax.rem(i, heads)
 
 
+def build_super_luts(layout, G):
+    """2-D aggregated LUTs: coarsen the layout into ``G×G`` super-tiles so
+    the kernel streams MXU-efficient ``[G·blk, G·blk]`` tiles (the fix for
+    sub-512 layout blocks starving the MXU: the reference's Triton kernels
+    run 16-px blocks natively, but TPU tiles want ~512-wide dots, so a
+    super-tile covers a G×G patch of layout blocks and a per-tile BITMASK
+    — bit ``row_g·G + col_g`` — keeps masking at the original block
+    granularity).  Work scales with SUPER-tile density at the dense
+    kernel's per-tile efficiency.
+
+    Returns ``(slut, scnt, smask, stlut, stcnt, stmask)``:
+      - ``slut[h, sq, t]``: t-th active super key-column for super q-row
+        ``sq`` (``scnt[h, sq]`` valid entries);
+      - ``smask[h, sq, t]``: G·G bits of that super-tile's sub-blocks;
+      - ``stlut/stcnt/stmask``: the transpose — active super q-rows per
+        super key-column (for dk/dv), with the SAME bit convention.
+    """
+    layout = np.asarray(layout) != 0
+    h, nb, nb2 = layout.shape
+    assert nb == nb2 and nb % G == 0 and G * G <= 32
+    ns = nb // G
+    # [h, ns, G, ns, G] → per-super-tile G×G patch
+    patch = layout.reshape(h, ns, G, ns, G)
+    active = patch.any(axis=(2, 4))                  # [h, ns, ns]
+    bitval = (1 << (np.arange(G)[:, None] * G
+                    + np.arange(G)[None, :])).astype(np.int64)
+    bits = (patch.transpose(0, 1, 3, 2, 4) * bitval).sum((-1, -2))  # [h,ns,ns]
+    tmax = max(1, int(active.sum(-1).max()))
+    qmax = max(1, int(active.sum(-2).max()))
+    slut = np.zeros((h, ns, tmax), np.int32)
+    scnt = np.zeros((h, ns), np.int32)
+    smask = np.zeros((h, ns, tmax), np.int32)
+    stlut = np.zeros((h, ns, qmax), np.int32)
+    stcnt = np.zeros((h, ns), np.int32)
+    stmask = np.zeros((h, ns, qmax), np.int32)
+    for hi in range(h):
+        for sq in range(ns):
+            cols = np.nonzero(active[hi, sq])[0]
+            slut[hi, sq, :len(cols)] = cols
+            scnt[hi, sq] = len(cols)
+            smask[hi, sq, :len(cols)] = bits[hi, sq, cols]
+        for sk in range(ns):
+            rows = np.nonzero(active[hi, :, sk])[0]
+            stlut[hi, sk, :len(rows)] = rows
+            stcnt[hi, sk] = len(rows)
+            stmask[hi, sk, :len(rows)] = bits[hi, rows, sk]
+    return slut, scnt, smask, stlut, stcnt, stmask
+
+
+def _super_tile_mask(mask_val, G, blk):
+    """[G·blk, G·blk] bool from the G·G-bit super-tile mask: element
+    (r, c) active iff bit ``(r//blk)·G + (c//blk)`` is set.  Built from
+    two BROADCAST shifts (a [n,1] row shift then a [1,n] column shift) —
+    fewer full-tile VPU passes than materializing the 2-D bit index."""
+    n = G * blk
+    row_sh = (jax.lax.broadcasted_iota(jnp.int32, (n, 1), 0) // blk) * G
+    col_sh = jax.lax.broadcasted_iota(jnp.int32, (1, n), 1) // blk
+    shifted = jax.lax.shift_right_logical(
+        jax.lax.shift_right_logical(jnp.full((n, 1), mask_val, jnp.int32),
+                                    row_sh), col_sh)
+    return shifted & 1 > 0
+
+
 def _tile_scores(q_blk, k_blk, scale, causal, j, kb, blk):
     s = jax.lax.dot_general(q_blk, k_blk, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
@@ -168,6 +231,128 @@ def _bwd_dkv_kernel(tlut_ref, tcnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         j = tlut_ref[lh, kb, t]
         s = _tile_scores(q_ref[0], k_ref[0], scale, causal, j, kb, blk)
         p = jnp.exp(s - lse_ref[0, 0][:, None])  # [blk_q, blk_k] fp32
+        dp = jax.lax.dot_general(do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        dv_sc[...] = dv_sc[...] + jax.lax.dot_general(
+            p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta_ref[0, 0][:, None])).astype(q_ref.dtype)
+        dk_sc[...] = dk_sc[...] + jax.lax.dot_general(
+            ds, q_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(t == n_t - 1)
+    def _finalize():
+        dk_ref[0] = (dk_sc[...] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_sc[...].astype(dv_ref.dtype)
+
+
+def _agg_tile_scores(q_tile, k_tile, scale, mask_val, causal, sq, skb, G,
+                     blk):
+    """[G·blk, G·blk] scores with the super-tile bitmask (and causal)
+    applied — inactive sub-blocks mask to -inf exactly like causal
+    masking, so the online softmax recurrence is untouched."""
+    s = jax.lax.dot_general(q_tile, k_tile, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    active = _super_tile_mask(mask_val, G, blk)
+    if causal:
+        n = G * blk
+        q_idx = sq * n + jax.lax.broadcasted_iota(jnp.int32, (n, n), 0)
+        k_idx = skb * n + jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)
+        active = jnp.logical_and(active, q_idx >= k_idx)
+    return jnp.where(active, s, NEG_INF)
+
+
+def _fwd_kernel_agg(slut_ref, scnt_ref, smask_ref, q_ref, k_ref, v_ref,
+                    o_ref, lse_ref, m_sc, l_sc, acc_sc, *, scale, causal,
+                    heads, n_layout_heads, blk, G):
+    """Forward over 2-D super-tiles: both q and k tiles span G layout
+    blocks ([G·blk, d] each) so every dot runs at the dense kernel's tile
+    shape; the G·G-bit mask keeps the math at layout-block granularity."""
+    i, sq, t = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    n_t = pl.num_programs(2)
+    lh = _layout_head(i, heads, n_layout_heads)
+
+    @pl.when(t == 0)
+    def _init():
+        m_sc[...] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[...] = jnp.zeros_like(l_sc)
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+
+    @pl.when(t < scnt_ref[lh, sq])
+    def _step():
+        skb = slut_ref[lh, sq, t]
+        s = _agg_tile_scores(q_ref[0], k_ref[0], scale,
+                             smask_ref[lh, sq, t], causal, sq, skb, G, blk)
+        m, l = m_sc[...], l_sc[...]
+        m_new = jnp.maximum(jnp.maximum(m, jnp.max(s, axis=1, keepdims=True)),
+                            MAX_FLOOR)
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l_sc[...] = l * corr + jnp.sum(p, axis=1, keepdims=True)
+        m_sc[...] = m_new
+        acc_sc[...] = acc_sc[...] * corr + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(t == n_t - 1)
+    def _finalize():
+        l = l_sc[...]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_sc[...] / l_safe).astype(o_ref.dtype)
+        lse_ref[0, 0] = (m_sc[...] + jnp.log(l_safe))[:, 0]
+
+
+def _bwd_dq_kernel_agg(slut_ref, scnt_ref, smask_ref, q_ref, k_ref, v_ref,
+                       do_ref, lse_ref, delta_ref, dq_ref, dq_sc, *, scale,
+                       causal, heads, n_layout_heads, blk, G):
+    i, sq, t = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    n_t = pl.num_programs(2)
+    lh = _layout_head(i, heads, n_layout_heads)
+
+    @pl.when(t == 0)
+    def _init():
+        dq_sc[...] = jnp.zeros_like(dq_sc)
+
+    @pl.when(t < scnt_ref[lh, sq])
+    def _step():
+        skb = slut_ref[lh, sq, t]
+        s = _agg_tile_scores(q_ref[0], k_ref[0], scale,
+                             smask_ref[lh, sq, t], causal, sq, skb, G, blk)
+        p = jnp.exp(s - lse_ref[0, 0][:, None])
+        dp = jax.lax.dot_general(do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta_ref[0, 0][:, None])).astype(k_ref.dtype)
+        dq_sc[...] = dq_sc[...] + jax.lax.dot_general(
+            ds, k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(t == n_t - 1)
+    def _finalize():
+        dq_ref[0] = (dq_sc[...] * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel_agg(stlut_ref, stcnt_ref, stmask_ref, q_ref, k_ref,
+                        v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+                        dk_sc, dv_sc, *, scale, causal, heads,
+                        n_layout_heads, blk, G):
+    """dk/dv: the k/v tiles are fixed per super key-column; super q-rows
+    stream via the transposed LUT with the same bit convention."""
+    i, sk, t = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    n_t = pl.num_programs(2)
+    lh = _layout_head(i, heads, n_layout_heads)
+
+    @pl.when(t == 0)
+    def _init():
+        dk_sc[...] = jnp.zeros_like(dk_sc)
+        dv_sc[...] = jnp.zeros_like(dv_sc)
+
+    @pl.when(t < stcnt_ref[lh, sk])
+    def _step():
+        sqb = stlut_ref[lh, sk, t]
+        s = _agg_tile_scores(q_ref[0], k_ref[0], scale,
+                             stmask_ref[lh, sk, t], causal, sqb, sk, G, blk)
+        p = jnp.exp(s - lse_ref[0, 0][:, None])  # [G·blk, G·blk]
         dp = jax.lax.dot_general(do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         dv_sc[...] = dv_sc[...] + jax.lax.dot_general(
@@ -324,14 +509,194 @@ def _fbs_bwd(causal, interpret, res, g):
 _fbs_attention.defvjp(_fbs_fwd, _fbs_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(9, 10, 11))
+def _fbs_attention_agg(q, k, v, slut, scnt, smask, stlut, stcnt, stmask,
+                       causal, interpret, G):
+    out, _ = _fbs_fwd_agg(q, k, v, slut, scnt, smask, stlut, stcnt, stmask,
+                          causal, interpret, G)
+    return out
+
+
+def _fbs_fwd_agg(q, k, v, slut, scnt, smask, stlut, stcnt, stmask, causal,
+                 interpret, G):
+    b, s, h, d = q.shape
+    H, nsq, tmax = slut.shape
+    blk = s // (nsq * G)
+    scale = 1.0 / math.sqrt(d)
+    qf, kf, vf = _flatten_heads(q), _flatten_heads(k), _flatten_heads(v)
+    bh = b * h
+
+    kernel = functools.partial(_fwd_kernel_agg, scale=scale, causal=causal,
+                               heads=h, n_layout_heads=H, blk=blk, G=G)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=(bh, nsq, tmax),
+            in_specs=[
+                pl.BlockSpec((1, G * blk, d),
+                             lambda i, sq, t, lut_r, cnt_r, msk_r: (i, sq, 0)),
+                pl.BlockSpec((1, G * blk, d),
+                             lambda i, sq, t, lut_r, cnt_r, msk_r:
+                             (i, lut_r[_layout_head(i, h, H), sq, t], 0)),
+                pl.BlockSpec((1, G * blk, d),
+                             lambda i, sq, t, lut_r, cnt_r, msk_r:
+                             (i, lut_r[_layout_head(i, h, H), sq, t], 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, G * blk, d),
+                             lambda i, sq, t, lut_r, cnt_r, msk_r: (i, sq, 0)),
+                pl.BlockSpec((1, 1, G * blk),
+                             lambda i, sq, t, lut_r, cnt_r, msk_r: (i, 0, sq)),
+            ],
+            scratch_shapes=[
+                _VMEM((G * blk, 1), jnp.float32),
+                _VMEM((G * blk, 1), jnp.float32),
+                _VMEM((G * blk, d), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 1, s), jnp.float32),
+        ],
+        interpret=interpret,
+        **_grid_params(interpret),
+    )(slut, scnt, smask, qf, kf, vf)
+    outh = _unflatten_heads(out, b, h)
+    return outh, (q, k, v, slut, scnt, smask, stlut, stcnt, stmask, outh, lse)
+
+
+def _fbs_bwd_agg(causal, interpret, G, res, g):
+    (q, k, v, slut, scnt, smask, stlut, stcnt, stmask, out, lse) = res
+    b, s, h, d = q.shape
+    H, nsq, tmax = slut.shape
+    qmax = stlut.shape[-1]
+    blk = s // (nsq * G)
+    scale = 1.0 / math.sqrt(d)
+    bh = b * h
+
+    qf, kf, vf = _flatten_heads(q), _flatten_heads(k), _flatten_heads(v)
+    dof, of = _flatten_heads(g), _flatten_heads(out)
+    delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32), axis=-1,
+                    keepdims=True).transpose(0, 2, 1)  # [bh, 1, s]
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel_agg, scale=scale, causal=causal,
+                          heads=h, n_layout_heads=H, blk=blk, G=G),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=(bh, nsq, tmax),
+            in_specs=[
+                pl.BlockSpec((1, G * blk, d),
+                             lambda i, sq, t, lut_r, cnt_r, msk_r: (i, sq, 0)),
+                pl.BlockSpec((1, G * blk, d),
+                             lambda i, sq, t, lut_r, cnt_r, msk_r:
+                             (i, lut_r[_layout_head(i, h, H), sq, t], 0)),
+                pl.BlockSpec((1, G * blk, d),
+                             lambda i, sq, t, lut_r, cnt_r, msk_r:
+                             (i, lut_r[_layout_head(i, h, H), sq, t], 0)),
+                pl.BlockSpec((1, G * blk, d),
+                             lambda i, sq, t, lut_r, cnt_r, msk_r: (i, sq, 0)),
+                pl.BlockSpec((1, 1, G * blk),
+                             lambda i, sq, t, lut_r, cnt_r, msk_r: (i, 0, sq)),
+                pl.BlockSpec((1, 1, G * blk),
+                             lambda i, sq, t, lut_r, cnt_r, msk_r: (i, 0, sq)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, G * blk, d),
+                lambda i, sq, t, lut_r, cnt_r, msk_r: (i, sq, 0)),
+            scratch_shapes=[_VMEM((G * blk, d), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        interpret=interpret,
+        **_grid_params(interpret),
+    )(slut, scnt, smask, qf, kf, vf, dof, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel_agg, scale=scale, causal=causal,
+                          heads=h, n_layout_heads=H, blk=blk, G=G),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=(bh, nsq, qmax),
+            in_specs=[
+                pl.BlockSpec((1, G * blk, d),
+                             lambda i, sk, t, lut_r, cnt_r, msk_r:
+                             (i, lut_r[_layout_head(i, h, H), sk, t], 0)),
+                pl.BlockSpec((1, G * blk, d),
+                             lambda i, sk, t, lut_r, cnt_r, msk_r: (i, sk, 0)),
+                pl.BlockSpec((1, G * blk, d),
+                             lambda i, sk, t, lut_r, cnt_r, msk_r: (i, sk, 0)),
+                pl.BlockSpec((1, G * blk, d),
+                             lambda i, sk, t, lut_r, cnt_r, msk_r:
+                             (i, lut_r[_layout_head(i, h, H), sk, t], 0)),
+                pl.BlockSpec((1, 1, G * blk),
+                             lambda i, sk, t, lut_r, cnt_r, msk_r:
+                             (i, 0, lut_r[_layout_head(i, h, H), sk, t])),
+                pl.BlockSpec((1, 1, G * blk),
+                             lambda i, sk, t, lut_r, cnt_r, msk_r:
+                             (i, 0, lut_r[_layout_head(i, h, H), sk, t])),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, G * blk, d),
+                             lambda i, sk, t, lut_r, cnt_r, msk_r: (i, sk, 0)),
+                pl.BlockSpec((1, G * blk, d),
+                             lambda i, sk, t, lut_r, cnt_r, msk_r: (i, sk, 0)),
+            ],
+            scratch_shapes=[
+                _VMEM((G * blk, d), jnp.float32),
+                _VMEM((G * blk, d), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, s, d), v.dtype),
+        ],
+        interpret=interpret,
+        **_grid_params(interpret),
+    )(stlut, stcnt, stmask, qf, kf, vf, dof, lse, delta)
+
+    return (_unflatten_heads(dq, b, h), _unflatten_heads(dk, b, h),
+            _unflatten_heads(dv, b, h), None, None, None, None, None, None)
+
+
+_fbs_attention_agg.defvjp(_fbs_fwd_agg, _fbs_bwd_agg)
+
+
+def _pick_q_agg(blk, nb, q_agg):
+    """2-D aggregation factor: grow super-tiles toward the dense kernel's
+    tuned 512 width, bounded by the layout (nb % G == 0) and the 32-bit
+    per-tile mask (G·G <= 32 → G <= 5; 4 in practice).  Measured: blk 256
+    runs best UNaggregated (the G=2 union overhead beats the tile-shape
+    gain), so aggregation engages for blk <= 128 only."""
+    if q_agg == "never":
+        return 1
+    if q_agg in ("auto", None):
+        if blk > 128:
+            return 1
+        G = max(512 // blk, 1)
+    else:
+        # explicit factor: honored at ANY block size (ablations need it)
+        G = int(q_agg)
+    G = min(G, nb, 4)
+    while G > 1 and nb % G != 0:
+        G -= 1
+    return max(G, 1)
+
+
 def flash_block_sparse_attention(q, k, v, layout, causal=False,
-                                 interpret=False):
+                                 interpret=False, q_agg="auto"):
     """Block-sparse flash attention on ``[b, s, h, d]`` inputs.
 
     ``layout`` is the ``[H, nb, nb]`` 0/1 block layout (H == heads, or 1 for
-    a shared layout) produced by ``sparsity_config.make_layout``.  Layout
-    block size should be >= 128 for MXU efficiency (the reference's Triton
-    kernels use 16/32/64 blocks; TPU tiles want 128 lanes).
+    a shared layout) produced by ``sparsity_config.make_layout``.
+
+    Small layout blocks (the reference's Triton kernels run 16-px blocks;
+    BERT-scale configs use 128) starve the MXU as bare [blk, blk] tiles —
+    measured 0.76× vs dense at block 128 — so for ``blk < 512`` the kernel
+    aggregates ``q_agg`` consecutive layout rows per q tile (512 sublanes,
+    the dense kernel's tuned shape) and masks inactive (row, key-block)
+    pairs via a per-tick bitmask; dk/dv aggregates key rows symmetrically.
+    ``q_agg``: "auto" (default), "never", or an explicit factor.
 
     Requires the Mosaic PRNG-free feature set only; on CPU builds without
     ``jax.experimental.pallas.tpu``, use the gather-based
@@ -346,6 +711,12 @@ def flash_block_sparse_attention(q, k, v, layout, causal=False,
     assert s % nb == 0, f"seq {s} not divisible into {nb} blocks"
     assert layout.shape[0] in (1, h), (
         f"layout heads {layout.shape[0]} incompatible with {h} heads")
+    blk = s // nb
+    G = _pick_q_agg(blk, nb, q_agg)
+    if G > 1:
+        luts = tuple(jnp.asarray(a) for a in build_super_luts(layout, G))
+        return _fbs_attention_agg(q, k, v, *luts, bool(causal),
+                                  bool(interpret), G)
     lut, cnt, tlut, tcnt = (jnp.asarray(a) for a in build_block_luts(layout))
     return _fbs_attention(q, k, v, lut, cnt, tlut, tcnt, bool(causal),
                           bool(interpret))

@@ -72,6 +72,16 @@ def _auto_blocks(s, kv_len, d=64, causal=False):
     End-to-end GPT-2-medium seq-1024 throughput is within noise (attention
     is ~7% of that step); the win grows with seq (more straddling tiles
     avoided) and is free either way.
+
+    Round-4 re-audit (repeated two-point scans, b8 s1024 h16 d64 causal —
+    the GPT-2 bench shape, where the step profile puts attention at a
+    third of the step): q512/k512 is stable-best at ~3.0 ms fwd+bwd;
+    q256/k512 reads 3.6 ms and q256/k1024 is bistable (1.7–3.8 across
+    identical recompiles).  A single-shot sweep suggested q256/k512 won —
+    it did not replicate and did not move the end-to-end step; geometry
+    stays as round 3 tuned it.  The kernel is VPU-bound here (softmax
+    state updates serialize against half-width d=64 dots), so the next
+    lever is vector-work reduction, not block shape.
     """
     def pick(n, candidates):
         for c in candidates:
@@ -184,6 +194,10 @@ def _fwd_kernel(*refs, scale, causal, masked, dropout, single):
     # are a rounding error next to the score matmuls at these block sizes.
     needed = True if not causal else kb * block_k <= (j + 1) * block_q - 1
 
+    # (round-4 negative result: splitting this step into masked/unmasked
+    # variants so fully-below-diagonal tiles skip the causal iota/select
+    # measured 3.02 vs 3.00 ms at the GPT-2 shape — Mosaic overlaps that
+    # VPU work with the dots already; reverted to the single body)
     @pl.when(needed)
     def _step():
         s = _scores(q_ref[0], k_ref[0], scale, causal, masked, kvm_ref,

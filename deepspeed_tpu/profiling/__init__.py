@@ -1,5 +1,8 @@
 from .config import DeepSpeedFlopsProfilerConfig
 from .flops_profiler import (FlopsProfiler, count_fn_flops, get_model_profile)
+from .step_profiler import (model_scope_breakdown, timed_loop, timed_scan,
+                            wall_breakdown)
 
 __all__ = ["DeepSpeedFlopsProfilerConfig", "FlopsProfiler", "count_fn_flops",
-           "get_model_profile"]
+           "get_model_profile", "wall_breakdown", "model_scope_breakdown",
+           "timed_loop", "timed_scan"]

@@ -676,25 +676,13 @@ class DeepSpeedEngine:
                 out_specs=(rep, rep, rep),
                 axis_names={DATA_AXIS}, check_vma=False)(
                 batch, rng, cur_scale, extra, params)
-            # attribution OUTSIDE the manual region (debug callbacks don't
-            # compose with partial-auto shard_map): name the overflowed
-            # leaf so the NaN loss is traceable.  Optimizer moments are
-            # corrupted once the poison fires — restart from the last
-            # checkpoint after removing the leaf from sparse_gradients (or
-            # raising the token budget via a bigger micro-batch).
-            for leaf_key, d in drops.items():
-                jax.lax.cond(
-                    d > 0,
-                    lambda dd, k=leaf_key: jax.debug.print(
-                        "sparse_gradients budget overflow on leaf '{k}': "
-                        "{dd} rows dropped across ranks — gradient poisoned "
-                        "with NaN (loss will be NaN); restart from the last "
-                        "checkpoint with this leaf removed from "
-                        "sparse_gradients", k=k, dd=dd),
-                    lambda dd, k=leaf_key: None, d)
+            # the per-leaf drop counts flow OUT of the compiled program
+            # (device callbacks are unsupported on remote-attached
+            # backends, e.g. axon has no host send/recv) and the engine
+            # reports them host-side — see _check_sparse_overflow
             flat_g = self.flat.flatten_grads(grads)
             flat_g = jax.lax.with_sharding_constraint(flat_g, grad_sharding)
-            return sloss * grad_acc / cur_scale, flat_g
+            return sloss * grad_acc / cur_scale, flat_g, drops
 
         def loss_and_flat_grads(params, batch, rng, cur_scale, extra):
             if sparse_paths:
@@ -709,7 +697,7 @@ class DeepSpeedEngine:
             flat_g = self.flat.flatten_grads(grads)
             flat_g = jax.lax.with_sharding_constraint(flat_g, grad_sharding)
             loss = sloss * grad_acc / cur_scale
-            return loss, flat_g
+            return loss, flat_g, {}
 
         def fwd_bwd(params_or_master, batch, rng, cur_scale, extra):
             # trace-time: mesh-aware ops (ring attention) resolve THIS
@@ -718,7 +706,8 @@ class DeepSpeedEngine:
             params = cast_params(params_or_master) if stage3 else params_or_master
             return loss_and_flat_grads(params, batch, rng, cur_scale, extra)
 
-        self._fwd_bwd_fn = jax.jit(fwd_bwd, out_shardings=(None, grad_sharding))
+        self._fwd_bwd_fn = jax.jit(
+            fwd_bwd, out_shardings=(None, grad_sharding, None))
 
         def accum(acc, g):
             return acc + g
@@ -798,29 +787,36 @@ class DeepSpeedEngine:
                                      ustep * jnp.uint32(acc_steps))
 
             def micro(carry, xs):
-                acc, i = carry
+                acc, i, drops_acc = carry
                 batch_i = xs
-                loss, flat_g = loss_and_flat_grads(
+                loss, flat_g, drops = loss_and_flat_grads(
                     fwd_params, batch_i, jax.random.fold_in(rng, i), cur_scale,
                     extra)
-                return (acc + flat_g, i + 1), loss
+                # drops may cover a SUBSET of declared leaves (trace-time
+                # conditions skip some); keep the carry structure fixed
+                drops_acc = {k: (jnp.maximum(v, drops[k]) if k in drops
+                                 else v)
+                             for k, v in drops_acc.items()}
+                return (acc + flat_g, i + 1, drops_acc), loss
 
+            drops0 = {k: jnp.asarray(0, jnp.int32) for k in sparse_paths}
             if acc_steps == 1:
                 one = jax.tree_util.tree_map(lambda x: x[0], batches)
-                loss, flat_g = loss_and_flat_grads(fwd_params, one, rng,
-                                                   cur_scale, extra)
+                loss, flat_g, drops = loss_and_flat_grads(fwd_params, one, rng,
+                                                          cur_scale, extra)
                 losses = loss[None]
+                drops = {**drops0, **drops}
             else:
-                (flat_g, _), losses = jax.lax.scan(
+                (flat_g, _, drops), losses = jax.lax.scan(
                     micro, (jnp.zeros(segments.shape, jnp.float32),
-                            jnp.asarray(0, jnp.int32)), batches)
+                            jnp.asarray(0, jnp.int32), drops0), batches)
 
             (master, opt_state, scale_state, skipped, overflow,
              gnorm) = apply_update(master, opt_state, scale_state, skipped,
                                    flat_g, hp, segment_ids)
             new_params = None if stage3 else cast_params(master)
             return (jnp.mean(losses), master, opt_state, scale_state, skipped,
-                    ustep + jnp.uint32(1), overflow, gnorm, new_params)
+                    ustep + jnp.uint32(1), overflow, gnorm, new_params, drops)
 
         self._train_step_fn = jax.jit(
             train_step,
@@ -828,7 +824,7 @@ class DeepSpeedEngine:
             donate_argnums=(0, 1, 5),
             out_shardings=(None, master_out_sharding, opt_out_shardings, None,
                            None, None, None, None,
-                           None if stage3 else param_shardings))
+                           None if stage3 else param_shardings, None))
 
         # 1-bit Adam compressed phase: a second program with NO dense
         # gradient allreduce (host-side phase switch at freeze_step — the
@@ -939,6 +935,31 @@ class DeepSpeedEngine:
         key = jax.random.fold_in(self._rng, self.micro_steps)
         return key
 
+    def _check_sparse_overflow(self):
+        """Host-side attribution for the sparse_gradients NaN poison: the
+        compiled step returns per-leaf dropped-row counters (device
+        callbacks are unsupported on remote-attached backends, so the
+        print cannot live in the program).  Called at steps_per_print
+        cadence and from save_checkpoint; also public for direct use when
+        a NaN loss appears."""
+        drops = getattr(self, "_last_sparse_drops", None)
+        if not drops:
+            return {}
+        vals = {k: int(np.asarray(jax.device_get(v)).max())
+                for k, v in drops.items()}
+        for key, n in vals.items():
+            if n > 0:
+                logger.error(
+                    "sparse_gradients budget overflow on leaf '%s': %d rows "
+                    "dropped across ranks — its gradient was poisoned with "
+                    "NaN (loss will be NaN) and optimizer moments are "
+                    "corrupted; restart from the last checkpoint with this "
+                    "leaf removed from sparse_gradients (or raise the token "
+                    "budget via a larger micro-batch)", key, n)
+        return vals
+
+    sparse_overflow_report = _check_sparse_overflow
+
     # ------------------------------------------------------------------
     # train loop API (reference engine.py:796-1158)
     # ------------------------------------------------------------------
@@ -958,9 +979,12 @@ class DeepSpeedEngine:
         batch = self._shard_batch(batch)
         scale = self.state["scale"].cur_scale
         with self.mesh:
-            loss, flat_g = self._fwd_bwd_fn(self._forward_params(), batch,
-                                            self._next_rng(), scale,
-                                            self._extra_kwargs())
+            loss, flat_g, drops = self._fwd_bwd_fn(self._forward_params(),
+                                                   batch, self._next_rng(),
+                                                   scale,
+                                                   self._extra_kwargs())
+        if drops:
+            self._last_sparse_drops = drops
         self._pending_grads = flat_g
         self._last_loss = loss
         if self.wall_clock_breakdown():
@@ -1097,14 +1121,18 @@ class DeepSpeedEngine:
         if self._offload_eager:
             self._state_memory("device")
         with self.mesh:
-            (loss, self.state["master"], self.state["opt"], self.state["scale"],
-             self.state["skipped"], self.state["ustep"], overflow, gnorm,
-             new_params) = \
-                step_fn(self.state["master"], self.state["opt"],
-                        self.state["scale"], self.state["skipped"],
-                        self.state["ustep"], self._module_params,
-                        packed, spec, hp,
-                        self._segment_ids, self._extra_kwargs())
+            out = step_fn(self.state["master"], self.state["opt"],
+                          self.state["scale"], self.state["skipped"],
+                          self.state["ustep"], self._module_params,
+                          packed, spec, hp,
+                          self._segment_ids, self._extra_kwargs())
+        # the regular step carries a trailing sparse-overflow counter dict;
+        # the 1-bit compressed program (no sparse exchange) does not
+        (loss, self.state["master"], self.state["opt"], self.state["scale"],
+         self.state["skipped"], self.state["ustep"], overflow, gnorm,
+         new_params) = out[:9]
+        if len(out) > 9 and out[9]:
+            self._last_sparse_drops = out[9]
         if self.zero_stage < 3:
             self._module_params = new_params
         if self._offload_eager:
@@ -1134,6 +1162,7 @@ class DeepSpeedEngine:
             # monitor scalars share the steps_per_print cadence: fetching
             # the loss is a host sync, so it must stay off the per-step
             # critical path
+            self._check_sparse_overflow()
             lr = self.get_lr()[0] if self.optimizer.param_groups else 0.0
             loss_val = float(jax.device_get(loss))
             scale = self.loss_scale if self._config.fp16_enabled else 1.0
@@ -1212,6 +1241,7 @@ class DeepSpeedEngine:
         checkpoint trick, ``stage1.py:848-883``), a meta json, and a
         ``latest`` tag pointer.
         """
+        self._check_sparse_overflow()
         tag = tag or f"global_step{self.global_steps}"
         ckpt_dir = os.path.join(save_dir, str(tag))
         os.makedirs(ckpt_dir, exist_ok=True)

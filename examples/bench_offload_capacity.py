@@ -1,0 +1,124 @@
+"""ZeRO-Offload capacity headline: largest model trainable on ONE chip.
+
+The reference's ZeRO-Offload claim is "10× bigger models on one GPU —
+13B params on a single V100-32GB" (``docs/_posts/2020-09-09-
+ZeRO-Offload.md:10``).  This measures the TPU framework's analog on the
+single v5e (16 GB HBM): walk GPT-2-family configs upward, try a few
+training steps with ``cpu_offload`` off vs on, record the largest config
+that trains and the offload step-time tax.
+
+Each trial runs in a FRESH SUBPROCESS: compiled executables and buffers
+from a previous trial linger in-process (observed: a config that OOMs
+after prior same-process trials trains fine alone), so isolation is the
+only way to get truthful capacity numbers.
+
+Usage: python examples/bench_offload_capacity.py [quick]
+"""
+
+import os
+import subprocess
+import sys
+
+SEQ = 1024
+BATCH = int(os.environ.get("CAP_BATCH", "4"))
+STEPS = int(os.environ.get("CAP_STEPS", "6"))
+
+# (name, hidden, layers, heads) — params ≈ 12·L·h² + vocab·h
+LADDER = [
+    ("gpt2-medium-0.35B", 1024, 24, 16),
+    ("gpt2-large-0.77B", 1280, 36, 20),
+    ("gpt2-xl-1.5B", 1600, 48, 25),
+    ("gpt2-2.7B", 2560, 32, 32),
+    ("gpt2-4.2B", 3072, 36, 32),
+    ("gpt2-6.7B", 4096, 32, 32),
+]
+
+_TRIAL = r"""
+import time, numpy as np, jax
+import deepspeed_tpu as deepspeed
+from deepspeed_tpu.models import GPT2Config, GPT2LMHeadTPU
+from deepspeed_tpu.parallel import make_mesh
+import os
+h = int(os.environ["T_H"]); L = int(os.environ["T_L"])
+heads = int(os.environ["T_HEADS"]); off = os.environ["T_OFF"] == "1"
+batch = int(os.environ["T_B"]); steps = int(os.environ["T_S"])
+cfg = GPT2Config(hidden_size=h, num_layers=L, num_heads=heads,
+                 max_position_embeddings=1024, embd_dropout=0.0,
+                 attn_dropout=0.0, resid_dropout=0.0,
+                 remat=True, loss_chunk=256)
+mesh = make_mesh({"data": 1}, devices=jax.devices()[:1])
+model = GPT2LMHeadTPU(cfg)
+engine, *_ = deepspeed.initialize(model=model, mesh=mesh,
+    config={"train_batch_size": batch, "steps_per_print": 10 ** 9,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+            "zero_optimization": {"stage": 2, "cpu_offload": off},
+            "bf16": {"enabled": True}})
+rng = np.random.default_rng(0)
+b = {"input_ids": rng.integers(0, cfg.vocab_size,
+                               size=(batch, 1024)).astype(np.int32)}
+# TWO fenced warmups: the engine compiles a second program on step 1
+for _ in range(2):
+    loss = engine.train_batch(iter([b]))
+    float(np.asarray(jax.device_get(loss)))
+t0 = time.perf_counter()
+for _ in range(steps):
+    loss = engine.train_batch(iter([b]))
+v = float(np.asarray(jax.device_get(loss)))
+dt = (time.perf_counter() - t0) / steps
+assert np.isfinite(v)
+print(f"CAP_RESULT {dt * 1e3:.0f}")
+"""
+
+
+def param_count(h, L, vocab=50257, pos=SEQ):
+    return 12 * L * h * h + (vocab + pos) * h + 2 * h
+
+
+def try_step(offload, hidden, layers, heads):
+    env = dict(os.environ, T_H=str(hidden), T_L=str(layers),
+               T_HEADS=str(heads), T_OFF="1" if offload else "0",
+               T_B=str(BATCH), T_S=str(STEPS))
+    proc = subprocess.run([sys.executable, "-u", "-c", _TRIAL], env=env,
+                          capture_output=True, text=True, timeout=1800)
+    for line in proc.stdout.splitlines():
+        if line.startswith("CAP_RESULT "):
+            return True, float(line.split()[1]) / 1e3
+    err = proc.stdout[-300:] + proc.stderr[-300:]
+    oom = "RESOURCE_EXHAUSTED" in err or "memory space hbm" in err \
+        or "Out of memory" in err
+    return False, ("OOM" if oom else err.replace("\n", " ")[-200:])
+
+
+def main():
+    quick = "quick" in sys.argv[1:]
+    ladder = LADDER[:3] if quick else LADDER
+    results = {}
+    for offload in (False, True):
+        mode = "offload" if offload else "device"
+        for name, h, L, heads in ladder:
+            ok, info = try_step(offload, h, L, heads)
+            n = param_count(h, L)
+            if ok:
+                print(f"[{mode}] {name}: OK  {info * 1e3:.0f} ms/step "
+                      f"({BATCH * SEQ / info:.0f} tok/s, {n / 1e9:.2f}B)",
+                      flush=True)
+                results[(mode, name)] = info
+            else:
+                print(f"[{mode}] {name}: FAIL {info} ({n / 1e9:.2f}B)",
+                      flush=True)
+                break  # ladder is monotone in memory need
+
+    order = [name for name, *_ in LADDER]
+    print("\nsummary:")
+    for mode in ("device", "offload"):
+        ok_names = [n for n in order if (mode, n) in results]
+        if ok_names:
+            largest = ok_names[-1]
+            print(f"  {mode}: largest trainable = {largest} "
+                  f"({results[(mode, largest)] * 1e3:.0f} ms/step)")
+        else:
+            print(f"  {mode}: nothing trained")
+
+
+if __name__ == "__main__":
+    main()

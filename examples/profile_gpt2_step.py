@@ -1,0 +1,140 @@
+"""GPT-2-medium step profile: per-scope wall attribution + ablations.
+
+Finds where the GPT-2 training step's wall time goes on the real chip
+(the bench.py row: h1024 L24 seq1024 vocab 50257, batch 8, ZeRO-2 + Lamb,
+bf16, dropout 0.1).  Two instruments:
+
+1. ``wall_breakdown`` — the engine's sub-programs (fwd / bwd / optimizer
+   + flatten / param cast);
+2. ``model_scope_breakdown`` — nested model scopes (embed → trunk →
+   +head/CE), differenced to attribute the LM head;
+3. ablation engines — one knob changed each (dropout off, Adam, XLA
+   attention, chunked CE, ZeRO stage 0), train_batch wall deltas.
+
+Usage: python examples/profile_gpt2_step.py [quick]
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+STEPS = int(os.environ.get("PROF_STEPS", "10"))
+WARMUP = int(os.environ.get("PROF_WARMUP", "3"))
+BATCH = int(os.environ.get("PROF_BATCH", "8"))
+SEQ = 1024
+
+
+def build_engine(deepspeed, mesh, dropout=0.1, optimizer="Lamb", zero=2,
+                 loss_chunk=0, attn_impl="auto", hidden=1024, layers=24,
+                 heads=16):
+    from deepspeed_tpu.models import GPT2Config, GPT2LMHeadTPU
+
+    cfg = GPT2Config(hidden_size=hidden, num_layers=layers, num_heads=heads,
+                     max_position_embeddings=SEQ, embd_dropout=dropout,
+                     attn_dropout=dropout, resid_dropout=dropout,
+                     loss_chunk=loss_chunk, attn_impl=attn_impl)
+    model = GPT2LMHeadTPU(cfg)
+    engine, *_ = deepspeed.initialize(
+        model=model, mesh=mesh,
+        config={"train_batch_size": BATCH, "steps_per_print": 10 ** 9,
+                "optimizer": {"type": optimizer, "params": {"lr": 1e-4}},
+                "zero_optimization": {"stage": zero},
+                "bf16": {"enabled": True}})
+    return engine, model, cfg
+
+
+def main():
+    import jax
+
+    import deepspeed_tpu as deepspeed
+    from deepspeed_tpu.parallel import make_mesh
+    from deepspeed_tpu.profiling import (model_scope_breakdown, timed_loop,
+                                         wall_breakdown)
+
+    quick = "quick" in sys.argv[1:]
+    mesh = make_mesh({"data": 1}, devices=jax.devices()[:1])
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(
+        0, 50257, size=(BATCH, SEQ)).astype(np.int32)}
+
+    print(f"== GPT-2-medium step profile (batch {BATCH}, seq {SEQ}, "
+          f"steps {STEPS}) ==", flush=True)
+
+    # -- baseline engine: sub-program breakdown -------------------------
+    t0 = time.perf_counter()
+    engine, model, cfg = build_engine(deepspeed, mesh)
+    print(f"[engine built in {time.perf_counter() - t0:.0f}s]", flush=True)
+    t0 = time.perf_counter()
+    bd = wall_breakdown(engine, batch, steps=STEPS, warmup=WARMUP)
+    print(f"[breakdown took {time.perf_counter() - t0:.0f}s]")
+    for k, v in bd.items():
+        print(f"  {k:>22}: {v:8.2f} ms")
+    total = bd["train_step"]
+    sps = BATCH / (total / 1e3)
+    print(f"  baseline throughput: {sps:.1f} samples/s")
+
+    # -- model scopes ---------------------------------------------------
+    import jax.numpy as jnp
+
+    base_rng = engine._next_rng()
+
+    def sc_embed(p, i):
+        ids = jnp.asarray(batch["input_ids"])
+        x = jnp.take(p["wte"], ids, axis=0) + p["wpe"][None, :SEQ]
+        return jnp.sum(x.astype(jnp.float32) ** 2) * 1e-9
+
+    def sc_hidden(p, i):
+        r = jax.random.fold_in(base_rng, i)
+        x = model.hidden(p, jnp.asarray(batch["input_ids"]), rng=r,
+                         deterministic=False)
+        return jnp.sum(x.astype(jnp.float32) ** 2) * 1e-9
+
+    def sc_full(p, i):
+        r = jax.random.fold_in(base_rng, i)
+        return model.apply(p, batch, rng=r, train=True)
+
+    scopes = model_scope_breakdown(
+        engine, {"embed": sc_embed, "hidden(trunk)": sc_hidden,
+                 "full(+head/CE)": sc_full},
+        steps=max(STEPS // 2, 4), warmup=2)
+    for name, d in scopes.items():
+        print(f"  scope {name:>16}: fwd {d['fwd']:7.2f} ms   "
+              f"fwd+bwd {d['fwd_bwd']:8.2f} ms")
+    head = (scopes["full(+head/CE)"]["fwd_bwd"]
+            - scopes["hidden(trunk)"]["fwd_bwd"])
+    print(f"  derived LM head + CE (fwd+bwd): {head:.2f} ms")
+    del engine, model
+
+    if quick:
+        return
+
+    # -- ablations: one knob each --------------------------------------
+    def steptime(**kw):
+        e, m, _ = build_engine(deepspeed, mesh, **kw)
+        t = timed_loop(lambda: e.train_batch(iter([batch])),
+                       steps=STEPS, warmup=WARMUP) * 1e3
+        del e, m
+        return t
+
+    ablations = {
+        "dropout=0": dict(dropout=0.0),
+        "optimizer=Adam": dict(optimizer="Adam"),
+        "zero_stage=0": dict(zero=0),
+        "loss_chunk=256": dict(loss_chunk=256),
+        "attn=XLA (no flash)": dict(attn_impl="auto"),  # env flip below
+    }
+    for name, kw in ablations.items():
+        if "attn=" in name:
+            os.environ["DS_FLASH_ATTENTION"] = "never"
+        try:
+            t = steptime(**kw)
+        finally:
+            os.environ.pop("DS_FLASH_ATTENTION", None)
+        print(f"  ablation {name:>20}: {t:8.2f} ms  "
+              f"(delta {t - total:+7.2f} ms vs baseline)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,162 @@
+"""Model-scale functional-test harness.
+
+TPU analog of the reference's model-level test flow
+(``/root/reference/tests/model/Megatron_GPT2/run_func_test.py`` — train a
+real-config model under a DeepSpeed-config matrix, grep the loss curve
+from the run log, compare against the baseline run — and
+``/root/reference/tests/model/BingBertSquad/test_e2e_squad.py`` — drive a
+QA fine-tune and assert EM/F1 thresholds).
+
+Everything runs on fixed synthetic data (deterministic seeds) so curves
+are reproducible and pinnable.  The MLM phase trains real-width BERT-base
+(h768 L12 i3072, the reference's bert-pretraining config); the QA phase
+is a learnable extractive-span task: each sequence carries one MARKER
+token pair and the answer span is the tokens between them, so a
+converged model must attend to content (the synthetic stand-in for
+SQuAD's answer-span supervision).
+"""
+
+import json
+import os
+import re
+
+import numpy as np
+
+VOCAB = 30528
+MARKER_OPEN, MARKER_CLOSE = 5, 6  # reserved marker token ids
+LOSS_RE = re.compile(r"^step: (\d+) loss: ([0-9.eE+-]+)$")
+
+
+def bert_base_config(seq=128, dropout=0.1):
+    from deepspeed_tpu.models.bert import BertConfig
+
+    return BertConfig(
+        vocab_size=VOCAB, hidden_size=768, num_hidden_layers=12,
+        num_attention_heads=12, intermediate_size=3072,
+        max_position_embeddings=max(seq, 128),
+        hidden_dropout_prob=dropout, attention_probs_dropout_prob=dropout)
+
+
+def mlm_batches(seed, n_batches, batch, seq, n_pred=8):
+    """Fixed synthetic MLM+NSP batches (bing_bert contract: exactly
+    ``n_pred`` masked positions per row)."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n_batches):
+        ids = rng.integers(10, VOCAB, size=(batch, seq)).astype(np.int32)
+        labels = np.full((batch, seq), -100, np.int32)
+        for r in range(batch):
+            pos = rng.permutation(seq)[:n_pred]
+            labels[r, pos] = ids[r, pos]
+        out.append({
+            "input_ids": ids,
+            "masked_lm_labels": labels,
+            "next_sentence_label": rng.integers(
+                0, 2, size=(batch,)).astype(np.int32),
+        })
+    return out
+
+
+def qa_batches(seed, n_batches, batch, seq):
+    """Synthetic extractive-QA batches: one MARKER_OPEN..MARKER_CLOSE span
+    per row; start/end positions point at the span interior."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n_batches):
+        ids = rng.integers(10, VOCAB, size=(batch, seq)).astype(np.int32)
+        starts = np.zeros((batch,), np.int32)
+        ends = np.zeros((batch,), np.int32)
+        for r in range(batch):
+            span = int(rng.integers(1, 4))
+            s = int(rng.integers(1, seq - span - 1))
+            ids[r, s - 1] = MARKER_OPEN
+            ids[r, s + span] = MARKER_CLOSE
+            starts[r], ends[r] = s, s + span - 1
+        out.append({"input_ids": ids, "start_positions": starts,
+                    "end_positions": ends})
+    return out
+
+
+def make_engine(model, ds_config, n_devices=1):
+    import jax
+
+    import deepspeed_tpu as deepspeed
+    from deepspeed_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"data": n_devices}, devices=jax.devices()[:n_devices])
+    engine, *_ = deepspeed.initialize(model=model, config=ds_config,
+                                      mesh=mesh)
+    return engine
+
+
+def train_curve(engine, data, steps, log_path=None, sample_every=1):
+    """Train ``steps`` steps cycling ``data``; returns the sampled loss
+    curve and (optionally) writes the reference-style run log that
+    :func:`grep_loss_from_file` parses."""
+    import jax
+
+    lines = []
+    losses = []
+    for t in range(steps):
+        loss = engine.train_batch(iter([data[t % len(data)]]))
+        if t % sample_every == 0 or t == steps - 1:
+            val = float(np.asarray(jax.device_get(loss)))
+            losses.append(val)
+            lines.append(f"step: {t} loss: {val:.6f}")
+    if log_path:
+        with open(log_path, "w") as f:
+            f.write("\n".join(lines) + "\n")
+    return losses
+
+
+def grep_loss_from_file(path):
+    """Parse ``step: N loss: X`` lines (the reference's
+    ``run_func_test.py:20`` log-grepping contract)."""
+    losses = {}
+    with open(path) as f:
+        for line in f:
+            m = LOSS_RE.match(line.strip())
+            if m:
+                losses[int(m.group(1))] = float(m.group(2))
+    assert losses, f"no loss lines found in {path}"
+    return [losses[k] for k in sorted(losses)]
+
+
+def qa_em_f1(engine, model, eval_batches):
+    """Extractive-QA EM / F1 (the BingBertSquad ``test_e2e_squad.py``
+    metrics): predict argmax start/end, exact-match and token-overlap F1
+    against the gold span."""
+    import jax
+
+    em_hits, f1_sum, n = 0, 0.0, 0
+    for b in eval_batches:
+        logits = engine.eval_batch({"input_ids": b["input_ids"]})
+        start_logits, end_logits = logits
+        ps = np.asarray(jax.device_get(start_logits)).argmax(-1)
+        pe = np.asarray(jax.device_get(end_logits)).argmax(-1)
+        for r in range(len(ps)):
+            gs, ge = int(b["start_positions"][r]), int(b["end_positions"][r])
+            s, e = int(ps[r]), int(pe[r])
+            em_hits += int(s == gs and e == ge)
+            pred = set(range(s, max(e, s) + 1))
+            gold = set(range(gs, ge + 1))
+            inter = len(pred & gold)
+            if inter:
+                p_, r_ = inter / len(pred), inter / len(gold)
+                f1_sum += 2 * p_ * r_ / (p_ + r_)
+            n += 1
+    return em_hits / n, f1_sum / n
+
+
+def load_or_update_baseline(path, key, curve, update_env="DS_UPDATE_BASELINES"):
+    """Pin ``curve`` under ``key`` in a JSON baseline file; regenerate with
+    ``DS_UPDATE_BASELINES=1`` (the convergence suite's protocol)."""
+    baselines = {}
+    if os.path.isfile(path):
+        with open(path) as f:
+            baselines = json.load(f)
+    if os.environ.get(update_env) == "1" or key not in baselines:
+        baselines[key] = [round(v, 6) for v in curve]
+        with open(path, "w") as f:
+            json.dump(baselines, f, indent=1, sort_keys=True)
+    return baselines[key]

@@ -1,0 +1,133 @@
+"""Model-scale functional test driver (standalone).
+
+The reference's ``tests/model/Megatron_GPT2/run_func_test.py`` trains
+real Megatron-GPT2 runs under a matrix of DeepSpeed configs, greps the
+loss curves from the logs, and compares each DS config against the
+baseline run; ``BingBertSquad/test_e2e_squad.py`` then gates a SQuAD
+fine-tune on EM/F1.  This driver is that flow for the TPU framework:
+
+1. real-config BERT-base MLM pretraining on fixed synthetic data for a
+   few hundred steps, once per config in the matrix (baseline Adam,
+   ZeRO-1, ZeRO-2, ZeRO-2+Lamb, bf16);
+2. every config's grep'd loss curve must track the baseline's;
+3. a QA (extractive-span) fine-tune gated on EM/F1.
+
+Runs on whatever backend JAX selects (on the TPU tier this is minutes;
+on CPU pass ``--steps`` to shrink).  Usage::
+
+    python tests/model/run_func_test.py [--steps N] [--batch B] [--seq S]
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+from tests.model import func_harness as H  # noqa: E402
+
+BASELINE_KEY = "baseline_adam"
+
+CONFIG_MATRIX = {
+    BASELINE_KEY: {"optimizer": {"type": "Adam", "params": {"lr": 1e-4}}},
+    "zero1_adam": {"optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+                   "zero_optimization": {"stage": 1}},
+    "zero2_adam": {"optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+                   "zero_optimization": {"stage": 2}},
+    "zero2_lamb": {"optimizer": {"type": "Lamb", "params": {"lr": 2e-3}},
+                   "zero_optimization": {"stage": 2}},
+    "zero2_bf16": {"optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+                   "zero_optimization": {"stage": 2},
+                   "bf16": {"enabled": True}},
+}
+
+
+def run_matrix(steps, batch, seq, out_dir, n_devices=1):
+    from deepspeed_tpu.models.bert import BertForPreTrainingTPU
+
+    data = H.mlm_batches(seed=17, n_batches=8, batch=batch, seq=seq)
+    curves = {}
+    for name, overrides in CONFIG_MATRIX.items():
+        cfg = dict({"train_batch_size": batch, "steps_per_print": 10 ** 9},
+                   **overrides)
+        model = BertForPreTrainingTPU(H.bert_base_config(seq))
+        engine = H.make_engine(model, cfg, n_devices)
+        log = os.path.join(out_dir, f"func_{name}.log")
+        H.train_curve(engine, data, steps, log_path=log,
+                      sample_every=max(steps // 20, 1))
+        curves[name] = H.grep_loss_from_file(log)
+        print(f"[{name}] first {curves[name][0]:.4f} "
+              f"last {curves[name][-1]:.4f}", flush=True)
+        del engine, model
+    return curves
+
+
+def check_matrix(curves, rtol):
+    """Every DS config's curve must track the baseline's (the reference's
+    baseline-vs-deepspeed loss comparison)."""
+    base = np.asarray(curves[BASELINE_KEY])
+    assert base[-1] < base[0], "baseline did not train"
+    failures = []
+    for name, c in curves.items():
+        if name == BASELINE_KEY:
+            continue
+        c = np.asarray(c)
+        # bf16/lamb runs differ in arithmetic; compare trajectory shape:
+        # strictly decreasing trend and a final loss within rtol of base
+        if not np.allclose(c[-1], base[-1], rtol=rtol):
+            failures.append(f"{name}: final {c[-1]:.4f} vs baseline "
+                            f"{base[-1]:.4f} (rtol {rtol})")
+        if not c[-1] < c[0]:
+            failures.append(f"{name}: loss did not decrease "
+                            f"({c[0]:.4f} -> {c[-1]:.4f})")
+    assert not failures, "config-matrix drift:\n" + "\n".join(failures)
+
+
+def run_qa_gate(steps, batch, seq, em_min, f1_min, n_devices=1, lr=3e-4):
+    from deepspeed_tpu.models.bert import BertForQuestionAnsweringTPU
+
+    model = BertForQuestionAnsweringTPU(H.bert_base_config(seq, dropout=0.0))
+    # warmup is load-bearing: from-scratch post-LN BERT-base sits on the
+    # uniform plateau (loss == ln(seq)) without it
+    engine = H.make_engine(
+        model, {"train_batch_size": batch, "steps_per_print": 10 ** 9,
+                "optimizer": {"type": "Adam", "params": {"lr": lr}},
+                "scheduler": {"type": "WarmupLR",
+                              "params": {"warmup_min_lr": 0.0,
+                                         "warmup_max_lr": lr,
+                                         "warmup_num_steps": max(steps // 5,
+                                                                 10)}}},
+        n_devices)
+    train = H.qa_batches(seed=23, n_batches=8, batch=batch, seq=seq)
+    H.train_curve(engine, train, steps)
+    em, f1 = H.qa_em_f1(engine, model,
+                        H.qa_batches(seed=99, n_batches=2, batch=batch,
+                                     seq=seq))
+    print(f"[qa] EM {em:.3f} F1 {f1:.3f} (gates: {em_min}/{f1_min})",
+          flush=True)
+    assert em >= em_min and f1 >= f1_min, (
+        f"QA gate failed: EM {em:.3f} < {em_min} or F1 {f1:.3f} < {f1_min}")
+    return em, f1
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=300)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--seq", type=int, default=128)
+    ap.add_argument("--qa_steps", type=int, default=200)
+    ap.add_argument("--rtol", type=float, default=0.05)
+    ap.add_argument("--out", type=str, default="/tmp/ds_func_test")
+    args = ap.parse_args()
+    os.makedirs(args.out, exist_ok=True)
+
+    curves = run_matrix(args.steps, args.batch, args.seq, args.out)
+    check_matrix(curves, args.rtol)
+    run_qa_gate(args.qa_steps, args.batch, args.seq, em_min=0.75, f1_min=0.85)
+    print("run_func_test: ALL PASS")
+
+
+if __name__ == "__main__":
+    main()
