@@ -677,10 +677,21 @@ def _pick_q_agg(blk, nb, q_agg):
     else:
         # explicit factor: honored at ANY block size (ablations need it)
         G = int(q_agg)
+    requested = G
     G = min(G, nb, 4)
     while G > 1 and nb % G != 0:
         G -= 1
-    return max(G, 1)
+    G = max(G, 1)
+    if q_agg not in ("auto", None, "never") and G != requested:
+        # an ablation must not silently measure a different kernel than
+        # it asked for
+        from ...utils.logging import logger
+
+        logger.warning(
+            "flash_block_sparse_attention: explicit q_agg=%s clamped to "
+            "G=%d (bounds: nb=%d divisibility, mask budget G<=4)",
+            q_agg, G, nb)
+    return G
 
 
 def flash_block_sparse_attention(q, k, v, layout, causal=False,

@@ -34,6 +34,7 @@ saved mask.
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -55,6 +56,20 @@ NEG_INF = -1e30
 # key is masked out (m would otherwise be NEG_INF and exp(0) = 1).
 MAX_FLOOR = -1e20
 
+# Base-2 softmax: fold log2(e) into the score scale so the per-element
+# transcendental is exp2 instead of exp (one fewer VPU multiply per score
+# entry; the probabilities are bit-comparable — exp2(x·log2e) == exp(x) up
+# to fp rounding).  The logsumexp residual is stored in base 2 so forward
+# and backward agree; the natural-scale 1/√d still lands on dq/dk.  Read
+# once at import: the choice bakes into the jit cache (same contract as
+# DS_FLASH_ATTENTION — see ADVICE round 3).
+EXP2 = os.environ.get("DS_FLASH_EXP2", "0") != "0"
+LOG2E = 1.4426950408889634
+
+
+def _ex(x, exp2):
+    return jnp.exp2(x) if exp2 else jnp.exp(x)
+
 
 def _auto_blocks(s, kv_len, d=64, causal=False):
     """Largest MXU-friendly blocks the sequence lengths divide into.
@@ -75,13 +90,19 @@ def _auto_blocks(s, kv_len, d=64, causal=False):
 
     Round-4 re-audit (repeated two-point scans, b8 s1024 h16 d64 causal —
     the GPT-2 bench shape, where the step profile puts attention at a
-    third of the step): q512/k512 is stable-best at ~3.0 ms fwd+bwd;
-    q256/k512 reads 3.6 ms and q256/k1024 is bistable (1.7–3.8 across
-    identical recompiles).  A single-shot sweep suggested q256/k512 won —
-    it did not replicate and did not move the end-to-end step; geometry
-    stays as round 3 tuned it.  The kernel is VPU-bound here (softmax
-    state updates serialize against half-width d=64 dots), so the next
-    lever is vector-work reduction, not block shape.
+    third of the step): among streamed geometries q512/k512 is stable-best
+    at ~3.0 ms fwd+bwd; q256/k512 reads 3.6 ms and q256/k1024 is bistable
+    (1.7–3.8 across identical recompiles).  But the SINGLE-TILE path at
+    q1024/k1024 beats them all — 2.3–2.6 ms no-dropout, 2.8–3.3 with
+    dropout, vs 3.0–3.3 / 3.3–4.3 for the round-3 auto choice — despite
+    executing the full (unskipped) score tile: the straight-line softmax
+    with no scratch round-trips and full-width PV lanes more than pays for
+    the 2x causal MXU waste at this size.  So for causal shapes up to
+    s=1024 the auto policy now prefers one full tile; past that the
+    streamed q512 geometry still wins (the waste grows quadratically).
+    (Also measured, negative: base-2 softmax (DS_FLASH_EXP2) is a wash —
+    Mosaic's exp already costs the same as exp2 — and a masked/unmasked
+    tile split gains zero; both knobs documented, not defaulted.)
     """
     def pick(n, candidates):
         for c in candidates:
@@ -89,7 +110,16 @@ def _auto_blocks(s, kv_len, d=64, causal=False):
                 return c
         return n
 
-    block_q = pick(s, (512, 256, 128))
+    qcands = (512, 256, 128)
+    if (causal and s == kv_len and s <= 1024
+            and (128 * 1024) // max(d, 1) >= s):
+        # single full tile (see docstring: measured best at the GPT-2
+        # shape; n_kb == 1 takes the scratch-free straight-line kernel).
+        # The d-gate keeps this to shapes where block_k can also reach s —
+        # otherwise the pick would silently swap the measured q512 streamed
+        # geometry for an unmeasured q1024 streamed one.
+        qcands = (1024,) + qcands
+    block_q = pick(s, qcands)
     kmax = max(128, (128 * 1024) // max(d, 1))
     if causal:
         kmax = min(kmax, block_q)
@@ -149,7 +179,7 @@ def _scores(q_blk, k_blk, scale, causal, masked, kvm_ref, j, kb, block_q,
     return s
 
 
-def _fwd_kernel(*refs, scale, causal, masked, dropout, single):
+def _fwd_kernel(*refs, scale, causal, masked, dropout, single, exp2):
     refs = list(refs)
     q_ref, k_ref, v_ref = refs[:3]
     rest = refs[3:]
@@ -161,14 +191,16 @@ def _fwd_kernel(*refs, scale, causal, masked, dropout, single):
     block_k = k_ref.shape[1]
     i, j, kb = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     n_kb = pl.num_programs(2)
+    score_scale = scale * LOG2E if exp2 else scale
+    log = jnp.log2 if exp2 else jnp.log
 
     if single:
         # one k block: straight-line softmax, no scratch round-trips (the
         # common short-sequence case; ~25% faster than the streamed form)
-        s = _scores(q_ref[0], k_ref[0], scale, causal, masked, kvm_ref,
+        s = _scores(q_ref[0], k_ref[0], score_scale, causal, masked, kvm_ref,
                     j, kb, block_q, block_k)
         m = jnp.maximum(jnp.max(s, axis=1, keepdims=True), MAX_FLOOR)
-        p = jnp.exp(s - m)
+        p = _ex(s - m, exp2)
         l = jnp.sum(p, axis=1, keepdims=True)
         if dropout:
             thresh, inv_keep = _dropout_thresh(dropout)
@@ -179,7 +211,7 @@ def _fwd_kernel(*refs, scale, causal, masked, dropout, single):
                                   preferred_element_type=jnp.float32)
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
-        lse_ref[0, 0] = (m + jnp.log(l_safe))[:, 0]
+        lse_ref[0, 0] = (m + log(l_safe))[:, 0]
         return
 
     @pl.when(kb == 0)
@@ -200,13 +232,13 @@ def _fwd_kernel(*refs, scale, causal, masked, dropout, single):
     # VPU work with the dots already; reverted to the single body)
     @pl.when(needed)
     def _step():
-        s = _scores(q_ref[0], k_ref[0], scale, causal, masked, kvm_ref,
+        s = _scores(q_ref[0], k_ref[0], score_scale, causal, masked, kvm_ref,
                     j, kb, block_q, block_k)
         m, l = m_sc[...], l_sc[...]
         m_new = jnp.maximum(jnp.maximum(m, jnp.max(s, axis=1, keepdims=True)),
                             MAX_FLOOR)
-        p = jnp.exp(s - m_new)
-        corr = jnp.exp(m - m_new)
+        p = _ex(s - m_new, exp2)
+        corr = _ex(m - m_new, exp2)
         # l accumulates the UNdropped sum (softmax normalizer); dropout hits
         # only the value accumulation, so out == dropout(softmax(s)) @ v.
         l_sc[...] = l * corr + jnp.sum(p, axis=1, keepdims=True)
@@ -224,10 +256,10 @@ def _fwd_kernel(*refs, scale, causal, masked, dropout, single):
         l = l_sc[...]
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_sc[...] / l_safe).astype(o_ref.dtype)
-        lse_ref[0, 0] = (m_sc[...] + jnp.log(l_safe))[:, 0]
+        lse_ref[0, 0] = (m_sc[...] + log(l_safe))[:, 0]
 
 
-def _bwd_dq_kernel(*refs, scale, causal, masked, dropout, single):
+def _bwd_dq_kernel(*refs, scale, causal, masked, dropout, single, exp2):
     refs = list(refs)
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
     rest = refs[6:]
@@ -240,10 +272,12 @@ def _bwd_dq_kernel(*refs, scale, causal, masked, dropout, single):
     i, j, kb = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     n_kb = pl.num_programs(2)
 
+    score_scale = scale * LOG2E if exp2 else scale
+
     def tile_dq():
-        s = _scores(q_ref[0], k_ref[0], scale, causal, masked, kvm_ref,
+        s = _scores(q_ref[0], k_ref[0], score_scale, causal, masked, kvm_ref,
                     j, kb, block_q, block_k)
-        p = jnp.exp(s - lse_ref[0, 0][:, None])
+        p = _ex(s - lse_ref[0, 0][:, None], exp2)
         dp = jax.lax.dot_general(do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         if dropout:
@@ -273,7 +307,7 @@ def _bwd_dq_kernel(*refs, scale, causal, masked, dropout, single):
         dq_ref[0] = (dq_sc[...] * scale).astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(*refs, scale, causal, masked, dropout, single):
+def _bwd_dkv_kernel(*refs, scale, causal, masked, dropout, single, exp2):
     refs = list(refs)
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
     rest = refs[6:]
@@ -287,10 +321,12 @@ def _bwd_dkv_kernel(*refs, scale, causal, masked, dropout, single):
     i, kb, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     n_qb = pl.num_programs(2)
 
+    score_scale = scale * LOG2E if exp2 else scale
+
     def tile_dkdv():
-        s = _scores(q_ref[0], k_ref[0], scale, causal, masked, kvm_ref,
+        s = _scores(q_ref[0], k_ref[0], score_scale, causal, masked, kvm_ref,
                     j, kb, block_q, block_k)
-        p = jnp.exp(s - lse_ref[0, 0][:, None])  # [Bq, Bk] fp32
+        p = _ex(s - lse_ref[0, 0][:, None], exp2)  # [Bq, Bk] fp32
         dp = jax.lax.dot_general(do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         if dropout:
@@ -481,7 +517,7 @@ def _flash_fwd(q, k, v, kv_mask, dropout_seed, causal, block_q, block_k,
 
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                masked=masked, dropout=drop,
-                               single=(n_kb == 1))
+                               single=(n_kb == 1), exp2=EXP2)
     out, lse = pl.pallas_call(
         kernel,
         grid=(bh, n_qb, n_kb),
@@ -553,7 +589,8 @@ def _flash_bwd_rule(causal, block_q, block_k, interpret, dropout_rate, res, g):
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          masked=masked, dropout=drop, single=(n_kb == 1)),
+                          masked=masked, dropout=drop, single=(n_kb == 1),
+                          exp2=EXP2),
         grid=(bh, n_qb, n_kb),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
@@ -579,7 +616,8 @@ def _flash_bwd_rule(causal, block_q, block_k, interpret, dropout_rate, res, g):
                       if masked else ())
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          masked=masked, dropout=drop, single=(n_qb == 1)),
+                          masked=masked, dropout=drop, single=(n_qb == 1),
+                          exp2=EXP2),
         grid=(bh, n_kb, n_qb),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, kb, j: (i, j, 0)),

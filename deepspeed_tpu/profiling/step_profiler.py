@@ -24,7 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["timed_loop", "timed_scan", "wall_breakdown",
-           "model_scope_breakdown"]
+           "model_scope_breakdown", "grad_fold"]
 
 
 def _fence(x):
@@ -46,7 +46,8 @@ def timed_loop(call, steps=10, warmup=3):
     out = None
     for _ in range(warmup):
         out = call()
-    _fence(out)
+    if out is not None:  # warmup=0: nothing to fence yet
+        _fence(out)
     t0 = time.perf_counter()
     for _ in range(steps):
         out = call()
@@ -119,7 +120,7 @@ def min_wall(thunk, reps):
     return best
 
 
-def _grad_fold(grads):
+def grad_fold(grads):
     """Fold EVERY grad leaf into one scalar — XLA dead-code-eliminates
     unused backward outputs, so touching a single leaf would let it prune
     most of the backward pass and fake a speedup."""
@@ -171,7 +172,7 @@ def wall_breakdown(engine, batch, steps=10, warmup=3, scan_steps=6):
                                        **extra))(p)
         # small non-zero factor: XLA may fold a literal 0·x and then DCE
         # the whole backward
-        return loss + 1e-30 * _grad_fold(grads)
+        return loss + 1e-30 * grad_fold(grads)
 
     out["fwd_bwd"] = timed_scan(fwd_bwd, ops, scan_steps,
                                 mesh=engine.mesh) * 1e3
@@ -212,7 +213,7 @@ def model_scope_breakdown(engine, scopes, steps=6, warmup=2):
 
         def fb(p, i, fn=fn):
             loss, grads = jax.value_and_grad(lambda pp: fn(pp, i))(p)
-            return loss + 1e-30 * _grad_fold(grads)
+            return loss + 1e-30 * grad_fold(grads)
 
         fb_ms = timed_scan(fb, params, steps, warmup, mesh=engine.mesh) * 1e3
         out[name] = {"fwd": fwd_ms, "fwd_bwd": fb_ms}

@@ -950,12 +950,14 @@ class DeepSpeedEngine:
         for key, n in vals.items():
             if n > 0:
                 logger.error(
-                    "sparse_gradients budget overflow on leaf '%s': %d rows "
-                    "dropped across ranks — its gradient was poisoned with "
-                    "NaN (loss will be NaN) and optimizer moments are "
-                    "corrupted; restart from the last checkpoint with this "
-                    "leaf removed from sparse_gradients (or raise the token "
-                    "budget via a larger micro-batch)", key, n)
+                    "sparse_gradients budget overflow on leaf '%s': up to "
+                    "%d rows dropped in one micro-batch (max across ranks "
+                    "and accumulation micro-steps) — its gradient was "
+                    "poisoned with NaN (loss will be NaN) and optimizer "
+                    "moments are corrupted; restart from the last "
+                    "checkpoint with this leaf removed from "
+                    "sparse_gradients (or raise the token budget via a "
+                    "larger micro-batch)", key, n)
         return vals
 
     sparse_overflow_report = _check_sparse_overflow

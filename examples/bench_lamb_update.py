@@ -1,0 +1,119 @@
+"""A/B microbench for the flat-space LAMB update (the measured ~11 ms/step
+GPT-2-medium tax over Adam — PERF.md step breakdown).
+
+LAMB's extra HBM traffic over Adam is bounded below by 3 sweeps of the
+flat buffer (write update, read update for norms, read update for apply);
+anything above that is XLA scheduling slack this bench exists to find.
+Times each variant as a two-point (N vs 2N) scanned loop inside one jit so
+the ~100 ms dispatch fence cancels.
+
+Usage: python examples/bench_lamb_update.py [rows]
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.ops.adam.fused_adam import FusedAdam
+from deepspeed_tpu.ops.lamb.fused_lamb import FusedLamb
+from deepspeed_tpu.ops.op_common import LANES, build_segments
+
+# GPT-2-medium-ish: 355M params
+ROWS = int(sys.argv[1]) if len(sys.argv) > 1 else 347_000
+
+
+def time_update(opt, segments, rows, n=8):
+    hp = opt.hyperparams()
+    p0 = jnp.ones((rows, LANES), jnp.float32) * 0.01
+    g0 = jnp.full((rows, LANES), 1e-4, jnp.float32)
+    st0 = opt.init_state(p0)
+
+    def loop(steps, p, st, g):
+        def body(carry, _):
+            p_, st_ = carry
+            # perturb the grad by the step counter so the scan body cannot
+            # be hoisted as loop-invariant
+            gg = g + st_.step.astype(jnp.float32) * 1e-9
+            p2, st2 = opt.update(st_, p_, gg, hp, segments=segments,
+                                 segment_ids=None)
+            return (p2, st2), ()
+
+        (p, st), _ = jax.lax.scan(body, (p, st), None, length=steps)
+        return p, st
+
+    f = jax.jit(loop, static_argnums=(0,))
+
+    def run(steps):
+        t0 = time.perf_counter()
+        p, st = f(steps, p0, st0, g0)
+        float(jax.device_get(st.step))
+        float(jax.device_get(p[0, 0]))
+        return time.perf_counter() - t0
+
+    run(n)  # compile + warm
+    run(2 * n)
+    t1, t2 = run(n), run(2 * n)
+    return (t2 - t1) / n * 1e3
+
+
+class BarrierLamb(FusedLamb):
+    """Two-pass variant: materialize (m, v, update) exactly once behind an
+    optimization barrier, then norms + apply read the materialized buffers.
+    Lower bound on LAMB-over-Adam HBM: +3 sweeps (write u, read u for
+    norms, read u for apply)."""
+
+    def update(self, state, flat_master, flat_grads, hp, segments=None,
+               segment_ids=None):
+        from deepspeed_tpu.ops.op_common import segment_l2_norms_rows
+        lr, beta1, beta2, wd = (hp["lr"], hp["beta1"], hp["beta2"],
+                                hp["weight_decay"])
+        g = jnp.asarray(flat_grads, jnp.float32)
+        p = flat_master
+        step = state.step + 1
+        m = beta1 * state.exp_avg + (1.0 - beta1) * g
+        v = beta2 * state.exp_avg_sq + (1.0 - beta2) * (g * g)
+        tf = step.astype(jnp.float32)
+        m_hat = m / (1.0 - beta1 ** tf)
+        v_hat = v / (1.0 - beta2 ** tf)
+        update = m_hat / (jnp.sqrt(v_hat) + self.eps) + wd * p
+        m, v, update = jax.lax.optimization_barrier((m, v, update))
+        w_norms = segment_l2_norms_rows(p, segments)
+        u_norms = segment_l2_norms_rows(update, segments)
+        ratio = jnp.where((w_norms > 0) & (u_norms > 0),
+                          jnp.clip(w_norms / u_norms, self.min_coeff,
+                                   self.max_coeff),
+                          jnp.ones_like(w_norms))
+        ratio_full = jnp.concatenate([ratio, jnp.ones((1,), jnp.float32)])
+        scale = ratio_full[jnp.asarray(segments.row_segment_ids())][:, None]
+        new_p = p - lr * scale * update
+        from deepspeed_tpu.ops.lamb.fused_lamb import LambState
+        return new_p, LambState(exp_avg=m, exp_avg_sq=v, step=step)
+
+
+def main():
+    # ~300 tensors with GPT-2-ish size mix
+    sizes = []
+    per_layer = [1024 * 3072, 3072, 1024 * 1024, 1024, 1024 * 4096, 4096,
+                 4096 * 1024, 1024, 1024, 1024, 1024, 1024]
+    for _ in range(24):
+        sizes += per_layer
+    sizes += [50257 * 1024, 1024 * 1024, 1024, 1024]
+    segments = build_segments(sizes)
+    rows = max(segments.rows, ROWS)
+    segments = segments._replace(rows=rows)
+    print(f"buffer: {rows} rows x {LANES} = {rows * LANES / 1e6:.0f}M f32 "
+          f"({rows * LANES * 4 / 1e9:.2f} GB), {len(sizes)} tensors")
+
+    adam_ms = time_update(FusedAdam(lr=1e-4), segments, rows)
+    print(f"adam:         {adam_ms:7.2f} ms/step")
+    lamb_ms = time_update(FusedLamb(lr=1e-4), segments, rows)
+    print(f"lamb:         {lamb_ms:7.2f} ms/step  (+{lamb_ms - adam_ms:.2f})")
+    bar_ms = time_update(BarrierLamb(lr=1e-4), segments, rows)
+    print(f"barrier-lamb: {bar_ms:7.2f} ms/step  (+{bar_ms - adam_ms:.2f})")
+
+
+if __name__ == "__main__":
+    main()

@@ -78,8 +78,11 @@ def try_step(offload, hidden, layers, heads):
     env = dict(os.environ, T_H=str(hidden), T_L=str(layers),
                T_HEADS=str(heads), T_OFF="1" if offload else "0",
                T_B=str(BATCH), T_S=str(STEPS))
-    proc = subprocess.run([sys.executable, "-u", "-c", _TRIAL], env=env,
-                          capture_output=True, text=True, timeout=1800)
+    try:
+        proc = subprocess.run([sys.executable, "-u", "-c", _TRIAL], env=env,
+                              capture_output=True, text=True, timeout=1800)
+    except subprocess.TimeoutExpired:
+        return False, "TIMEOUT (30 min)"
     for line in proc.stdout.splitlines():
         if line.startswith("CAP_RESULT "):
             return True, float(line.split()[1]) / 1e3

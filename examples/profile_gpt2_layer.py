@@ -24,7 +24,7 @@ def main():
 
     from deepspeed_tpu.models.layers import TransformerLayer
     from deepspeed_tpu.ops.transformer.flash_attention import flash_attention
-    from deepspeed_tpu.profiling.step_profiler import timed_scan
+    from deepspeed_tpu.profiling.step_profiler import grad_fold, timed_scan
 
     rng = jax.random.PRNGKey(0)
     layer = TransformerLayer(hidden_size=H, heads=HEADS, causal=True,
@@ -45,9 +45,7 @@ def main():
             def fb(o, i):
                 val, grads = jax.value_and_grad(
                     lambda oo: fn(oo, i))(o)
-                return val + 1e-30 * sum(
-                    jnp.sum(g.astype(jnp.float32))
-                    for g in jax.tree_util.tree_leaves(grads))
+                return val + 1e-30 * grad_fold(grads)
 
             fb_ms = timed_scan(fb, ops, steps=STEPS) * 1e3
             line += f"   fwd+bwd {fb_ms:8.3f} ms"

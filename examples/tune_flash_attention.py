@@ -22,7 +22,7 @@ def main():
     import jax.numpy as jnp
 
     from deepspeed_tpu.ops.transformer.flash_attention import flash_attention
-    from deepspeed_tpu.profiling.step_profiler import timed_scan
+    from deepspeed_tpu.profiling.step_profiler import grad_fold, timed_scan
 
     args = [int(a) for a in sys.argv[1:]] or [8, 1024, 16, 64]
     B, S, H, D = args
@@ -35,8 +35,7 @@ def main():
 
         def fb(o, i):
             val, grads = jax.value_and_grad(lambda oo: fn(oo, i))(o)
-            return val + 1e-30 * sum(jnp.sum(g.astype(jnp.float32))
-                                     for g in jax.tree_util.tree_leaves(grads))
+            return val + 1e-30 * grad_fold(grads)
 
         fb_ms = timed_scan(fb, qkv, steps=STEPS) * 1e3
         print(f"  {name:>34}: fwd {fwd_ms:7.3f} ms   fwd+bwd {fb_ms:7.3f} ms",
