@@ -140,6 +140,13 @@ ZERO_CONTIGUOUS_GRADIENTS = "contiguous_gradients"
 ZERO_CONTIGUOUS_GRADIENTS_DEFAULT = False
 ZERO_CPU_OFFLOAD = "cpu_offload"
 ZERO_CPU_OFFLOAD_DEFAULT = False
+# Offloaded master/optimizer state streams through the device in chunks of
+# at most this many megabytes of fp32 rows per buffer (TPU-native analog of
+# the reference's grad/param bucket sizes for ZeRO-Offload, stage2.py:326):
+# bounds peak HBM during the update to ~one chunk of (p, m, v) instead of
+# three full buffers.  0 disables chunking.
+ZERO_OFFLOAD_CHUNK_MB = "offload_chunk_mb"
+ZERO_OFFLOAD_CHUNK_MB_DEFAULT = 512
 ZERO_ELASTIC_CHECKPOINT = "elastic_checkpoint"
 ZERO_ELASTIC_CHECKPOINT_DEFAULT = True
 

@@ -43,6 +43,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..ops.adam.fused_adam import FusedAdam
 from ..ops.lamb.fused_lamb import FusedLamb
+from ..ops.op_common import LANES
 from ..parallel.mesh import DATA_AXIS, MeshGrid, make_mesh, set_current_mesh
 from ..utils.distributed import init_distributed
 from ..utils.logging import log_dist, logger
@@ -293,6 +294,14 @@ class DeepSpeedEngine:
 
         # master weights (flat fp32, sharded per stage)
         master0 = self.flat.flatten_to_master(params0)
+        if self._config.zero_config.cpu_offload:
+            # free the fp32 init params BEFORE later init work dispatches:
+            # with state host-offloaded, the async param cast otherwise
+            # executes while these ~4 bytes/param still occupy HBM — at
+            # ~1B params the overlap alone exhausts the chip (measured:
+            # the streamed cast ResourceExhausted at 1.0B until this del)
+            del params0
+            model_parameters = None
 
         # -- optimizer (reference _configure_optimizer engine.py:544-712) --
         self.client_optimizer = optimizer
@@ -307,14 +316,41 @@ class DeepSpeedEngine:
                 lambda s: s.with_memory_kind("device"), self._opt_shardings)
         else:
             self._opt_shardings_device = self._opt_shardings
+        if (self.flat.host_group_bounds is not None
+                and getattr(self.optimizer, "name", "") != "adam"):
+            raise ValueError(
+                "cpu_offload with state this large (row-grouped host "
+                "buffers) requires an Adam-family flat optimizer — "
+                "reference parity: ZeRO-Offload pairs with [CPU]Adam "
+                "(stage2.py:326, zero/utils.py:26)")
         with self.mesh:
-            master0_dev = (jax.device_put(master0, self.flat.master_device_sharding)
-                           if self._offload else master0)
-            opt0 = jax.jit(self.optimizer.init_state,
-                           out_shardings=self._opt_shardings_device)(master0_dev)
-            if self._offload:
-                opt0 = jax.device_put(opt0, self._opt_shardings)
-                del master0_dev
+            if self.flat.host_group_bounds is not None:
+                # grouped offload state: per-group zero init (Adam-family
+                # state is zeros_like + a step scalar; the full-buffer
+                # init would materialize fp32 state on device all at once)
+                opt_shape = jax.eval_shape(
+                    self.optimizer.init_state,
+                    jax.ShapeDtypeStruct(self.segments.shape, jnp.float32))
+
+                def _mk(leaf):
+                    if leaf.shape == self.segments.shape:
+                        return tuple(
+                            jax.device_put(jnp.zeros((rc, LANES), leaf.dtype),
+                                           self.flat.master_sharding)
+                            for _, rc in self.flat.host_group_bounds)
+                    return jnp.zeros(leaf.shape, leaf.dtype)
+
+                opt0 = jax.tree_util.tree_map(_mk, opt_shape)
+            else:
+                master0_dev = (jax.device_put(
+                    master0, self.flat.master_device_sharding)
+                    if self._offload else master0)
+                opt0 = jax.jit(self.optimizer.init_state,
+                               out_shardings=self._opt_shardings_device)(
+                    master0_dev)
+                if self._offload:
+                    opt0 = jax.device_put(opt0, self._opt_shardings)
+                    del master0_dev
 
         scale0 = DynamicScaleState.create(
             init_scale=(self._config.initial_dynamic_scale
@@ -469,6 +505,14 @@ class DeepSpeedEngine:
         opt_shape = jax.eval_shape(
             self.optimizer.init_state,
             jax.ShapeDtypeStruct(self.segments.shape, jnp.float32))
+        if self.flat.host_group_bounds is not None:
+            # grouped state: one sharding per row-group buffer
+            return jax.tree_util.tree_map(
+                lambda l: (tuple(self.flat.master_sharding
+                                 for _ in self.flat.host_group_bounds)
+                           if l.shape == self.segments.shape
+                           else self.flat.replicated),
+                opt_shape)
         return jax.tree_util.tree_map(
             lambda l: self.flat.master_sharding if l.ndim > 0 else self.flat.replicated,
             opt_shape)
@@ -570,11 +614,144 @@ class DeepSpeedEngine:
         master_out_sharding = (self.flat.master_sharding
                                if not self._offload_eager
                                else dev_sharding)
+        if self.flat.host_group_bounds is not None:
+            # grouped master: one host sharding per row-group buffer
+            master_out_sharding = tuple(
+                self.flat.master_sharding
+                for _ in self.flat.host_group_bounds)
         opt_out_shardings = (self._opt_shardings if not self._offload_eager
                              else self._opt_shardings_device)
 
         def to_device(flat_buf):
             return jax.device_put(flat_buf, dev_sharding) if offload else flat_buf
+
+        # Chunk plan for streamed offload: the capacity fix for the in-jit
+        # path, which otherwise materializes master + m + v on device AT
+        # ONCE for the update (measured 21.8 G peak at GPT-2-large — MORE
+        # than device-resident training, defeating offload's purpose).
+        # Chunked, each program step streams one [chunk, LANES] slice of
+        # (p, m, v) host→device, updates, and streams back — measured
+        # throughput-equal to the full-buffer form (examples/
+        # exp_host_stream.py) with peak HBM of ~one chunk.  Per-tensor
+        # trust-ratio optimizers (LAMB) need whole-buffer norms, so only
+        # elementwise flat optimizers (Adam family) chunk; the reference
+        # has the same constraint (ZeRO-Offload pairs with [CPU]Adam only).
+        from .zero.coordinator import split_rows
+
+        groups = self.flat.host_group_bounds  # tuple[(r0, rc)] or None
+        chunk_mb = int(getattr(self._config.zero_config,
+                               "offload_chunk_mb", 512) or 0)
+        rows_per_chunk = (max(1, (chunk_mb << 20) // (LANES * 4))
+                          if chunk_mb else None)
+
+        def _chunks(rows_g):
+            """Relative chunk bounds within one (group) buffer."""
+            return split_rows(rows_g, rows_per_chunk)
+
+        offload_stream = (
+            offload and getattr(optimizer, "name", "") == "adam"
+            and (groups is not None
+                 or (rows_per_chunk is not None
+                     and segments.rows > rows_per_chunk)))
+        if offload_stream:
+            log_dist(
+                f"ZeRO-Offload: streaming update over "
+                f"{len(groups) if groups else 1} host group(s) in chunks "
+                f"of ≤{chunk_mb} MB", ranks=[0])
+
+        host_big = self.flat.master_sharding
+
+        def _after(token, tree):
+            """Data-dependency fence: every producer feeding ``tree`` may
+            only be scheduled after ``token`` is computed.  Without this the
+            chunk pipelines below are mutually independent and XLA's
+            scheduler runs them ALL concurrently — every chunk's fp32 state
+            lands on device at once and the peak is the full buffers again
+            (measured: 29.3 G at GPT-2-xl, worse than unchunked)."""
+            tree, _ = jax.lax.optimization_barrier((tree, token))
+            return tree
+
+        def _is_grp(x):
+            # plain tuple only: NamedTuple optimizer states are pytree
+            # NODES, not row-group containers
+            return type(x) is tuple
+
+        def _stream_one_group(master_g, st_g, g_g, hp, overflow, token):
+            """Stream one host buffer's (p, m, v) through the device chunk
+            by chunk.  ``g_g`` is this group's slice of the device-resident
+            unscaled gradient; ``overflow`` gates an fp16 no-op step per
+            chunk (the pick the unchunked path applies whole-buffer).
+
+            Results write back into the (donated) input host buffers via
+            ``dynamic_update_slice`` — concatenating fresh output parts
+            defeats XLA's donation aliasing in host space, doubling the
+            program's host footprint past the attachment's pool (measured:
+            5x3.76 GB in+out fails with concat outputs, 8x passes with DUS
+            write-back — examples/exp_host_stream.py)."""
+            opt_leaves, opt_def = jax.tree_util.tree_flatten(st_g)
+            is_flat = [getattr(l, "ndim", 0) == 2 for l in opt_leaves]
+            scalar_out = [None] * len(opt_leaves)
+            for r0, rc in _chunks(master_g.shape[0]):
+                host_slices = _after(token, [
+                    jax.lax.slice_in_dim(master_g, r0, r0 + rc)] + [
+                    jax.lax.slice_in_dim(l, r0, r0 + rc)
+                    for l, f in zip(opt_leaves, is_flat) if f])
+                pm = jax.device_put(host_slices[0], dev_sharding)
+                it = iter(host_slices[1:])
+                chunk_leaves = [
+                    jax.device_put(next(it), dev_sharding) if f else l
+                    for l, f in zip(opt_leaves, is_flat)]
+                st = jax.tree_util.tree_unflatten(opt_def, chunk_leaves)
+                gc = jax.lax.slice_in_dim(g_g, r0, r0 + rc)
+                new_p, new_st = optimizer.update(st, pm, gc, hp)
+                token = new_p[0, 0]
+                if fp16:
+                    new_p = jnp.where(overflow, pm, new_p)
+                master_g = jax.lax.dynamic_update_slice(
+                    master_g, jax.device_put(new_p, host_big), (r0, 0))
+                for idx, (old_c, new_l) in enumerate(zip(
+                        chunk_leaves, jax.tree_util.tree_leaves(new_st))):
+                    if is_flat[idx]:
+                        if fp16:
+                            new_l = jnp.where(overflow, old_c, new_l)
+                        opt_leaves[idx] = jax.lax.dynamic_update_slice(
+                            opt_leaves[idx],
+                            jax.device_put(new_l, host_big), (r0, 0))
+                    elif scalar_out[idx] is None:
+                        # non-flat state (the step counter): identical per
+                        # chunk; fp16 pick applies as in the full path
+                        scalar_out[idx] = (jnp.where(overflow,
+                                                     opt_leaves[idx], new_l)
+                                           if fp16 else new_l)
+            new_leaves = [opt_leaves[i] if is_flat[i] else scalar_out[i]
+                          for i in range(len(opt_leaves))]
+            return (master_g,
+                    jax.tree_util.tree_unflatten(opt_def, new_leaves), token)
+
+        def chunked_offload_update(master, opt_state, g, hp, overflow):
+            """Group loop around :func:`_stream_one_group`: grouped state
+            (master/opt as tuples of ≤HOST_GROUP_BYTES host buffers) streams
+            group by group; ungrouped state is a single group."""
+            masters = master if type(master) is tuple else (master,)
+            gb = groups or ((0, segments.rows),)
+            token = jnp.float32(0.0)
+            new_masters, new_sts = [], []
+            for gi, (gr0, grc) in enumerate(gb):
+                st_g = jax.tree_util.tree_map(
+                    lambda l: l[gi] if type(l) is tuple else l,
+                    opt_state, is_leaf=_is_grp)
+                g_g = jax.lax.slice_in_dim(g, gr0, gr0 + grc)
+                nm, nst, token = _stream_one_group(
+                    masters[gi], st_g, g_g, hp, overflow, token)
+                new_masters.append(nm)
+                new_sts.append(nst)
+            if groups is None:
+                return new_masters[0], new_sts[0]
+            new_opt = jax.tree_util.tree_map(
+                lambda orig, *gs: tuple(gs) if type(orig) is tuple
+                else gs[0],
+                opt_state, *new_sts, is_leaf=_is_grp)
+            return tuple(new_masters), new_opt
 
         def cast_params(master):
             # stage 3 skips the up-front full replication: each leaf's row
@@ -582,7 +759,33 @@ class DeepSpeedEngine:
             # schedule per-layer gathers and free them after last use
             # instead of materializing a replicated copy of every
             # parameter for the whole step (stage-3's memory win)
-            params = self.flat.unflatten_params(to_device(master),
+            if offload_stream and self.compute_dtype:
+                # streamed cast: the fp32 master never materializes whole
+                # on device — each chunk casts to the compute dtype on
+                # arrival, so peak HBM is the bf16 buffer + one fp32 chunk.
+                # Chained (_after) for the same reason as the update: un-
+                # ordered chunk pipelines would all stream simultaneously.
+                parts, token = [], jnp.float32(0.0)
+                masters = master if type(master) is tuple else (master,)
+                for m_g in masters:
+                    for r0, rc in _chunks(m_g.shape[0]):
+                        src = _after(token,
+                                     jax.lax.slice_in_dim(m_g, r0, r0 + rc))
+                        part = jax.device_put(src, dev_sharding).astype(
+                            self.compute_dtype)
+                        token = part[0, 0].astype(jnp.float32)
+                        parts.append(part)
+                flat_src = (parts[0] if len(parts) == 1
+                            else jnp.concatenate(parts, axis=0))
+            elif type(master) is tuple:
+                # grouped state but fp32 compute: the full fp32 buffer is
+                # needed on device regardless — assemble it
+                flat_src = jnp.concatenate(
+                    [jax.device_put(m_g, dev_sharding) for m_g in master],
+                    axis=0)
+            else:
+                flat_src = to_device(master)
+            params = self.flat.unflatten_params(flat_src,
                                                 self._param_template,
                                                 self.compute_dtype,
                                                 constrain=not stage3)
@@ -717,10 +920,6 @@ class DeepSpeedEngine:
 
         def apply_update(master, opt_state, scale_state, skipped, flat_g, hp,
                          segment_ids):
-            master = to_device(master)
-            opt_state = jax.tree_util.tree_map(
-                lambda l: to_device(l) if getattr(l, "shape", ()) == segments.shape
-                else l, opt_state)
             inv = 1.0 / scale_state.cur_scale
             g = flat_g * inv
             if fp16:
@@ -732,6 +931,26 @@ class DeepSpeedEngine:
                 g = g * jnp.minimum(1.0, clip / (gnorm + 1e-6))
             else:
                 gnorm = jnp.asarray(0.0, jnp.float32)
+
+            if offload_stream:
+                # streamed offload: per-chunk fp16 pick happens inside
+                new_master, new_opt = chunked_offload_update(
+                    master, opt_state, g, hp, overflow)
+                if fp16 and dynamic:
+                    scale_state = update_scale_state(
+                        scale_state, overflow,
+                        scale_window=scale_args.get("scale_window", 1000),
+                        min_scale=scale_args.get("min_scale", 1.0),
+                        delayed_shift=scale_args.get("delayed_shift", 1))
+                if fp16:
+                    skipped = skipped + overflow.astype(jnp.int32)
+                return (new_master, new_opt, scale_state, skipped, overflow,
+                        gnorm)
+
+            master = to_device(master)
+            opt_state = jax.tree_util.tree_map(
+                lambda l: to_device(l) if getattr(l, "shape", ()) == segments.shape
+                else l, opt_state)
 
             new_master, new_opt = optimizer.update(
                 opt_state, master, g, hp, segments=segments, segment_ids=segment_ids)
@@ -1257,10 +1476,15 @@ class DeepSpeedEngine:
         # flat-shaped optimizer-state leaves are saved unpadded too, so the
         # whole optimizer checkpoint is DP-degree elastic
         opt_host = {}
-        flat_opt, _ = jax.tree_util.tree_flatten_with_path(self.state["opt"])
+        # row-group tuples (grouped offload state) are treated as one
+        # logical leaf so the saved format stays identical to the
+        # ungrouped layout — checkpoints stay portable across offload
+        # modes and DP degrees
+        flat_opt, _ = jax.tree_util.tree_flatten_with_path(
+            self.state["opt"], is_leaf=lambda x: type(x) is tuple)
         for path, leaf in flat_opt:
             key = self._path_key(path)
-            if leaf.shape == self.segments.shape:
+            if type(leaf) is tuple or leaf.shape == self.segments.shape:
                 opt_host[key] = self.flat.gather_master_unpadded(leaf)
             else:
                 opt_host[key] = np.asarray(jax.device_get(leaf))
@@ -1363,13 +1587,24 @@ class DeepSpeedEngine:
         """Place host arrays into a pytree matching ``tree``'s structure and
         shardings, keyed by tree paths.  Scalars (e.g. step counters) restore
         by shape."""
-        flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+        flat, treedef = jax.tree_util.tree_flatten_with_path(
+            tree, is_leaf=lambda x: type(x) is tuple)
         leaves = []
         for path, leaf in flat:
             key = self._path_key(path)
             src = host_dict.get(key)
             assert src is not None, f"checkpoint missing key {key}"
             arr = np.asarray(src)
+            if type(leaf) is tuple:
+                # grouped flat leaf: unpadded 1-D → repad → re-split into
+                # the current row groups
+                padded = self.flat.repad_unpadded(arr.reshape(-1))
+                leaves.append(tuple(
+                    jax.device_put(padded[r0:r0 + rc].astype(g.dtype),
+                                   g.sharding)
+                    for (r0, rc), g in zip(self.flat.host_group_bounds,
+                                           leaf)))
+                continue
             if arr.ndim == 1 and leaf.shape == self.segments.shape:
                 # flat buffer saved unpadded (possibly different DP degree)
                 arr = self.flat.repad_unpadded(arr)

@@ -43,6 +43,12 @@ class DeepSpeedZeroConfig:
                                                       C.ZERO_ALLGATHER_BUCKET_SIZE_DEFAULT)
         self.cpu_offload = get_scalar_param(d, C.ZERO_CPU_OFFLOAD,
                                             C.ZERO_CPU_OFFLOAD_DEFAULT)
+        self.offload_chunk_mb = get_scalar_param(d, C.ZERO_OFFLOAD_CHUNK_MB,
+                                                 C.ZERO_OFFLOAD_CHUNK_MB_DEFAULT)
+        assert (isinstance(self.offload_chunk_mb, int)
+                and self.offload_chunk_mb >= 0), (
+            f"offload_chunk_mb must be a non-negative integer (MB; 0 "
+            f"disables chunking), got {self.offload_chunk_mb!r}")
         self.elastic_checkpoint = get_scalar_param(d, C.ZERO_ELASTIC_CHECKPOINT,
                                                    C.ZERO_ELASTIC_CHECKPOINT_DEFAULT)
 
@@ -54,6 +60,7 @@ class DeepSpeedZeroConfig:
                     allgather_bucket_size=self.allgather_bucket_size,
                     overlap_comm=self.overlap_comm,
                     cpu_offload=self.cpu_offload,
+                    offload_chunk_mb=self.offload_chunk_mb,
                     elastic_checkpoint=self.elastic_checkpoint)
 
     def __repr__(self):

@@ -27,6 +27,7 @@ STEPS = int(os.environ.get("CAP_STEPS", "6"))
 LADDER = [
     ("gpt2-medium-0.35B", 1024, 24, 16),
     ("gpt2-large-0.77B", 1280, 36, 20),
+    ("gpt2-1.0B", 1408, 40, 22),
     ("gpt2-xl-1.5B", 1600, 48, 25),
     ("gpt2-2.7B", 2560, 32, 32),
     ("gpt2-4.2B", 3072, 36, 32),
@@ -87,8 +88,8 @@ def try_step(offload, hidden, layers, heads):
         if line.startswith("CAP_RESULT "):
             return True, float(line.split()[1]) / 1e3
     err = proc.stdout[-300:] + proc.stderr[-300:]
-    oom = "RESOURCE_EXHAUSTED" in err or "memory space hbm" in err \
-        or "Out of memory" in err
+    oom = ("RESOURCE_EXHAUSTED" in err or "memory space hbm" in err
+           or "Out of memory" in err or "ResourceExhausted" in err)
     return False, ("OOM" if oom else err.replace("\n", " ")[-200:])
 
 

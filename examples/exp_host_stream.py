@@ -39,43 +39,58 @@ def main():
 
     rows = (ROWS // CHUNKS) * CHUNKS
     cr = rows // CHUNKS
-    x = jax.device_put(jnp.ones((rows, LANES), jnp.float32), host)
-    m = jax.device_put(jnp.zeros((rows, LANES), jnp.float32), host)
     g = jax.device_put(jnp.full((rows, LANES), 1e-3, jnp.float32), devs)
+
+    def fresh():
+        # per-variant buffers: donation consumes them
+        return (jax.device_put(jnp.ones((rows, LANES), jnp.float32), host),
+                jax.device_put(jnp.zeros((rows, LANES), jnp.float32), host))
 
     def full_update(x, m, g):
         xd = jax.device_put(x, devs)
         md = jax.device_put(m, devs)
         m2 = 0.9 * md + 0.1 * g
         x2 = xd - 0.01 * m2
-        return (jax.device_put(x2, host), jax.device_put(m2, host))
+        # device-scalar fence output: indexing a pinned_host array EAGERLY
+        # (x2[0, 0] outside jit) compiles a tiny host-space program that
+        # SIGABRTs this toolchain — fence on a device scalar instead
+        return (jax.device_put(x2, host), jax.device_put(m2, host),
+                jnp.sum(m2[0, :8]))
 
     def chunked_update(x, m, g):
         xs, ms = [], []
+        token = jnp.float32(0.0)
         for c in range(CHUNKS):
             sl = slice(c * cr, (c + 1) * cr)
-            xd = jax.device_put(jax.lax.slice_in_dim(x, c * cr, (c + 1) * cr),
-                                devs)
-            md = jax.device_put(jax.lax.slice_in_dim(m, c * cr, (c + 1) * cr),
-                                devs)
+            # chain chunks: without the barrier the pipelines are
+            # independent and XLA schedules them ALL at once — peak HBM
+            # equals the full buffers again (the engine's _after fence)
+            xh, mh = jax.lax.optimization_barrier(
+                ((jax.lax.slice_in_dim(x, c * cr, (c + 1) * cr),
+                  jax.lax.slice_in_dim(m, c * cr, (c + 1) * cr)), token))[0]
+            xd = jax.device_put(xh, devs)
+            md = jax.device_put(mh, devs)
             m2 = 0.9 * md + 0.1 * g[sl]
             x2 = xd - 0.01 * m2
+            token = m2[0, 0]
             xs.append(jax.device_put(x2, host))
             ms.append(jax.device_put(m2, host))
-        return jnp.concatenate(xs, axis=0), jnp.concatenate(ms, axis=0)
+        return (jnp.concatenate(xs, axis=0), jnp.concatenate(ms, axis=0),
+                token)
 
     for name, fn in (("full", full_update), ("chunked", chunked_update)):
         try:
+            x, m = fresh()
             f = jax.jit(fn, donate_argnums=(0, 1),
-                        out_shardings=(host, host))
-            x2, m2 = f(x, m, g)
-            x2.block_until_ready()
+                        out_shardings=(host, host, devs))
+            x2, m2, s = f(x, m, g)
+            float(jax.device_get(s))
             print(f"{name}: compiles+runs; out kinds "
                   f"{x2.sharding.memory_kind}/{m2.sharding.memory_kind}")
             t0 = time.perf_counter()
             for _ in range(5):
-                x2, m2 = f(x2, m2, g)
-            float(jax.device_get(x2[0, 0]))
+                x2, m2, s = f(x2, m2, g)
+            float(jax.device_get(s))
             print(f"{name}: {(time.perf_counter() - t0) / 5 * 1e3:.1f} ms "
                   f"per sweep ({rows * LANES * 4 / 1e9:.2f} GB buffer)")
         except Exception as e:  # noqa: BLE001
