@@ -213,12 +213,15 @@ def main():
     # config; BENCH_SEQ512=0 skips.  Guarded so a secondary failure (OOM on
     # a smaller chip, compile error) can never lose the validated primary
     # metric above.  One retry: this environment's remote compile service
-    # sporadically 500s.
+    # sporadically 500s.  (Round-4 negative result: running secondaries in
+    # fresh subprocesses measured gpt2 at 7 samples/s and seq512 at 82 —
+    # the parent's live runtime starves the child of HBM — so co-resident
+    # measurement stays, costing gpt2 a known ~6% vs sole-tenant runs.)
     for attempt in (1, 2):
         try:
             _measure_seq512(record, deepspeed, BertConfig,
                             BertForPreTrainingTPU, mesh, config, rng, steps,
-                            warmup, dropout_p, peak)
+                            warmup, dropout_p, peak, attempt=attempt)
             record.pop("seq512_exc", None)
             break
         except Exception as e:  # pragma: no cover - depends on chip
@@ -227,8 +230,8 @@ def main():
 
     # Tertiary: a causal-LM row (3 of the 5 BASELINE configs are GPT-2
     # class).  GPT-2-medium 355M, seq 1024, the BASELINE #3 shape: ZeRO
-    # stage 2 + Lamb + bf16 (degenerate but real at dp=1).  Same guard
-    # discipline as seq-512.
+    # stage 2 + Lamb + bf16 (degenerate but real at dp=1).  (Order A/B:
+    # gpt2-first gains it 1.6% but costs seq512 4% — seq512 runs first.)
     for attempt in (1, 2):
         try:
             _measure_gpt2(record, deepspeed, mesh, rng, steps, warmup,
@@ -247,7 +250,59 @@ def main():
     except Exception as e:  # pragma: no cover - depends on chip
         record["sparse_attn_exc"] = f"sparse run failed: {e!r:.300}"
 
+    # Quinary: ZeRO-Offload step-time tax (the reference's ZeRO-Offload
+    # capability, ZeRO-Offload.md:10).  GPT-2-large: the LARGEST config
+    # this chip trains at all — device-resident just fits, offload pays
+    # the host-streaming tax (the capacity ladder with max-size search is
+    # examples/bench_offload_capacity.py; too slow for the driver run).
+    for attempt in (1, 2):
+        try:
+            _measure_offload(record, deepspeed, mesh, rng)
+            record.pop("offload_exc", None)
+            break
+        except Exception as e:  # pragma: no cover - depends on chip
+            record["offload_exc"] = f"offload run failed (try {attempt}): {e!r:.300}"
+            gc.collect()
+
     print(json.dumps(record))
+
+
+
+def _measure_offload(record, deepspeed, mesh, rng):
+    if os.environ.get("BENCH_OFFLOAD", "1") == "0":
+        return
+    import jax
+
+    from deepspeed_tpu.models import GPT2Config, GPT2LMHeadTPU
+
+    steps = int(os.environ.get("BENCH_OFFLOAD_STEPS", "5"))
+    cfg = GPT2Config(hidden_size=1280, num_layers=36, num_heads=20,
+                     max_position_embeddings=1024, embd_dropout=0.0,
+                     attn_dropout=0.0, resid_dropout=0.0, remat=True,
+                     loss_chunk=256)
+    model = GPT2LMHeadTPU(cfg)
+    engine, *_ = deepspeed.initialize(
+        model=model, mesh=mesh,
+        config={"train_batch_size": 4, "steps_per_print": 10 ** 9,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+                "zero_optimization": {"stage": 2, "cpu_offload": True},
+                "bf16": {"enabled": True}})
+    batch = {"input_ids": rng.integers(
+        0, cfg.vocab_size, size=(4, 1024)).astype(np.int32)}
+    for _ in range(2):
+        loss = engine.train_batch(iter([batch]))
+    v = float(jax.device_get(loss))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = engine.train_batch(iter([batch]))
+    v = float(jax.device_get(loss))
+    dt = (time.perf_counter() - t0) / steps
+    if math.isfinite(v):
+        record["offload_gpt2_large_ms_per_step"] = round(dt * 1e3, 0)
+        record["offload_gpt2_large_params_b"] = 0.77
+    else:
+        record["offload_error"] = f"non-finite loss {v}"
+    del engine, model
 
 
 def _measure_sparse_attention(record):
@@ -331,11 +386,19 @@ def _measure_gpt2(record, deepspeed, mesh, rng, steps, warmup, dropout_p,
 
 
 def _measure_seq512(record, deepspeed, BertConfig, BertForPreTrainingTPU,
-                    mesh, config, rng, steps, warmup, dropout_p, peak):
+                    mesh, config, rng, steps, warmup, dropout_p, peak,
+                    attempt=1):
     import jax
 
     if os.environ.get("BENCH_SEQ512", "1") != "0":
-        b512 = int(os.environ.get("BENCH_SEQ512_BATCH", "16"))
+        # batch 32 beats 16 here (93.6 vs 91 co-resident; 99.5 sole-
+        # tenant, examples/bench_seq512_dispatch.py) but may OOM next to
+        # the primary engine on smaller chips — the retry attempt indexes
+        # a fallback list, and the batch used is recorded in the JSON so a
+        # downgraded retry (e.g. after a transient compile 500) is visible
+        choices = [int(os.environ["BENCH_SEQ512_BATCH"])] \
+            if os.environ.get("BENCH_SEQ512_BATCH") else [32, 16]
+        b512 = choices[min(attempt - 1, len(choices) - 1)]
         s512_steps = max(steps // 3, 5)
         # 80 = bing_bert's max_predictions_per_seq at seq 512
         cfg512 = BertConfig.bert_large(
@@ -372,6 +435,7 @@ def _measure_seq512(record, deepspeed, BertConfig, BertForPreTrainingTPU,
             record["seq512_error"] = (
                 f"invalid measurement: mfu={mfu512:.2f} loss={final512}")
         else:
+            record["seq512_batch"] = b512
             record["seq512_samples_per_sec"] = round(sps512, 2)
             record["seq512_vs_baseline"] = round(
                 sps512 / BASELINE_SEQ512_SAMPLES_PER_SEC, 3)

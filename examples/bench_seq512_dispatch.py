@@ -59,13 +59,16 @@ print(f"AB_RESULT {b * steps / dt:.2f}")
 
 
 def run_cell(mode, batch, steps=12):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # APPEND to PYTHONPATH: clobbering it drops the site dir that
+    # registers the TPU attachment plugin on this environment
+    pp = os.environ.get("PYTHONPATH", "")
     env = dict(os.environ, DS_FLASH_ATTENTION=mode, T_B=str(batch),
                T_S=str(steps),
-               PYTHONPATH=os.path.dirname(os.path.dirname(
-                   os.path.abspath(__file__))))
+               PYTHONPATH=f"{repo}:{pp}" if pp else repo)
     proc = subprocess.run([sys.executable, "-u", "-c", _TRIAL], env=env,
                           capture_output=True, text=True, timeout=1800,
-                          cwd=env["PYTHONPATH"])
+                          cwd=repo)
     for line in proc.stdout.splitlines():
         if line.startswith("AB_RESULT "):
             return float(line.split()[1])

@@ -173,6 +173,9 @@ def test_eval_batch(cpu_devices):
     x = np.random.default_rng(0).normal(size=(16, HIDDEN)).astype(np.float32)
     out = engine.eval_batch((x, x))
     assert out.shape == (16, HIDDEN)
+    # iterator form (the reference eval_batch contract, pipe/engine.py:320)
+    out_it = engine.eval_batch(iter([(x, x)]))
+    np.testing.assert_allclose(np.asarray(out_it), np.asarray(out))
 
 
 @pytest.mark.slow

@@ -82,3 +82,15 @@ def test_streamed_offload_checkpoint_roundtrip(tmp_path, monkeypatch):
     l_ref = float(np.asarray(jax.device_get(
         engine.train_batch(iter([batch])))))
     np.testing.assert_allclose(l_resumed, l_ref, rtol=2e-4, atol=2e-4)
+
+
+def test_streamed_offload_grouped_with_chunking_disabled(monkeypatch):
+    """offload_chunk_mb=0 disables sub-group chunking, but row-grouped
+    state must STILL stream (one chunk per group) — the one-shot update
+    cannot consume tuple-of-group buffers."""
+    import deepspeed_tpu.runtime.zero.coordinator as coord
+
+    monkeypatch.setattr(coord, "HOST_GROUP_BYTES", 1 << 20)
+    losses, engine = _losses(cpu_offload=True, chunk_mb=0)
+    assert engine.flat.host_group_bounds is not None
+    assert losses[-1] < losses[0], losses
