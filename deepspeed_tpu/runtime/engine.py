@@ -664,13 +664,14 @@ class DeepSpeedEngine:
         stream_min_bytes = 1792 << 20
         try:
             # derive the floor from real device memory when the backend
-            # reports it (~11% of HBM ~= the 1.75G/16G calibration point);
-            # remote-attached backends (axon) return None/raise -> keep
-            # the 16G-chip calibration
+            # reports it (~11% of HBM ~= the 1.75G/16G calibration point,
+            # applied in BOTH directions so >16G chips keep the faster
+            # one-shot path for proportionally bigger state); remote-
+            # attached backends (axon) return None/raise -> keep the
+            # 16G-chip calibration
             ms = mesh.devices.flat[0].memory_stats()
             if ms and ms.get("bytes_limit"):
-                stream_min_bytes = min(stream_min_bytes,
-                                       int(ms["bytes_limit"] * 0.11))
+                stream_min_bytes = int(ms["bytes_limit"] * 0.11)
         except Exception:
             pass
         chunk_mb_forced = (chunk_mb > 0 and getattr(
@@ -1449,15 +1450,40 @@ class DeepSpeedEngine:
     def eval_batch(self, batch):
         """Loss on one batch with ``train=False`` semantics.
 
-        Accepts either a batch pytree or an iterator, from which EXACTLY
-        ONE batch is drawn (the reference's ``eval_batch`` is
-        iterator-based, ``pipe/engine.py:320``, but also aggregates
-        ``micro_batches`` draws — callers wanting an averaged eval loss
-        over several micro-batches should loop and average; a raw
-        iterator would otherwise reach ``_shard_batch`` as an
-        object-dtype leaf and fail obscurely)."""
+        Accepts either a batch pytree (evaluated as-is) or an iterator,
+        from which ``gradient_accumulation_steps`` micro-batches are drawn
+        and their mean loss returned — the reference pipe engine's
+        contract (``pipe/engine.py:320``: pulls ``micro_batches`` entries
+        per call), so callers porting reference eval loops see the same
+        iterator advancement and the same averaged loss."""
         if hasattr(batch, "__next__"):
-            batch = next(batch)
+            losses = []
+            for _ in range(max(1, self.gradient_accumulation_steps())):
+                try:
+                    losses.append(self._eval_one(next(batch)))
+                except StopIteration:
+                    # dataset tail shorter than gas: average what we got
+                    # rather than leaking StopIteration (PEP 479 would
+                    # turn it into RuntimeError inside caller generators)
+                    break
+            if not losses:
+                raise ValueError(
+                    "eval_batch received an exhausted iterator")
+            if len(losses) == 1:
+                return losses[0]
+            # mean over the micro-batch axis, pytree-safe (models whose
+            # eval output is logits rather than a scalar loss)
+            try:
+                return jax.tree_util.tree_map(
+                    lambda *xs: jnp.mean(jnp.stack(xs), axis=0), *losses)
+            except (ValueError, TypeError) as e:
+                raise ValueError(
+                    "eval_batch cannot aggregate ragged per-example eval "
+                    "outputs across micro-batches; pass equal-shape "
+                    "micro-batches or call eval_batch per batch") from e
+        return self._eval_one(batch)
+
+    def _eval_one(self, batch):
         batch = self._shard_batch(batch)
         with self.mesh:
             return self._eval_fn(self._forward_params(), batch, self._next_rng(),

@@ -48,10 +48,15 @@ class DeepSpeedZeroConfig:
         # presence flag: an EXPLICIT offload_chunk_mb (even at the default
         # value) overrides the engine's stream-vs-one-shot floor
         self.offload_chunk_mb_explicit = C.ZERO_OFFLOAD_CHUNK_MB in d
-        assert (isinstance(self.offload_chunk_mb, int)
-                and self.offload_chunk_mb >= 0), (
-            f"offload_chunk_mb must be a non-negative integer (MB; 0 "
-            f"disables chunking), got {self.offload_chunk_mb!r}")
+        # ValueError (not assert: stripped under -O); bool is an int
+        # subclass, and "offload_chunk_mb": true silently meaning 1 MB
+        # chunks would be a config foot-gun
+        if (isinstance(self.offload_chunk_mb, bool)
+                or not isinstance(self.offload_chunk_mb, int)
+                or self.offload_chunk_mb < 0):
+            raise ValueError(
+                f"offload_chunk_mb must be a non-negative integer (MB; 0 "
+                f"disables chunking), got {self.offload_chunk_mb!r}")
         self.elastic_checkpoint = get_scalar_param(d, C.ZERO_ELASTIC_CHECKPOINT,
                                                    C.ZERO_ELASTIC_CHECKPOINT_DEFAULT)
 

@@ -95,6 +95,20 @@ def test_cpu_offload_requires_stage2():
         }, world_size=1)
 
 
+def test_offload_chunk_mb_rejects_bool_and_negative():
+    # bool is an int subclass: "offload_chunk_mb": true must not silently
+    # become 1 MB chunks; validation raises (ValueError, not a -O-stripped
+    # assert)
+    for bad in (True, False, -1, "512"):
+        with pytest.raises((ValueError, AssertionError)):
+            make_config({
+                "train_batch_size": 8,
+                "bf16": {"enabled": True},
+                "zero_optimization": {"stage": 2, "cpu_offload": True,
+                                      "offload_chunk_mb": bad},
+            }, world_size=1)
+
+
 def test_fp16_and_bf16_exclusive():
     with pytest.raises(AssertionError):
         make_config({

@@ -178,6 +178,33 @@ def test_eval_batch(cpu_devices):
     np.testing.assert_allclose(np.asarray(out_it), np.asarray(out))
 
 
+def test_eval_batch_iterator_aggregates_micro_batches(cpu_devices):
+    """Iterator form draws gradient_accumulation_steps micro-batches and
+    returns their mean — the reference pipe-engine contract
+    (pipe/engine.py:320)."""
+    from .simple_model import SimpleMLPWithLogits
+
+    config = dict(base_config())
+    config["train_batch_size"] = 32
+    config["train_micro_batch_size_per_gpu"] = 2
+    config["gradient_accumulation_steps"] = 2
+    mesh = make_mesh({"data": 8}, devices=cpu_devices)
+    model = SimpleMLPWithLogits(HIDDEN, nlayers=1)
+    engine, _, _, _ = deepspeed.initialize(model=model, config=config, mesh=mesh)
+    rng = np.random.default_rng(0)
+    b1 = rng.normal(size=(16, HIDDEN)).astype(np.float32)
+    b2 = rng.normal(size=(16, HIDDEN)).astype(np.float32)
+    out1 = engine.eval_batch((b1, b1))
+    out2 = engine.eval_batch((b2, b2))
+    it = iter([(b1, b1), (b2, b2), (b1, b1)])
+    agg = engine.eval_batch(it)
+    np.testing.assert_allclose(
+        np.asarray(agg), (np.asarray(out1) + np.asarray(out2)) / 2,
+        rtol=1e-6)
+    # exactly micro_batches entries consumed
+    assert next(it)[0] is b1
+
+
 @pytest.mark.slow
 def test_zero3_shards_resident_state_compile_time():
     """ZeRO-3's memory claim, checked at compile time: the train step's
