@@ -374,6 +374,52 @@ def _bwd_dkv_kernel(*refs, scale, causal, masked, dropout, single, exp2):
         dv_ref[0] = dv_sc[...].astype(dv_ref.dtype)
 
 
+def _bwd_fused_kernel(*refs, scale, causal, masked, dropout, exp2):
+    """Single-tile fused backward: dq, dk, dv from ONE score
+    materialization.  The streamed pair (_bwd_dq_kernel + _bwd_dkv_kernel)
+    each recompute the q·kᵀ scores, the softmax exp, the dᵒ·vᵀ dot and —
+    under dropout — the PRNG mask; at the single-tile shapes the auto
+    policy picks for s ≤ 1024 (GPT-2 s=1024 causal, BERT s=512) the whole
+    tile fits VMEM, so one straight-line kernel computes p and ds once
+    and feeds all three gradient dots (round-5 follow-up to the round-4b
+    single-tile forward: the same win applied to the backward)."""
+    refs = list(refs)
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = refs[:6]
+    rest = refs[6:]
+    seed_ref = rest.pop(0) if dropout else None
+    kvm_ref = rest.pop(0) if masked else None
+    dq_ref, dk_ref, dv_ref = rest
+
+    block_q, d = q_ref.shape[1], q_ref.shape[2]
+    block_k = k_ref.shape[1]
+    i = pl.program_id(0)
+    score_scale = scale * LOG2E if exp2 else scale
+
+    s = _scores(q_ref[0], k_ref[0], score_scale, causal, masked, kvm_ref,
+                0, 0, block_q, block_k)
+    p = _ex(s - lse_ref[0, 0][:, None], exp2)  # [Bq, Bk] fp32
+    dp = jax.lax.dot_general(do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    if dropout:
+        thresh, inv_keep = _dropout_thresh(dropout)
+        keep = _keep_mask(seed_ref, i, 0, 0, (block_q, block_k), thresh)
+        p_v = jnp.where(keep, p * inv_keep, 0.0)
+        dp = jnp.where(keep, dp * inv_keep, 0.0)
+    else:
+        p_v = p
+    ds = (p * (dp - delta_ref[0, 0][:, None])).astype(q_ref.dtype)
+    dq_ref[0] = (jax.lax.dot_general(
+        ds, k_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale).astype(dq_ref.dtype)
+    # s was scaled after the q·kᵀ dot, so the 1/√d factor lands on dk too
+    dk_ref[0] = (jax.lax.dot_general(
+        ds, q_ref[0], (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale).astype(dk_ref.dtype)
+    dv_ref[0] = jax.lax.dot_general(
+        p_v.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dv_ref.dtype)
+
+
 def _flatten_heads(x):
     b, s, h, d = x.shape
     return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
@@ -586,6 +632,47 @@ def _flash_bwd_rule(causal, block_q, block_k, interpret, dropout_rate, res, g):
     if masked:
         mask_ops = (kv_mask.astype(jnp.float32)[:, None, :],)
         mask_specs = (_mask_spec(h, block_k),)
+
+    if n_qb == 1 and n_kb == 1:
+        # single-tile fused backward: one kernel, one score pass
+        grid_1d = ({} if (pltpu is None or interpret) else
+                   {"compiler_params": pltpu.CompilerParams(
+                       dimension_semantics=("parallel",),
+                       vmem_limit_bytes=100 * 1024 * 1024)})
+        fused_seed_specs = seed_specs
+        fused_mask_specs = ((pl.BlockSpec((1, 1, block_k),
+                                          lambda i: (i // h, 0, 0)),)
+                            if masked else ())
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(_bwd_fused_kernel, scale=scale, causal=causal,
+                              masked=masked, dropout=drop, exp2=EXP2),
+            grid=(bh,),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda i: (i, 0, 0)),
+                pl.BlockSpec((1, block_k, d), lambda i: (i, 0, 0)),
+                pl.BlockSpec((1, block_k, d), lambda i: (i, 0, 0)),
+                pl.BlockSpec((1, block_q, d), lambda i: (i, 0, 0)),
+                pl.BlockSpec((1, 1, block_q), lambda i: (i, 0, 0)),
+                pl.BlockSpec((1, 1, block_q), lambda i: (i, 0, 0)),
+                *fused_seed_specs,
+                *fused_mask_specs,
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_q, d), lambda i: (i, 0, 0)),
+                pl.BlockSpec((1, block_k, d), lambda i: (i, 0, 0)),
+                pl.BlockSpec((1, block_k, d), lambda i: (i, 0, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+                jax.ShapeDtypeStruct((bh, kv_len, d), k.dtype),
+                jax.ShapeDtypeStruct((bh, kv_len, d), v.dtype),
+            ],
+            interpret=interpret,
+            **grid_1d,
+        )(qf, kf, vf, dof, lse, delta, *seed_ops, *mask_ops)
+        dqh = (_unflatten_heads(dq, b, h), _unflatten_heads(dk, b, h),
+               _unflatten_heads(dv, b, h))
+        return dqh + (jnp.zeros_like(kv_mask) if masked else None, None)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,

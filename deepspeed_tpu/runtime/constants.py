@@ -147,6 +147,14 @@ ZERO_CPU_OFFLOAD_DEFAULT = False
 # three full buffers.  0 disables chunking.
 ZERO_OFFLOAD_CHUNK_MB = "offload_chunk_mb"
 ZERO_OFFLOAD_CHUNK_MB_DEFAULT = 512
+# Keep the flat fp32 gradient buffer in pinned host memory too (reference
+# ZeRO-Offload moves averaged gradients to CPU as the backward produces
+# them, stage2.py:622-668): the compiled step writes gradient rows out
+# chunk-by-chunk as the backward frees them and the streamed update reads
+# them back per chunk, so device HBM never holds the full 4 bytes/param
+# gradient buffer — the last per-param device cost beyond the bf16 params.
+ZERO_OFFLOAD_GRADIENTS = "offload_gradients"
+ZERO_OFFLOAD_GRADIENTS_DEFAULT = False
 ZERO_ELASTIC_CHECKPOINT = "elastic_checkpoint"
 ZERO_ELASTIC_CHECKPOINT_DEFAULT = True
 

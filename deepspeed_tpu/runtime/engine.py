@@ -265,14 +265,31 @@ class DeepSpeedEngine:
         # model init always derives from threefry: same seed → same initial
         # params on every backend, independent of the training-stream impl
         init_rng = jax.random.PRNGKey(rng_seed)
+        offload_cfg = bool(self._config.zero_config.cpu_offload)
         if model_parameters is not None:
             params0 = model_parameters
         else:
             assert hasattr(model, "init"), (
                 "model has no .init(rng); pass model_parameters explicitly")
-            with self.mesh:
-                params0 = model.init(init_rng)
-        params0 = jax.tree_util.tree_map(jnp.asarray, params0)
+            params0 = None
+            if offload_cfg:
+                # ZeRO-Offload: init on the host CPU backend when one is
+                # available so the fp32 init params never touch HBM — the
+                # capacity ceiling is then set by the streamed step, not
+                # by init (reference analog: ZeRO-Offload's "10x bigger
+                # models" claim requires init to not be the limit either,
+                # stage2.py:326-342).  Same seed → same params (init keys
+                # are threefry on every backend).
+                params0 = self._try_host_init(model, init_rng)
+            if params0 is None:
+                with self.mesh:
+                    params0 = model.init(init_rng)
+        if offload_cfg:
+            # host leaves: the flatten consumes them leaf-wise on host;
+            # putting them on device here would re-impose the init ceiling
+            params0 = jax.tree_util.tree_map(np.asarray, params0)
+        else:
+            params0 = jax.tree_util.tree_map(jnp.asarray, params0)
         self._param_template = jax.tree_util.tree_map(
             lambda x: jax.ShapeDtypeStruct(x.shape, self.compute_dtype), params0)
 
@@ -327,23 +344,35 @@ class DeepSpeedEngine:
                 "reference parity: ZeRO-Offload pairs with [CPU]Adam "
                 "(stage2.py:326, zero/utils.py:26)")
         with self.mesh:
-            if self.flat.host_group_bounds is not None:
-                # grouped offload state: per-group zero init (Adam-family
-                # state is zeros_like + a step scalar; the full-buffer
-                # init would materialize fp32 state on device all at once)
+            if self._offload and getattr(self.optimizer, "name", "") in (
+                    "adam", "cpu_adam", "lamb"):
+                # offload state: host-side zero init (every flat optimizer
+                # here is zeros_like + a step scalar — asserted by
+                # test_zero_offload); running init_state on device would
+                # materialize full fp32 state in HBM just to write zeros
                 opt_shape = jax.eval_shape(
                     self.optimizer.init_state,
                     jax.ShapeDtypeStruct(self.segments.shape, jnp.float32))
+                bounds = (self.flat.host_group_bounds
+                          or ((0, self.segments.rows),))
 
                 def _mk(leaf):
                     if leaf.shape == self.segments.shape:
-                        return tuple(
-                            jax.device_put(jnp.zeros((rc, LANES), leaf.dtype),
+                        grps = tuple(
+                            jax.device_put(np.zeros((rc, LANES),
+                                                    leaf.dtype),
                                            self.flat.master_sharding)
-                            for _, rc in self.flat.host_group_bounds)
+                            for _, rc in bounds)
+                        return (grps if self.flat.host_group_bounds
+                                is not None else grps[0])
                     return jnp.zeros(leaf.shape, leaf.dtype)
 
                 opt0 = jax.tree_util.tree_map(_mk, opt_shape)
+            elif self.flat.host_group_bounds is not None:
+                raise ValueError(
+                    "cpu_offload with row-grouped host state requires a "
+                    "zeros-init flat optimizer (adam/lamb family), got "
+                    f"{getattr(self.optimizer, 'name', type(self.optimizer))}")
             else:
                 master0_dev = (jax.device_put(
                     master0, self.flat.master_device_sharding)
@@ -361,9 +390,43 @@ class DeepSpeedEngine:
             delayed_shift=(self._config.dynamic_loss_scale_args or {}).get(
                 "delayed_shift", 1))
 
+        # host-resident flat gradients (ZeRO-Offload's gradient leg,
+        # reference stage2.py:622-668): only meaningful under in-jit
+        # streaming; the buffer is donated through every fused step
+        offload_grads_requested = bool(
+            getattr(self._config.zero_config, "offload_gradients", False))
+        self._offload_grads = (offload_grads_requested and self._offload
+                               and not self._offload_eager)
+        if offload_grads_requested and not self._offload_grads:
+            # loud, not silent: the flag exists to eliminate the
+            # 4 bytes/param device gradient buffer — dropping it quietly
+            # would let the job OOM at exactly the scale the flag was set
+            # to reach
+            raise ValueError(
+                "offload_gradients requires in-jit host placement (TPU "
+                "backend); this backend only supports eager offload mode")
+        if self._offload_grads:
+            if self._sparse_grad_paths:
+                raise ValueError(
+                    "offload_gradients does not compose with "
+                    "sparse_gradients (the row-sparse shard_map exchange "
+                    "has no host-streamed form)")
+            if getattr(self.optimizer, "name", "") != "adam":
+                raise ValueError(
+                    "offload_gradients requires the flat Adam optimizer "
+                    "(the chunk-streamed update)")
+            if self.gradient_accumulation_steps() > 1:
+                raise ValueError(
+                    "offload_gradients does not yet support "
+                    "gradient_accumulation_steps > 1 (the host gradient "
+                    "buffer is written once per fused step)")
+        hostgrad0 = (self.flat.alloc_host_grads()
+                     if self._offload_grads else None)
+
         self.state = {
             "master": master0,
             "opt": opt0,
+            "hostgrad": hostgrad0,
             "scale": scale0,
             "skipped": jnp.asarray(0, jnp.int32),
             # device-resident step counter: the fused train step derives its
@@ -678,7 +741,8 @@ class DeepSpeedEngine:
             self._config.zero_config, "offload_chunk_mb_explicit", False))
         offload_stream = (
             offload and getattr(optimizer, "name", "") == "adam"
-            and (groups is not None
+            and (self._offload_grads  # host grads ride the chunk stream
+                 or groups is not None
                  or (rows_per_chunk is not None
                      and segments.rows > rows_per_chunk
                      and (chunk_mb_forced
@@ -706,7 +770,8 @@ class DeepSpeedEngine:
             # NODES, not row-group containers
             return type(x) is tuple
 
-        def _stream_one_group(master_g, st_g, g_g, hp, overflow, token):
+        def _stream_one_group(master_g, st_g, g_g, hp, overflow, token,
+                              coef=None, g_on_host=False, cast_chunks=None):
             """Stream one host buffer's (p, m, v) through the device chunk
             by chunk.  ``g_g`` is this group's slice of the device-resident
             unscaled gradient; ``overflow`` gates an fp16 no-op step per
@@ -721,22 +786,55 @@ class DeepSpeedEngine:
             opt_leaves, opt_def = jax.tree_util.tree_flatten(st_g)
             is_flat = [getattr(l, "ndim", 0) == 2 for l in opt_leaves]
             scalar_out = [None] * len(opt_leaves)
+            # depth-2 chunk pipeline: chunk k's host loads gate on chunk
+            # k-2's UPDATE token, so chunk k+1's host→device transfer
+            # overlaps chunk k's update compute and write-back (the
+            # reference hides CPU-Adam latency behind streams the same
+            # way, csrc/adam/cpu_adam.cpp:60-66).  Peak HBM = two chunks
+            # of (p, m, v[, g]) instead of one; the fully serial chain
+            # (round 4) left the device idle during every transfer.
+            # Measured (gpt2-large, 0.77B): slicing the ORIGINAL buffer
+            # values (disjoint rows, SSA-clean) to decouple load k from
+            # write k-1 REGRESSED 1.62 → 2.23 s/step — it defeats XLA's
+            # in-place donation aliasing on the host buffers, and the
+            # induced host copies cost more than the overlap gains.  So
+            # chunks slice the rebound post-DUS values (aliasing-
+            # friendly); the depth-2 token still lets the h2d DMA of
+            # chunk k+1's data issue while chunk k's update computes.
+            tok2 = tok1 = token
             for r0, rc in _chunks(master_g.shape[0]):
-                host_slices = _after(token, [
-                    jax.lax.slice_in_dim(master_g, r0, r0 + rc)] + [
+                slices = [jax.lax.slice_in_dim(master_g, r0, r0 + rc)] + [
                     jax.lax.slice_in_dim(l, r0, r0 + rc)
-                    for l, f in zip(opt_leaves, is_flat) if f])
+                    for l, f in zip(opt_leaves, is_flat) if f]
+                if g_on_host:
+                    # offload_gradients: the gradient chunk loads from the
+                    # pinned-host flat buffer alongside (p, m, v);
+                    # unscale/clip fold into one per-chunk multiply
+                    slices.append(jax.lax.slice_in_dim(g_g, r0, r0 + rc))
+                host_slices = _after(tok2, slices)
                 pm = jax.device_put(host_slices[0], dev_sharding)
                 it = iter(host_slices[1:])
                 chunk_leaves = [
                     jax.device_put(next(it), dev_sharding) if f else l
                     for l, f in zip(opt_leaves, is_flat)]
                 st = jax.tree_util.tree_unflatten(opt_def, chunk_leaves)
-                gc = jax.lax.slice_in_dim(g_g, r0, r0 + rc)
+                if g_on_host:
+                    gc = jax.device_put(host_slices[-1],
+                                        dev_sharding) * coef
+                else:
+                    gc = jax.lax.slice_in_dim(g_g, r0, r0 + rc)
                 new_p, new_st = optimizer.update(st, pm, gc, hp)
-                token = new_p[0, 0]
+                tok2, tok1 = tok1, new_p[0, 0]
+                token = tok1
                 if fp16:
                     new_p = jnp.where(overflow, pm, new_p)
+                if cast_chunks is not None:
+                    # fold the compute-dtype param cast into the update:
+                    # the new-param chunk is already on device, so the
+                    # post-update streamed cast's re-download of the whole
+                    # master (4 bytes/param of host→device traffic, a
+                    # fully serial phase) disappears
+                    cast_chunks.append(new_p.astype(self.compute_dtype))
                 master_g = jax.lax.dynamic_update_slice(
                     master_g, jax.device_put(new_p, host_big), (r0, 0))
                 for idx, (old_c, new_l) in enumerate(zip(
@@ -758,30 +856,168 @@ class DeepSpeedEngine:
             return (master_g,
                     jax.tree_util.tree_unflatten(opt_def, new_leaves), token)
 
-        def chunked_offload_update(master, opt_state, g, hp, overflow):
+        def carve_leaves(chunk_list):
+            """In-order device chunks tiling the flat rows → params pytree
+            in compute dtype (leaves carved with ordinary device slices;
+            see the cast_params alignment note)."""
+            tmpl_leaves, treedef = jax.tree_util.tree_flatten(
+                self._param_template)
+            offs, rcs, ns = (segments.row_offsets, segments.row_counts,
+                             segments.sizes)
+            pieces = [[] for _ in tmpl_leaves]
+            abs0 = 0
+            for chunk in chunk_list:
+                end = abs0 + chunk.shape[0]
+                for i in range(len(tmpl_leaves)):
+                    lo = max(offs[i], abs0)
+                    hi = min(offs[i] + rcs[i], end)
+                    if lo < hi:
+                        pieces[i].append(jax.lax.slice_in_dim(
+                            chunk, lo - abs0, hi - abs0))
+                abs0 = end
+            assert abs0 == segments.rows, (abs0, segments.rows)
+            out = []
+            for i, tl in enumerate(tmpl_leaves):
+                rows = (pieces[i][0] if len(pieces[i]) == 1
+                        else jnp.concatenate(pieces[i], axis=0))
+                out.append(jax.lax.slice(
+                    rows.reshape(-1), (0,), (ns[i],)).reshape(tl.shape))
+            params = jax.tree_util.tree_unflatten(treedef, out)
+            return jax.tree_util.tree_map(
+                lambda x, s: jax.lax.with_sharding_constraint(x, s),
+                params, param_shardings)
+
+        def chunked_offload_update(master, opt_state, g, hp, overflow,
+                                   coef=None, g_on_host=False,
+                                   want_cast=False):
             """Group loop around :func:`_stream_one_group`: grouped state
             (master/opt as tuples of ≤HOST_GROUP_BYTES host buffers) streams
-            group by group; ungrouped state is a single group."""
+            group by group; ungrouped state is a single group.  Under
+            ``offload_gradients`` ``g`` is the pinned-host flat gradient
+            (grouped like the master) and ``coef`` folds unscale+clip.
+            ``want_cast`` collects the updated chunks cast to the compute
+            dtype (in row order) so the caller can assemble the new params
+            without re-reading the master from host."""
             masters = master if type(master) is tuple else (master,)
             gb = groups or ((0, segments.rows),)
             token = jnp.float32(0.0)
             new_masters, new_sts = [], []
+            cast_list = ([] if (want_cast and self.compute_dtype)
+                         else None)
             for gi, (gr0, grc) in enumerate(gb):
                 st_g = jax.tree_util.tree_map(
                     lambda l: l[gi] if type(l) is tuple else l,
                     opt_state, is_leaf=_is_grp)
-                g_g = jax.lax.slice_in_dim(g, gr0, gr0 + grc)
+                if g_on_host:
+                    g_g = g[gi] if type(g) is tuple else g
+                else:
+                    g_g = jax.lax.slice_in_dim(g, gr0, gr0 + grc)
                 nm, nst, token = _stream_one_group(
-                    masters[gi], st_g, g_g, hp, overflow, token)
+                    masters[gi], st_g, g_g, hp, overflow, token,
+                    coef=coef, g_on_host=g_on_host, cast_chunks=cast_list)
                 new_masters.append(nm)
                 new_sts.append(nst)
             if groups is None:
-                return new_masters[0], new_sts[0]
+                return new_masters[0], new_sts[0], cast_list
             new_opt = jax.tree_util.tree_map(
                 lambda orig, *gs: tuple(gs) if type(orig) is tuple
                 else gs[0],
                 opt_state, *new_sts, is_leaf=_is_grp)
-            return tuple(new_masters), new_opt
+            return tuple(new_masters), new_opt, cast_list
+
+        host_grad_big = self.flat.grad_host_sharding
+        offload_grads_mode = self._offload_grads and offload_stream
+
+        def grads_tree_to_host(grads, hostg):
+            """Write the flat fp32 gradient into the donated pinned-host
+            buffer chunk-by-chunk, iterating chunks in REVERSE row order
+            (≈ the backward's production order: later tree leaves — later
+            layers and the LM head — produce their gradients first), so
+            each grad leaf's device lifetime ends at its host write and
+            the full 4 bytes/param gradient never sits in HBM (reference
+            analog: ZeRO-Offload moves averaged gradients to CPU as the
+            backward frees them, stage2.py:622-668).  Squared norm and
+            finiteness accumulate on device during the pass — clipping
+            and fp16 overflow detection would otherwise cost a second
+            streamed read of the host buffer."""
+            leaves = jax.tree_util.tree_leaves(grads)
+            hostgs = list(hostg) if type(hostg) is tuple else [hostg]
+            bounds = groups or ((0, segments.rows),)
+            offs, rcs, ns = (segments.row_offsets, segments.row_counts,
+                             segments.sizes)
+            sq = jnp.float32(0.0)
+            finite = jnp.asarray(True)
+            tok2 = tok1 = jnp.float32(0.0)  # depth-2: see update loop
+            for gi in reversed(range(len(bounds))):
+                gr0, grc = bounds[gi]
+                for r0, rc in reversed(_chunks(grc)):
+                    abs0 = gr0 + r0
+                    end = abs0 + rc
+                    parts, cursor = [], abs0
+                    for i in range(len(leaves)):
+                        lo = max(offs[i], abs0)
+                        hi = min(offs[i] + rcs[i], end)
+                        if lo >= hi:
+                            continue
+                        if lo > cursor:  # inter-leaf padding rows
+                            parts.append(jnp.zeros(
+                                ((lo - cursor) * LANES,), jnp.float32))
+                        el_lo = (lo - offs[i]) * LANES
+                        el_hi = (hi - offs[i]) * LANES
+                        flat_leaf = leaves[i].reshape(-1).astype(jnp.float32)
+                        take_hi = min(el_hi, ns[i])
+                        if el_lo < take_hi:
+                            parts.append(jax.lax.slice(
+                                flat_leaf, (el_lo,), (take_hi,)))
+                        if take_hi < el_hi:  # leaf's own row-tail padding
+                            parts.append(jnp.zeros(
+                                (el_hi - take_hi,), jnp.float32))
+                        cursor = hi
+                    if cursor < end:  # trailing dp-padding rows
+                        parts.append(jnp.zeros(
+                            ((end - cursor) * LANES,), jnp.float32))
+                    parts = _after(tok2, parts)
+                    chunk = (parts[0] if len(parts) == 1
+                             else jnp.concatenate(parts)).reshape(rc, LANES)
+                    if clip > 0.0:
+                        sq = sq + jnp.sum(chunk ** 2)
+                    if fp16:
+                        finite = jnp.logical_and(
+                            finite, jnp.all(jnp.isfinite(chunk)))
+                    tok2, tok1 = tok1, chunk[0, 0]
+                    hostgs[gi] = jax.lax.dynamic_update_slice(
+                        hostgs[gi], jax.device_put(chunk, host_grad_big),
+                        (r0, 0))
+            out = tuple(hostgs) if type(hostg) is tuple else hostgs[0]
+            return out, sq, finite
+
+        def apply_update_hostg(master, opt_state, scale_state, skipped,
+                               hostg, sq, finite, hp):
+            """The offload_gradients update: gradients stream back from
+            the pinned-host buffer per chunk; unscale + clip fold into a
+            single per-chunk multiply (``coef``)."""
+            inv = 1.0 / scale_state.cur_scale
+            overflow = (jnp.logical_not(finite) if fp16
+                        else jnp.asarray(False))
+            if clip > 0.0:
+                gnorm = jnp.sqrt(sq) * inv
+                coef = inv * jnp.minimum(1.0, clip / (gnorm + 1e-6))
+            else:
+                gnorm = jnp.asarray(0.0, jnp.float32)
+                coef = jnp.asarray(inv, jnp.float32)
+            new_master, new_opt, cast_list = chunked_offload_update(
+                master, opt_state, hostg, hp, overflow, coef=coef,
+                g_on_host=True, want_cast=True)
+            if fp16 and dynamic:
+                scale_state = update_scale_state(
+                    scale_state, overflow,
+                    scale_window=scale_args.get("scale_window", 1000),
+                    min_scale=scale_args.get("min_scale", 1.0),
+                    delayed_shift=scale_args.get("delayed_shift", 1))
+            if fp16:
+                skipped = skipped + overflow.astype(jnp.int32)
+            return (new_master, new_opt, scale_state, skipped, overflow,
+                    gnorm, cast_list)
 
         def cast_params(master):
             # stage 3 skips the up-front full replication: each leaf's row
@@ -790,23 +1026,32 @@ class DeepSpeedEngine:
             # instead of materializing a replicated copy of every
             # parameter for the whole step (stage-3's memory win)
             if offload_stream and self.compute_dtype:
-                # streamed cast: the fp32 master never materializes whole
-                # on device — each chunk casts to the compute dtype on
-                # arrival, so peak HBM is the bf16 buffer + one fp32 chunk.
-                # Chained (_after) for the same reason as the update: un-
-                # ordered chunk pipelines would all stream simultaneously.
-                parts, token = [], jnp.float32(0.0)
+                # leaf-direct streamed cast: parameter leaves materialize
+                # from chunk-aligned host reads — the full flat
+                # compute-dtype buffer never exists on device, so cast
+                # peak is the bf16 leaves plus ~two fp32 chunks.  (The
+                # round-4 parts+concat+unflatten form peaked at
+                # ~4 bytes/param — flat bf16 AND the leaves — re-imposing
+                # a ~2B capacity ceiling the update stream had removed.)
+                # Load-bearing detail: host-space slice offsets must stay
+                # CHUNK-ALIGNED — per-leaf (unaligned) host reads
+                # silently corrupted the whole fused step on the bench
+                # attachment (master write-back lost, cast returned
+                # zeros), so each aligned chunk loads to device whole and
+                # leaves are carved out with ordinary device slices.
                 masters = master if type(master) is tuple else (master,)
-                for m_g in masters:
-                    for r0, rc in _chunks(m_g.shape[0]):
-                        src = _after(token,
-                                     jax.lax.slice_in_dim(m_g, r0, r0 + rc))
-                        part = jax.device_put(src, dev_sharding).astype(
+                bounds = groups or ((0, segments.rows),)
+                tok2 = tok1 = jnp.float32(0.0)  # depth-2: see update loop
+                chunk_list = []
+                for gi, (gr0, grc) in enumerate(bounds):
+                    for r0, rc in _chunks(grc):
+                        src = _after(tok2, jax.lax.slice_in_dim(
+                            masters[gi], r0, r0 + rc))
+                        chunk = jax.device_put(src, dev_sharding).astype(
                             self.compute_dtype)
-                        token = part[0, 0].astype(jnp.float32)
-                        parts.append(part)
-                flat_src = (parts[0] if len(parts) == 1
-                            else jnp.concatenate(parts, axis=0))
+                        tok2, tok1 = tok1, chunk[0, 0].astype(jnp.float32)
+                        chunk_list.append(chunk)
+                return carve_leaves(chunk_list)
             elif type(master) is tuple:
                 # grouped state but fp32 compute: the full fp32 buffer is
                 # needed on device regardless — assemble it
@@ -932,6 +1177,17 @@ class DeepSpeedEngine:
             loss = sloss * grad_acc / cur_scale
             return loss, flat_g, {}
 
+        def loss_and_grads_tree(params, batch, rng, cur_scale, extra):
+            """offload_gradients path: returns the raw gradient TREE (no
+            device flatten — grads_tree_to_host streams it out leaf-wise)."""
+
+            def scaled_loss(p):
+                loss = self._loss_fn(p, batch, rng=rng, train=True, **extra)
+                return (loss.astype(jnp.float32) * cur_scale) / grad_acc
+
+            sloss, grads = jax.value_and_grad(scaled_loss)(params)
+            return sloss * grad_acc / cur_scale, grads
+
         def fwd_bwd(params_or_master, batch, rng, cur_scale, extra):
             # trace-time: mesh-aware ops (ring attention) resolve THIS
             # engine's mesh even when several engines coexist in-process
@@ -949,7 +1205,7 @@ class DeepSpeedEngine:
                                  out_shardings=grad_sharding)
 
         def apply_update(master, opt_state, scale_state, skipped, flat_g, hp,
-                         segment_ids):
+                         segment_ids, want_cast=False):
             inv = 1.0 / scale_state.cur_scale
             g = flat_g * inv
             if fp16:
@@ -964,8 +1220,8 @@ class DeepSpeedEngine:
 
             if offload_stream:
                 # streamed offload: per-chunk fp16 pick happens inside
-                new_master, new_opt = chunked_offload_update(
-                    master, opt_state, g, hp, overflow)
+                new_master, new_opt, cast_list = chunked_offload_update(
+                    master, opt_state, g, hp, overflow, want_cast=want_cast)
                 if fp16 and dynamic:
                     scale_state = update_scale_state(
                         scale_state, overflow,
@@ -974,8 +1230,9 @@ class DeepSpeedEngine:
                         delayed_shift=scale_args.get("delayed_shift", 1))
                 if fp16:
                     skipped = skipped + overflow.astype(jnp.int32)
-                return (new_master, new_opt, scale_state, skipped, overflow,
+                base = (new_master, new_opt, scale_state, skipped, overflow,
                         gnorm)
+                return base + (cast_list,) if want_cast else base
 
             master = to_device(master)
             opt_state = jax.tree_util.tree_map(
@@ -1027,13 +1284,38 @@ class DeepSpeedEngine:
         base_rng = self._rng
 
         def train_step(master, opt_state, scale_state, skipped, ustep, params,
-                       packed, unpack_spec, hp, segment_ids, extra):
+                       packed, unpack_spec, hp, segment_ids, extra,
+                       hostgrad):
             set_current_mesh(mesh)
             cur_scale = scale_state.cur_scale
             fwd_params = cast_params(master) if stage3 else params
             batches = _unpack_batches(packed, unpack_spec)
             rng = jax.random.fold_in(base_rng,
                                      ustep * jnp.uint32(acc_steps))
+
+            if offload_grads_mode:
+                # capacity path: grads stream to pinned host as the
+                # backward frees them; the update streams them back per
+                # chunk.  acc_steps == 1 enforced at init.
+                one = jax.tree_util.tree_map(lambda x: x[0], batches)
+                loss, grads = loss_and_grads_tree(fwd_params, one, rng,
+                                                  cur_scale, extra)
+                hostgrad, sq, finite = grads_tree_to_host(grads, hostgrad)
+                del grads
+                (master, opt_state, scale_state, skipped, overflow,
+                 gnorm, cast_list) = apply_update_hostg(
+                    master, opt_state, scale_state, skipped, hostgrad, sq,
+                    finite, hp)
+                if stage3:
+                    new_params = None
+                elif cast_list is not None:
+                    new_params = carve_leaves(cast_list)
+                else:
+                    new_params = cast_params(master)
+                drops = {k: jnp.asarray(0, jnp.int32) for k in sparse_paths}
+                return (loss, master, opt_state, scale_state, skipped,
+                        ustep + jnp.uint32(1), overflow, gnorm, new_params,
+                        drops, hostgrad)
 
             def micro(carry, xs):
                 acc, i, drops_acc = carry
@@ -1060,20 +1342,37 @@ class DeepSpeedEngine:
                     micro, (jnp.zeros(segments.shape, jnp.float32),
                             jnp.asarray(0, jnp.int32), drops0), batches)
 
+            upd = apply_update(master, opt_state, scale_state, skipped,
+                               flat_g, hp, segment_ids,
+                               want_cast=offload_stream)
             (master, opt_state, scale_state, skipped, overflow,
-             gnorm) = apply_update(master, opt_state, scale_state, skipped,
-                                   flat_g, hp, segment_ids)
-            new_params = None if stage3 else cast_params(master)
+             gnorm) = upd[:6]
+            if stage3:
+                new_params = None
+            elif offload_stream and upd[6] is not None:
+                # params assembled from the update's own device chunks —
+                # no post-update re-read of the host master
+                new_params = carve_leaves(upd[6])
+            else:
+                new_params = cast_params(master)
             return (jnp.mean(losses), master, opt_state, scale_state, skipped,
-                    ustep + jnp.uint32(1), overflow, gnorm, new_params, drops)
+                    ustep + jnp.uint32(1), overflow, gnorm, new_params, drops,
+                    hostgrad)
 
+        hostgrad_sharding = None
+        if offload_grads_mode:
+            hostgrad_sharding = (
+                tuple(host_grad_big for _ in groups) if groups is not None
+                else host_grad_big)
         self._train_step_fn = jax.jit(
             train_step,
             static_argnums=(7,),
-            donate_argnums=(0, 1, 5),
+            donate_argnums=(0, 1, 5, 11) if offload_grads_mode
+            else (0, 1, 5),
             out_shardings=(None, master_out_sharding, opt_out_shardings, None,
                            None, None, None, None,
-                           None if stage3 else param_shardings, None))
+                           None if stage3 else param_shardings, None,
+                           hostgrad_sharding))
 
         # 1-bit Adam compressed phase: a second program with NO dense
         # gradient allreduce (host-side phase switch at freeze_step — the
@@ -1107,6 +1406,26 @@ class DeepSpeedEngine:
                 acc_steps=acc_steps, base_rng=base_rng,
                 master_sharding=master_sharding,
                 opt_shardings=self._opt_shardings)
+
+    @staticmethod
+    def _try_host_init(model, init_rng):
+        """Run ``model.init`` on the host CPU backend so fp32 init params
+        never occupy HBM (the ZeRO-Offload init path).  Returns None when
+        no CPU backend is available (e.g. single-platform remote
+        attachments) — the caller falls back to device init with the
+        documented ~4 bytes/param transient ceiling."""
+        try:
+            cpu = jax.local_devices(backend="cpu")[0]
+        except Exception:
+            return None
+        try:
+            with jax.default_device(cpu):
+                return model.init(jax.device_put(init_rng, cpu))
+        except Exception as e:  # pragma: no cover - backend-specific
+            logger.warning(
+                "cpu_offload host-side model init failed (%s); falling "
+                "back to device init", e)
+            return None
 
     def _state_memory(self, kind):
         """Eager-offload mode: move master + flat optimizer leaves between
@@ -1225,6 +1544,11 @@ class DeepSpeedEngine:
         and the return value is the scalar loss, not intermediate outputs.
         Clients that need raw model outputs should call
         :meth:`eval_batch` / ``module.apply`` directly."""
+        if self._offload_grads:
+            raise RuntimeError(
+                "offload_gradients supports only the fused train_batch() "
+                "path (the step-wise forward/backward API would hold the "
+                "full flat gradient on device)")
         if self.wall_clock_breakdown():
             self.timers("forward").start(sync=False)
         batch = self._shard_batch(batch)
@@ -1372,18 +1696,29 @@ class DeepSpeedEngine:
         if self._offload_eager:
             self._state_memory("device")
         with self.mesh:
-            out = step_fn(self.state["master"], self.state["opt"],
-                          self.state["scale"], self.state["skipped"],
-                          self.state["ustep"], self._module_params,
-                          packed, spec, hp,
-                          self._segment_ids, self._extra_kwargs())
-        # the regular step carries a trailing sparse-overflow counter dict;
-        # the 1-bit compressed program (no sparse exchange) does not
+            if step_fn is self._train_step_fn:
+                out = step_fn(self.state["master"], self.state["opt"],
+                              self.state["scale"], self.state["skipped"],
+                              self.state["ustep"], self._module_params,
+                              packed, spec, hp,
+                              self._segment_ids, self._extra_kwargs(),
+                              self.state.get("hostgrad"))
+            else:  # 1-bit compressed program (no hostgrad leg)
+                out = step_fn(self.state["master"], self.state["opt"],
+                              self.state["scale"], self.state["skipped"],
+                              self.state["ustep"], self._module_params,
+                              packed, spec, hp,
+                              self._segment_ids, self._extra_kwargs())
+        # the regular step carries a trailing sparse-overflow counter dict
+        # and the donated hostgrad buffer; the 1-bit compressed program
+        # (no sparse exchange, no offload) does not
         (loss, self.state["master"], self.state["opt"], self.state["scale"],
          self.state["skipped"], self.state["ustep"], overflow, gnorm,
          new_params) = out[:9]
         if len(out) > 9 and out[9]:
             self._last_sparse_drops = out[9]
+        if len(out) > 10:
+            self.state["hostgrad"] = out[10]
         if self.zero_stage < 3:
             self._module_params = new_params
         if self._offload_eager:

@@ -157,6 +157,18 @@ class _BaseSchedule:
 
     def load_state_dict(self, sd):
         self.last_batch_iteration = sd["last_batch_iteration"]
+        # re-apply the restored-iteration schedule to the optimizer NOW:
+        # the next step() only fires after the first resumed update, so
+        # without this the first post-resume update runs at the
+        # construction-time hyperparameters (caught by the checkpoint-
+        # continuity gate, tests/model/run_checkpoint_test.py — one
+        # warmup-step-0 update after resume shifted the whole curve).
+        # Delegating to step() re-applies everything a subclass schedules
+        # (OneCycle: lr AND betas).  A pre-first-step checkpoint
+        # (iteration -1) is exactly the construction state — applying
+        # would hit get_lr()'s -1 sentinel, so leave it alone.
+        if self.last_batch_iteration >= 0:
+            self.step(self.last_batch_iteration)
 
 
 class LRRangeTest(_BaseSchedule):

@@ -48,6 +48,16 @@ class DeepSpeedZeroConfig:
         # presence flag: an EXPLICIT offload_chunk_mb (even at the default
         # value) overrides the engine's stream-vs-one-shot floor
         self.offload_chunk_mb_explicit = C.ZERO_OFFLOAD_CHUNK_MB in d
+        self.offload_gradients = get_scalar_param(
+            d, C.ZERO_OFFLOAD_GRADIENTS, C.ZERO_OFFLOAD_GRADIENTS_DEFAULT)
+        if not isinstance(self.offload_gradients, bool):
+            raise ValueError(
+                f"offload_gradients must be a bool, got "
+                f"{self.offload_gradients!r}")
+        if self.offload_gradients and not self.cpu_offload:
+            raise ValueError(
+                "offload_gradients requires cpu_offload: true (the host "
+                "gradient buffer rides the offload streaming machinery)")
         # ValueError (not assert: stripped under -O); bool is an int
         # subclass, and "offload_chunk_mb": true silently meaning 1 MB
         # chunks would be a config foot-gun
@@ -69,6 +79,7 @@ class DeepSpeedZeroConfig:
                     overlap_comm=self.overlap_comm,
                     cpu_offload=self.cpu_offload,
                     offload_chunk_mb=self.offload_chunk_mb,
+                    offload_gradients=self.offload_gradients,
                     elastic_checkpoint=self.elastic_checkpoint)
 
     def __repr__(self):

@@ -120,34 +120,70 @@ class FlatParamCoordinator:
             if self.segments.rows > rows_per:
                 self.host_group_bounds = split_rows(self.segments.rows,
                                                     rows_per)
+        # host-resident flat gradient buffer (offload_gradients): same
+        # (rows, LANES) fp32 layout and grouping as the master
+        self.grad_host_sharding = (
+            NamedSharding(mesh, grad_spec, memory_kind="pinned_host")
+            if cpu_offload else None)
+
+    def alloc_host_grads(self):
+        """Pinned-host zero-filled flat gradient buffer (grouped like the
+        master); donated in/out of every fused step under
+        ``offload_gradients``."""
+        bounds = self.host_group_bounds or ((0, self.segments.rows),)
+        grps = tuple(
+            jax.device_put(np.zeros((rc, LANES), np.float32),
+                           self.grad_host_sharding)
+            for _, rc in bounds)
+        return grps if self.host_group_bounds is not None else grps[0]
 
     # -- host-side (eager) --
     def flatten_to_master(self, params) -> jax.Array:
         """Build the initial (rows, LANES) fp32 master from a params pytree.
-        Under offload the flatten runs on device and the result is parked in
-        pinned host memory eagerly (in-jit placement is not universally
-        supported at trace time on all backends).
 
-        Known init ceiling: the flatten materializes the full fp32 master
-        on device while the caller's fp32 init params are still alive —
-        ~8 bytes/param of transient HBM, capping offload INIT around 1.9B
-        params on a 16 G chip even though the streamed step itself is
-        bounded per-chunk.  Lifting it needs leaf-wise host flattening
-        (or host-side model init); see PERF.md "ZeRO-Offload capacity"."""
-        with self.mesh:
-            flat = jax.jit(self._flatten_traced,
-                           out_shardings=self.master_device_sharding)(params)
+        Offload path: LEAF-WISE host-side flatten — each leaf is pulled to
+        host RAM one at a time (numpy leaves pass through untouched),
+        written into per-group staging buffers, and the groups are
+        ``device_put`` into pinned host memory.  Device-memory transient:
+        ZERO beyond whatever the caller's leaves already occupy, so init no
+        longer caps offload capacity (the round-4 ceiling was the jitted
+        whole-tree flatten materializing ~8 bytes/param of HBM — see
+        PERF.md "ZeRO-Offload capacity").  Callers with host-initialized
+        (numpy) leaves never touch HBM at all."""
         if self.cpu_offload:
-            if self.host_group_bounds is not None:
-                groups = []
-                for r0, rc in self.host_group_bounds:
-                    groups.append(jax.device_put(flat[r0:r0 + rc],
-                                                 self.master_sharding))
-                    groups[-1].block_until_ready()
-                del flat
-                return tuple(groups)
-            flat = jax.device_put(flat, self.master_sharding)
-        return flat
+            return self._flatten_to_master_host(params)
+        with self.mesh:
+            return jax.jit(self._flatten_traced,
+                           out_shardings=self.master_device_sharding)(params)
+
+    def _flatten_to_master_host(self, params):
+        leaves = jax.tree_util.tree_leaves(params)
+        seg = self.segments
+        bounds = self.host_group_bounds or ((0, seg.rows),)
+        bufs = [np.zeros((rc, LANES), np.float32) for _, rc in bounds]
+        flat_views = [b.reshape(-1) for b in bufs]
+        for i, leaf in enumerate(leaves):
+            # one leaf at a time on host; a jax device leaf costs one
+            # leaf-sized host copy, a numpy leaf costs nothing
+            arr = np.asarray(jax.device_get(leaf),
+                             dtype=np.float32).reshape(-1)
+            start = seg.row_offsets[i] * LANES
+            n = seg.sizes[i]
+            for gi, (r0, rc) in enumerate(bounds):
+                g_lo, g_hi = r0 * LANES, (r0 + rc) * LANES
+                lo, hi = max(start, g_lo), min(start + n, g_hi)
+                if lo < hi:
+                    flat_views[gi][lo - g_lo:hi - g_lo] = arr[lo - start:
+                                                              hi - start]
+            del arr
+        groups = []
+        for buf in bufs:
+            groups.append(jax.device_put(buf, self.master_sharding))
+            groups[-1].block_until_ready()
+        del bufs, flat_views
+        if self.host_group_bounds is None:
+            return groups[0]
+        return tuple(groups)
 
     def gather_master_unpadded(self, master) -> np.ndarray:
         """Concatenated true-sized 1-D host copy (checkpoint format).

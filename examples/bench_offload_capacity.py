@@ -49,10 +49,12 @@ cfg = GPT2Config(hidden_size=h, num_layers=L, num_heads=heads,
                  remat=True, loss_chunk=256)
 mesh = make_mesh({"data": 1}, devices=jax.devices()[:1])
 model = GPT2LMHeadTPU(cfg)
+og = os.environ.get("T_OG") == "1"
 engine, *_ = deepspeed.initialize(model=model, mesh=mesh,
     config={"train_batch_size": batch, "steps_per_print": 10 ** 9,
             "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
-            "zero_optimization": {"stage": 2, "cpu_offload": off},
+            "zero_optimization": {"stage": 2, "cpu_offload": off,
+                                  "offload_gradients": og and off},
             "bf16": {"enabled": True}})
 rng = np.random.default_rng(0)
 b = {"input_ids": rng.integers(0, cfg.vocab_size,
@@ -75,10 +77,11 @@ def param_count(h, L, vocab=50257, pos=SEQ):
     return 12 * L * h * h + (vocab + pos) * h + 2 * h
 
 
-def try_step(offload, hidden, layers, heads):
+def try_step(offload, hidden, layers, heads, offload_grads=False):
     env = dict(os.environ, T_H=str(hidden), T_L=str(layers),
                T_HEADS=str(heads), T_OFF="1" if offload else "0",
-               T_B=str(BATCH), T_S=str(STEPS))
+               T_B=str(BATCH), T_S=str(STEPS),
+               T_OG="1" if offload_grads else "0")
     try:
         proc = subprocess.run([sys.executable, "-u", "-c", _TRIAL], env=env,
                               capture_output=True, text=True, timeout=1800)
@@ -96,11 +99,15 @@ def try_step(offload, hidden, layers, heads):
 def main():
     quick = "quick" in sys.argv[1:]
     ladder = LADDER[:3] if quick else LADDER
+    # three modes: device-resident, offload (state only), offload+grads
+    # (offload_gradients — the capacity configuration: bf16 params are
+    # the only per-param device cost)
+    modes = (("device", False, False), ("offload", True, False),
+             ("offload+grads", True, True))
     results = {}
-    for offload in (False, True):
-        mode = "offload" if offload else "device"
+    for mode, offload, og in modes:
         for name, h, L, heads in ladder:
-            ok, info = try_step(offload, h, L, heads)
+            ok, info = try_step(offload, h, L, heads, offload_grads=og)
             n = param_count(h, L)
             if ok:
                 print(f"[{mode}] {name}: OK  {info * 1e3:.0f} ms/step "
@@ -114,7 +121,7 @@ def main():
 
     order = [name for name, *_ in LADDER]
     print("\nsummary:")
-    for mode in ("device", "offload"):
+    for mode, *_ in modes:
         ok_names = [n for n in order if (mode, n) in results]
         if ok_names:
             largest = ok_names[-1]

@@ -163,6 +163,53 @@ def test_warmup_lr_schedule(cpu_devices):
     assert lrs[-1] < 0.01
 
 
+def test_scheduler_restore_reapplies_hyperparams():
+    """load_state_dict must re-apply the restored-iteration lr (and
+    OneCycle's betas) to the optimizer immediately: the first post-resume
+    update fires BEFORE the next scheduler.step() (caught by the
+    checkpoint-continuity gate).  A pre-first-step checkpoint
+    (iteration -1) must leave the construction state untouched."""
+    from deepspeed_tpu.ops.adam.fused_adam import FusedAdam
+    from deepspeed_tpu.runtime.lr_schedules import OneCycle, WarmupLR
+
+    opt = FusedAdam(lr=5e-4)
+    sched = WarmupLR(opt, warmup_min_lr=0.0, warmup_max_lr=1e-2,
+                     warmup_num_steps=10)
+    for _ in range(5):
+        sched.step()
+    sd = sched.state_dict()
+    lr_at_5 = opt.param_groups[0]["lr"]
+
+    opt2 = FusedAdam(lr=5e-4)
+    sched2 = WarmupLR(opt2, warmup_min_lr=0.0, warmup_max_lr=1e-2,
+                      warmup_num_steps=10)
+    sched2.load_state_dict(sd)
+    assert opt2.param_groups[0]["lr"] == lr_at_5
+
+    # pre-first-step checkpoint: construction lr preserved (get_lr's -1
+    # sentinel must not clobber it)
+    opt3 = FusedAdam(lr=5e-4)
+    sched3 = WarmupLR(opt3, warmup_min_lr=0.0, warmup_max_lr=1e-2,
+                      warmup_num_steps=10)
+    sched3.load_state_dict({"last_batch_iteration": -1})
+    assert opt3.param_groups[0]["lr"] == 5e-4
+
+    # OneCycle schedules betas too — restore must re-apply both
+    opt4 = FusedAdam(lr=5e-4)
+    c1 = OneCycle(opt4, cycle_min_lr=1e-4, cycle_max_lr=1e-2,
+                  cycle_first_step_size=10)
+    for _ in range(7):
+        c1.step()
+    sd4 = c1.state_dict()
+    lr4, betas4 = opt4.param_groups[0]["lr"], opt4.param_groups[0]["betas"]
+    opt5 = FusedAdam(lr=5e-4)
+    c2 = OneCycle(opt5, cycle_min_lr=1e-4, cycle_max_lr=1e-2,
+                  cycle_first_step_size=10)
+    c2.load_state_dict(sd4)
+    assert opt5.param_groups[0]["lr"] == lr4
+    assert opt5.param_groups[0]["betas"] == betas4
+
+
 def test_eval_batch(cpu_devices):
     from .simple_model import SimpleMLPWithLogits
 

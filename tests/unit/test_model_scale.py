@@ -45,12 +45,57 @@ def test_bert_base_mlm_curve_pinned(cpu_devices):
                                err_msg="curve drifted from pinned baseline")
 
 
-# The QA EM/F1 gate runs on the TPU tier + the standalone driver only
-# (mirroring the reference, whose BingBertSquad e2e lives in tests/model,
-# not unit CI): from-scratch 12-layer post-LN BERT needs warmup and a few
-# hundred steps to move off the uniform plateau — calibrated on-chip,
-# infeasible on the 1-core CPU tier (measured: 60 steps at lr 1e-3 stays
-# at ln(seq) exactly).
+@pytest.mark.slow
+def test_qa_gate_real_data():
+    """Extractive-QA EM/F1 gate on the vendored REAL dataset (qa_mini,
+    SQuAD v1.1 format — reference BingBertSquad/test_e2e_squad.py).
+    Calibrated: healthy run EM ~0.94 / F1 ~0.95 vs gates 0.75/0.85."""
+    from ..model import run_func_test as R
+
+    R.run_qa_gate(steps=250, batch=32, seq=128, em_min=0.75, f1_min=0.85)
+
+
+@pytest.mark.slow
+def test_qa_gate_fails_under_broken_mask():
+    """Falsifiability: the same gate must FAIL when the attention mask is
+    deliberately broken (question hidden from the encoder at eval).  Each
+    passage carries three questions with different answers and the
+    question slot is fixed-width, so a model that cannot attend the
+    question caps near EM 1/3 (measured: EM 0.15 / F1 0.27) — if this
+    test ever fails, the gate has stopped measuring attention."""
+    from ..model import run_func_test as R
+
+    R.run_qa_gate(steps=250, batch=32, seq=128, em_min=0.75, f1_min=0.85,
+                  corrupt_mask=True, _expect_fail=True)
+
+
+@pytest.mark.slow
+def test_checkpoint_resume_continuity_matrix():
+    """Train -> save -> resume-in-a-fresh-process -> the resumed loss
+    curve must match the uninterrupted run step-for-step (reference
+    ``tests/model/Megatron_GPT2/run_checkpoint_test.py``).  CPU tier runs
+    the three cheapest legs; the full 6-config matrix (incl. pipeline and
+    the elastic DP-degree change) is the standalone driver
+    ``tests/model/run_checkpoint_test.py``."""
+    import tempfile
+
+    from ..model import run_checkpoint_test as R
+
+    with tempfile.TemporaryDirectory() as tmp:
+        for name in ("baseline", "zero2", "elastic_dp"):
+            R.run_config(name, steps=8, out_dir=tmp, force_cpu=True)
+
+
+@pytest.mark.tpu
+def test_checkpoint_resume_continuity_on_chip():
+    """One continuity leg on the real chip (single-device configs only:
+    the tier has one TPU)."""
+    import tempfile
+
+    from ..model import run_checkpoint_test as R
+
+    with tempfile.TemporaryDirectory() as tmp:
+        R.run_config("zero2_offload", steps=8, out_dir=tmp, force_cpu=False)
 
 
 @pytest.mark.tpu
@@ -65,4 +110,4 @@ def test_bert_base_full_matrix_on_chip():
     with tempfile.TemporaryDirectory() as tmp:
         curves = R.run_matrix(steps=120, batch=32, seq=128, out_dir=tmp)
     R.check_matrix(curves, rtol=0.05)
-    R.run_qa_gate(steps=150, batch=32, seq=128, em_min=0.75, f1_min=0.85)
+    R.run_qa_gate(steps=250, batch=32, seq=128, em_min=0.75, f1_min=0.85)

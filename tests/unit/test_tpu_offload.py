@@ -19,7 +19,8 @@ HIDDEN = 256
 LAYERS = 2
 
 
-def _losses(cpu_offload, steps=4, chunk_mb=1):
+def _losses(cpu_offload, steps=4, chunk_mb=1, offload_gradients=False,
+            clip=0.0):
     import deepspeed_tpu as deepspeed
     from deepspeed_tpu.models import GPT2Config, GPT2LMHeadTPU
     from deepspeed_tpu.parallel import make_mesh
@@ -33,8 +34,11 @@ def _losses(cpu_offload, steps=4, chunk_mb=1):
         model=model, mesh=mesh,
         config={"train_batch_size": 4, "steps_per_print": 10 ** 9,
                 "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "gradient_clipping": clip,
                 "zero_optimization": {"stage": 2, "cpu_offload": cpu_offload,
-                                      "offload_chunk_mb": chunk_mb},
+                                      "offload_chunk_mb": chunk_mb,
+                                      "offload_gradients": (
+                                          offload_gradients and cpu_offload)},
                 "bf16": {"enabled": True}})
     rng = np.random.default_rng(0)
     batch = {"input_ids": rng.integers(0, 1024, size=(4, 128)).astype(np.int32)}
@@ -82,6 +86,25 @@ def test_streamed_offload_checkpoint_roundtrip(tmp_path, monkeypatch):
     l_ref = float(np.asarray(jax.device_get(
         engine.train_batch(iter([batch])))))
     np.testing.assert_allclose(l_resumed, l_ref, rtol=2e-4, atol=2e-4)
+
+
+def test_offload_gradients_matches_device_training(monkeypatch):
+    """offload_gradients (host-resident flat gradient + streamed read-back
+    with folded unscale/clip) is numerics-identical to device training at
+    the same clip setting, with grouping forced on so the reverse-order
+    chunked gradient write-out crosses group bounds."""
+    import deepspeed_tpu.runtime.zero.coordinator as coord
+
+    base, _ = _losses(cpu_offload=False, clip=1.0)
+    monkeypatch.setattr(coord, "HOST_GROUP_BYTES", 1 << 20)
+    streamed, engine = _losses(cpu_offload=True, chunk_mb=1,
+                               offload_gradients=True, clip=1.0)
+    assert engine._offload_grads
+    assert engine.state["hostgrad"] is not None
+    hg = engine.state["hostgrad"]
+    for g in (hg if type(hg) is tuple else (hg,)):
+        assert g.sharding.memory_kind == "pinned_host"
+    np.testing.assert_allclose(streamed, base, rtol=2e-4, atol=2e-4)
 
 
 def test_streamed_offload_grouped_with_chunking_disabled(monkeypatch):
