@@ -110,6 +110,16 @@ def chip_peak_tflops(device):
 def main():
     import jax
 
+    # Persistent compile cache: the big offload programs (gpt2-xl with
+    # host gradients compiles ~35 min on the tunneled toolchain) are
+    # byte-identical across runs — warm runs skip straight to execution.
+    try:
+        jax.config.update("jax_compilation_cache_dir", os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
     import deepspeed_tpu as deepspeed
     from deepspeed_tpu.models import BertConfig, BertForPreTrainingTPU
     from deepspeed_tpu.parallel import make_mesh
@@ -237,6 +247,17 @@ def main():
     # class).  GPT-2-medium 355M, seq 1024, the BASELINE #3 shape: ZeRO
     # stage 2 + Lamb + bf16 (degenerate but real at dp=1).  (Order A/B:
     # gpt2-first gains it 1.6% but costs seq512 4% — seq512 runs first.)
+    # Drop the finished rows' compiled executables before measuring: each
+    # earlier engine's programs pin HBM scratch that fragments the
+    # allocator (the measured ~6% in-bench vs sole-tenant gap); every
+    # remaining row compiles its own programs anyway.
+    try:
+        import jax
+
+        jax.clear_caches()
+    except Exception:
+        pass
+    gc.collect()
     for attempt in (1, 2):
         try:
             _measure_gpt2(record, deepspeed, mesh, rng, steps, warmup,
@@ -260,6 +281,13 @@ def main():
     # this chip trains at all — device-resident just fits, offload pays
     # the host-streaming tax (the capacity ladder with max-size search is
     # examples/bench_offload_capacity.py; too slow for the driver run).
+    try:
+        import jax
+
+        jax.clear_caches()
+    except Exception:
+        pass
+    gc.collect()
     for attempt in (1, 2):
         try:
             _measure_offload(record, deepspeed, mesh, rng)
@@ -268,6 +296,15 @@ def main():
         except Exception as e:  # pragma: no cover - depends on chip
             record["offload_exc"] = f"offload run failed (try {attempt}): {e!r:.300}"
             gc.collect()
+
+    # Senary: GPT-2-xl with offload_gradients — the capacity headline.
+    # Own guard (NO retry: its compile is the expensive part) so a
+    # failure cannot re-run or lose the gpt2-large row above.
+    try:
+        _measure_offload_xl(record, deepspeed, mesh, rng)
+        record.pop("offload_xl_exc", None)
+    except Exception as e:  # pragma: no cover - depends on chip
+        record["offload_xl_exc"] = f"xl run failed: {e!r:.300}"
 
     print(json.dumps(record))
 
@@ -307,6 +344,54 @@ def _measure_offload(record, deepspeed, mesh, rng):
         record["offload_gpt2_large_params_b"] = 0.77
     else:
         record["offload_error"] = f"non-finite loss {v}"
+    del engine, model
+
+
+def _measure_offload_xl(record, deepspeed, mesh, rng):
+    """GPT-2-xl (1.56B): beyond anything the chip can hold
+    device-resident (1.5B fp32 grads alone would be 6 GB + 3 GB bf16
+    params).  Runs the full capacity configuration: host
+    master/optimizer AND host gradients (offload_gradients), host-side
+    init.  Separate from the gpt2-large leg so a failure here cannot
+    re-run (or lose) that row; BENCH_OFFLOAD_XL=0 skips.  First-ever
+    compile of this program is ~35 min on the tunneled toolchain — the
+    persistent compile cache (.jax_cache, warmed by any prior run of
+    this script at the same code state) makes later runs execute-only."""
+    if os.environ.get("BENCH_OFFLOAD_XL", "1") == "0":
+        return
+    import jax
+
+    from deepspeed_tpu.models import GPT2Config, GPT2LMHeadTPU
+
+    steps = int(os.environ.get("BENCH_OFFLOAD_STEPS", "5"))
+    cfg = GPT2Config(hidden_size=1600, num_layers=48, num_heads=25,
+                     max_position_embeddings=1024, embd_dropout=0.0,
+                     attn_dropout=0.0, resid_dropout=0.0, remat=True,
+                     loss_chunk=256)
+    model = GPT2LMHeadTPU(cfg)
+    engine, *_ = deepspeed.initialize(
+        model=model, mesh=mesh,
+        config={"train_batch_size": 4, "steps_per_print": 10 ** 9,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+                "zero_optimization": {"stage": 2, "cpu_offload": True,
+                                      "offload_gradients": True},
+                "bf16": {"enabled": True}})
+    batch = {"input_ids": rng.integers(
+        0, cfg.vocab_size, size=(4, 1024)).astype(np.int32)}
+    for _ in range(2):
+        loss = engine.train_batch(iter([batch]))
+    v = float(jax.device_get(loss))
+    t0 = time.perf_counter()
+    xl_steps = max(steps - 2, 3)
+    for _ in range(xl_steps):
+        loss = engine.train_batch(iter([batch]))
+    v = float(jax.device_get(loss))
+    dt = (time.perf_counter() - t0) / xl_steps
+    if math.isfinite(v):
+        record["offload_gpt2_xl_ms_per_step"] = round(dt * 1e3, 0)
+        record["offload_gpt2_xl_params_b"] = 1.56
+    else:
+        record["offload_xl_error"] = f"non-finite loss {v}"
     del engine, model
 
 

@@ -477,8 +477,19 @@ def _dropout_ops(dropout_rate, dropout_seed):
             float(dropout_rate))
 
 
-def _resolve_blocks(s, kv_len, d, block_q, block_k, causal=False):
+def _resolve_blocks(s, kv_len, d, block_q, block_k, causal=False,
+                    dropout_rate=0.0):
     auto_q, auto_k = _auto_blocks(s, kv_len, d, causal)
+    if block_q is None and block_k is None:
+        # runtime autotune (reference analog: the GEMM algorithm search
+        # baked into kernel setup, csrc/includes/gemm_test.h): shapes the
+        # hand calibration covers keep the measured heuristic choice;
+        # anything else gets a cached first-use micro-search.  tune()
+        # calls back into flash_attention with EXPLICIT blocks, so the
+        # recursion terminates here.
+        from .kernel_tuner import tune
+        auto_q, auto_k = tune(s, kv_len, d, causal, dropout_rate,
+                              flash_attention, (auto_q, auto_k))
     block_q = block_q or auto_q
     block_k = block_k or auto_k
     # The kernels index K/V in whole blocks; a ragged tail would silently
@@ -545,7 +556,8 @@ def _flash_fwd(q, k, v, kv_mask, dropout_seed, causal, block_q, block_k,
         return out, (q, k, v, kv_mask, dropout_seed, out, lse)
     b, s, h, d = q.shape
     kv_len = k.shape[1]
-    block_q, block_k = _resolve_blocks(s, kv_len, d, block_q, block_k, causal)
+    block_q, block_k = _resolve_blocks(s, kv_len, d, block_q, block_k, causal,
+                                       dropout_rate)
     masked = kv_mask is not None
     scale = 1.0 / math.sqrt(d)
     qf, kf, vf = _flatten_heads(q), _flatten_heads(k), _flatten_heads(v)
@@ -613,7 +625,8 @@ def _flash_bwd_rule(causal, block_q, block_k, interpret, dropout_rate, res, g):
                 None)
     b, s, h, d = q.shape
     kv_len = k.shape[1]
-    block_q, block_k = _resolve_blocks(s, kv_len, d, block_q, block_k, causal)
+    block_q, block_k = _resolve_blocks(s, kv_len, d, block_q, block_k, causal,
+                                       dropout_rate)
     masked = kv_mask is not None
     scale = 1.0 / math.sqrt(d)
     bh = b * h

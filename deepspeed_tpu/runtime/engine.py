@@ -770,92 +770,6 @@ class DeepSpeedEngine:
             # NODES, not row-group containers
             return type(x) is tuple
 
-        def _stream_one_group(master_g, st_g, g_g, hp, overflow, token,
-                              coef=None, g_on_host=False, cast_chunks=None):
-            """Stream one host buffer's (p, m, v) through the device chunk
-            by chunk.  ``g_g`` is this group's slice of the device-resident
-            unscaled gradient; ``overflow`` gates an fp16 no-op step per
-            chunk (the pick the unchunked path applies whole-buffer).
-
-            Results write back into the (donated) input host buffers via
-            ``dynamic_update_slice`` — concatenating fresh output parts
-            defeats XLA's donation aliasing in host space, doubling the
-            program's host footprint past the attachment's pool (measured:
-            5x3.76 GB in+out fails with concat outputs, 8x passes with DUS
-            write-back — examples/exp_host_stream.py)."""
-            opt_leaves, opt_def = jax.tree_util.tree_flatten(st_g)
-            is_flat = [getattr(l, "ndim", 0) == 2 for l in opt_leaves]
-            scalar_out = [None] * len(opt_leaves)
-            # depth-2 chunk pipeline: chunk k's host loads gate on chunk
-            # k-2's UPDATE token, so chunk k+1's host→device transfer
-            # overlaps chunk k's update compute and write-back (the
-            # reference hides CPU-Adam latency behind streams the same
-            # way, csrc/adam/cpu_adam.cpp:60-66).  Peak HBM = two chunks
-            # of (p, m, v[, g]) instead of one; the fully serial chain
-            # (round 4) left the device idle during every transfer.
-            # Measured (gpt2-large, 0.77B): slicing the ORIGINAL buffer
-            # values (disjoint rows, SSA-clean) to decouple load k from
-            # write k-1 REGRESSED 1.62 → 2.23 s/step — it defeats XLA's
-            # in-place donation aliasing on the host buffers, and the
-            # induced host copies cost more than the overlap gains.  So
-            # chunks slice the rebound post-DUS values (aliasing-
-            # friendly); the depth-2 token still lets the h2d DMA of
-            # chunk k+1's data issue while chunk k's update computes.
-            tok2 = tok1 = token
-            for r0, rc in _chunks(master_g.shape[0]):
-                slices = [jax.lax.slice_in_dim(master_g, r0, r0 + rc)] + [
-                    jax.lax.slice_in_dim(l, r0, r0 + rc)
-                    for l, f in zip(opt_leaves, is_flat) if f]
-                if g_on_host:
-                    # offload_gradients: the gradient chunk loads from the
-                    # pinned-host flat buffer alongside (p, m, v);
-                    # unscale/clip fold into one per-chunk multiply
-                    slices.append(jax.lax.slice_in_dim(g_g, r0, r0 + rc))
-                host_slices = _after(tok2, slices)
-                pm = jax.device_put(host_slices[0], dev_sharding)
-                it = iter(host_slices[1:])
-                chunk_leaves = [
-                    jax.device_put(next(it), dev_sharding) if f else l
-                    for l, f in zip(opt_leaves, is_flat)]
-                st = jax.tree_util.tree_unflatten(opt_def, chunk_leaves)
-                if g_on_host:
-                    gc = jax.device_put(host_slices[-1],
-                                        dev_sharding) * coef
-                else:
-                    gc = jax.lax.slice_in_dim(g_g, r0, r0 + rc)
-                new_p, new_st = optimizer.update(st, pm, gc, hp)
-                tok2, tok1 = tok1, new_p[0, 0]
-                token = tok1
-                if fp16:
-                    new_p = jnp.where(overflow, pm, new_p)
-                if cast_chunks is not None:
-                    # fold the compute-dtype param cast into the update:
-                    # the new-param chunk is already on device, so the
-                    # post-update streamed cast's re-download of the whole
-                    # master (4 bytes/param of host→device traffic, a
-                    # fully serial phase) disappears
-                    cast_chunks.append(new_p.astype(self.compute_dtype))
-                master_g = jax.lax.dynamic_update_slice(
-                    master_g, jax.device_put(new_p, host_big), (r0, 0))
-                for idx, (old_c, new_l) in enumerate(zip(
-                        chunk_leaves, jax.tree_util.tree_leaves(new_st))):
-                    if is_flat[idx]:
-                        if fp16:
-                            new_l = jnp.where(overflow, old_c, new_l)
-                        opt_leaves[idx] = jax.lax.dynamic_update_slice(
-                            opt_leaves[idx],
-                            jax.device_put(new_l, host_big), (r0, 0))
-                    elif scalar_out[idx] is None:
-                        # non-flat state (the step counter): identical per
-                        # chunk; fp16 pick applies as in the full path
-                        scalar_out[idx] = (jnp.where(overflow,
-                                                     opt_leaves[idx], new_l)
-                                           if fp16 else new_l)
-            new_leaves = [opt_leaves[i] if is_flat[i] else scalar_out[i]
-                          for i in range(len(opt_leaves))]
-            return (master_g,
-                    jax.tree_util.tree_unflatten(opt_def, new_leaves), token)
-
         def carve_leaves(chunk_list):
             """In-order device chunks tiling the flat rows → params pytree
             in compute dtype (leaves carved with ordinary device slices;
@@ -890,40 +804,120 @@ class DeepSpeedEngine:
         def chunked_offload_update(master, opt_state, g, hp, overflow,
                                    coef=None, g_on_host=False,
                                    want_cast=False):
-            """Group loop around :func:`_stream_one_group`: grouped state
-            (master/opt as tuples of ≤HOST_GROUP_BYTES host buffers) streams
-            group by group; ungrouped state is a single group.  Under
-            ``offload_gradients`` ``g`` is the pinned-host flat gradient
-            (grouped like the master) and ``coef`` folds unscale+clip.
-            ``want_cast`` collects the updated chunks cast to the compute
-            dtype (in row order) so the caller can assemble the new params
+            """Chunk-streamed offloaded update, ROUND-ROBIN over host
+            groups.
+
+            Each chunk's (p, m, v[, g]) slices load from pinned host,
+            update on device, and write back in place via
+            ``dynamic_update_slice`` (concatenated fresh outputs defeat
+            host donation aliasing — examples/exp_host_stream.py).
+            Within one group the SSA chain serializes chunk k's loads
+            behind chunk k-1's write-back — that preserves in-place
+            aliasing (reading the ORIGINAL buffer instead measured
+            1.62 → 2.23 s/step from the induced host copies) but leaves
+            the wire idle during compute.  Round-robin interleaving
+            restores the overlap WITHOUT breaking aliasing: group A's
+            chunk k+1 only depends on A's chunk k, so its host→device
+            DMA streams while group B's chunk updates and writes back,
+            and the ``_after`` token (gating loads on the update two
+            jobs back) bounds in-flight chunks at two.
+
+            ``coef`` folds unscale+clip for host-resident gradients
+            (``g_on_host``); ``want_cast`` collects updated chunks cast
+            to the compute dtype so the caller assembles new params
             without re-reading the master from host."""
-            masters = master if type(master) is tuple else (master,)
+            masters = list(master) if type(master) is tuple else [master]
             gb = groups or ((0, segments.rows),)
-            token = jnp.float32(0.0)
-            new_masters, new_sts = [], []
-            cast_list = ([] if (want_cast and self.compute_dtype)
-                         else None)
-            for gi, (gr0, grc) in enumerate(gb):
+            n_g = len(gb)
+
+            opt_defs = None
+            group_leaves, is_flat = [], None
+            for gi in range(n_g):
                 st_g = jax.tree_util.tree_map(
                     lambda l: l[gi] if type(l) is tuple else l,
                     opt_state, is_leaf=_is_grp)
+                leaves, opt_defs = jax.tree_util.tree_flatten(st_g)
+                group_leaves.append(leaves)
+                if is_flat is None:
+                    is_flat = [getattr(l, "ndim", 0) == 2 for l in leaves]
+            scalar_out = [None] * len(is_flat)
+
+            per_group = [_chunks(grc) for _, grc in gb]
+            jobs, idx = [], [0] * n_g
+            while any(idx[gi] < len(per_group[gi]) for gi in range(n_g)):
+                for gi in range(n_g):
+                    if idx[gi] < len(per_group[gi]):
+                        jobs.append((gi,) + tuple(per_group[gi][idx[gi]]))
+                        idx[gi] += 1
+
+            cast_parts = {} if (want_cast and self.compute_dtype) else None
+            tok2 = tok1 = jnp.float32(0.0)
+            for jn, (gi, r0, rc) in enumerate(jobs):
+                gr0, _ = gb[gi]
+                master_g = masters[gi]
+                leaves = group_leaves[gi]
+                slices = [jax.lax.slice_in_dim(master_g, r0, r0 + rc)] + [
+                    jax.lax.slice_in_dim(l, r0, r0 + rc)
+                    for l, f in zip(leaves, is_flat) if f]
                 if g_on_host:
                     g_g = g[gi] if type(g) is tuple else g
+                    slices.append(jax.lax.slice_in_dim(g_g, r0, r0 + rc))
+                host_slices = _after(tok2, slices)
+                pm = jax.device_put(host_slices[0], dev_sharding)
+                it = iter(host_slices[1:])
+                chunk_leaves = [
+                    jax.device_put(next(it), dev_sharding) if f else l
+                    for l, f in zip(leaves, is_flat)]
+                st = jax.tree_util.tree_unflatten(opt_defs, chunk_leaves)
+                if g_on_host:
+                    gc_ = jax.device_put(host_slices[-1],
+                                         dev_sharding) * coef
                 else:
-                    g_g = jax.lax.slice_in_dim(g, gr0, gr0 + grc)
-                nm, nst, token = _stream_one_group(
-                    masters[gi], st_g, g_g, hp, overflow, token,
-                    coef=coef, g_on_host=g_on_host, cast_chunks=cast_list)
-                new_masters.append(nm)
-                new_sts.append(nst)
+                    gc_ = jax.lax.slice_in_dim(g, gr0 + r0, gr0 + r0 + rc)
+                new_p, new_st = optimizer.update(st, pm, gc_, hp)
+                tok2, tok1 = tok1, new_p[0, 0]
+                if fp16:
+                    new_p = jnp.where(overflow, pm, new_p)
+                if cast_parts is not None:
+                    # fold the compute-dtype param cast into the update:
+                    # the new-param chunk is already on device, so the
+                    # post-update streamed cast's re-download of the
+                    # whole master disappears
+                    cast_parts[(gi, r0)] = new_p.astype(self.compute_dtype)
+                masters[gi] = jax.lax.dynamic_update_slice(
+                    master_g, jax.device_put(new_p, host_big), (r0, 0))
+                for li, (old_c, new_l) in enumerate(zip(
+                        chunk_leaves, jax.tree_util.tree_leaves(new_st))):
+                    if is_flat[li]:
+                        if fp16:
+                            new_l = jnp.where(overflow, old_c, new_l)
+                        leaves[li] = jax.lax.dynamic_update_slice(
+                            leaves[li], jax.device_put(new_l, host_big),
+                            (r0, 0))
+                    elif scalar_out[li] is None:
+                        # non-flat state (the step counter): identical per
+                        # chunk; fp16 pick applies as in the full path
+                        scalar_out[li] = (jnp.where(overflow, leaves[li],
+                                                    new_l)
+                                          if fp16 else new_l)
+
+            cast_list = None
+            if cast_parts is not None:
+                cast_list = [cast_parts[k] for k in sorted(cast_parts)]
+            new_sts = []
+            for gi in range(n_g):
+                out_leaves = [group_leaves[gi][li] if is_flat[li]
+                              else scalar_out[li]
+                              for li in range(len(is_flat))]
+                new_sts.append(jax.tree_util.tree_unflatten(opt_defs,
+                                                            out_leaves))
             if groups is None:
-                return new_masters[0], new_sts[0], cast_list
+                return masters[0], new_sts[0], cast_list
             new_opt = jax.tree_util.tree_map(
                 lambda orig, *gs: tuple(gs) if type(orig) is tuple
                 else gs[0],
                 opt_state, *new_sts, is_leaf=_is_grp)
-            return tuple(new_masters), new_opt, cast_list
+            return tuple(masters), new_opt, cast_list
 
         host_grad_big = self.flat.grad_host_sharding
         offload_grads_mode = self._offload_grads and offload_stream

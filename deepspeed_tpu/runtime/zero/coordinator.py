@@ -47,7 +47,35 @@ from ...ops.op_common import LANES, build_segments
 # state larger than this is therefore stored as row GROUPS — a tuple of
 # host buffers, each at most HOST_GROUP_BYTES — and the engine streams
 # each group through the device in chunks.
-HOST_GROUP_BYTES = 3584 << 20
+#
+# The limit is 1.75 GB rather than the 3.5 GB the SIGABRT bound allows:
+# the engine's round-robin chunk pipeline overlaps host↔device transfer
+# with update compute ACROSS groups (within a group the in-place DUS
+# write-back chain serializes chunks — see chunked_offload_update), so
+# any state big enough to stream should split into at least two groups.
+HOST_GROUP_BYTES = 1792 << 20
+
+
+def split_rows_balanced(total_rows, rows_per, align):
+    """Near-equal contiguous (start, count) groups, each at most
+    ~``rows_per`` rows and aligned to ``align``.
+
+    Used for the host GROUP layout (not chunks): the engine's round-robin
+    chunk pipeline overlaps host↔device transfer with update compute only
+    ACROSS groups, so a greedy split's tiny tail group (e.g. 1.75 GB +
+    0.05 GB) would leave ~97% of the work in one group running fully
+    serial.  Near-equal groups keep the interleave balanced."""
+    if not rows_per or total_rows <= rows_per:
+        return ((0, total_rows),)
+    n_g = -(-total_rows // rows_per)
+    base = -(-total_rows // n_g)
+    base = -(-base // align) * align
+    out, r = [], 0
+    while r < total_rows:
+        rc = min(base, total_rows - r)
+        out.append((r, rc))
+        r += rc
+    return tuple(out)
 
 
 def split_rows(total_rows, rows_per):
@@ -118,8 +146,8 @@ class FlatParamCoordinator:
         if cpu_offload and self.injit_placement:
             rows_per = max(1, HOST_GROUP_BYTES // (LANES * 4))
             if self.segments.rows > rows_per:
-                self.host_group_bounds = split_rows(self.segments.rows,
-                                                    rows_per)
+                self.host_group_bounds = split_rows_balanced(
+                    self.segments.rows, rows_per, pad_to)
         # host-resident flat gradient buffer (offload_gradients): same
         # (rows, LANES) fp32 layout and grouping as the master
         self.grad_host_sharding = (
