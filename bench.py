@@ -135,6 +135,22 @@ def main():
     dev = jax.devices()[0]
     mesh = make_mesh({"data": 1}, devices=[dev])
 
+    # The block-sparse kernel row runs FIRST, sole-tenant: its ms-scale
+    # kernel timings are the most co-residency-sensitive measurement in
+    # the bench (measured 2.38x with the engines' executables resident vs
+    # 3.09x clean — allocator pressure inflates both dense and sparse,
+    # sparse more).  Engine rows keep the conservative co-resident
+    # methodology.
+    sparse_record = {}
+    try:
+        _measure_sparse_attention(sparse_record)
+    except Exception as e:  # pragma: no cover - depends on chip
+        sparse_record["sparse_attn_exc"] = f"sparse run failed: {e!r:.300}"
+    try:
+        jax.clear_caches()
+    except Exception:
+        pass
+
     config = {
         "train_batch_size": batch,
         "steps_per_print": 10 ** 9,
@@ -270,11 +286,9 @@ def main():
 
     # Quaternary: block-sparse attention kernel vs dense flash at seq 16k
     # (the reference's sparse-attention SPEED claim, measured on-chip
-    # every round instead of living in PERF.md prose).
-    try:
-        _measure_sparse_attention(record)
-    except Exception as e:  # pragma: no cover - depends on chip
-        record["sparse_attn_exc"] = f"sparse run failed: {e!r:.300}"
+    # every round instead of living in PERF.md prose).  Measured FIRST
+    # in main(), sole-tenant (see the note there); merged here.
+    record.update(sparse_record)
 
     # Quinary: ZeRO-Offload step-time tax (the reference's ZeRO-Offload
     # capability, ZeRO-Offload.md:10).  GPT-2-large: the LARGEST config
@@ -298,13 +312,17 @@ def main():
             gc.collect()
 
     # Senary: GPT-2-xl with offload_gradients — the capacity headline.
-    # Own guard (NO retry: its compile is the expensive part) so a
-    # failure cannot re-run or lose the gpt2-large row above.
-    try:
-        _measure_offload_xl(record, deepspeed, mesh, rng)
-        record.pop("offload_xl_exc", None)
-    except Exception as e:  # pragma: no cover - depends on chip
-        record["offload_xl_exc"] = f"xl run failed: {e!r:.300}"
+    # Own guard (so a failure cannot re-run or lose the gpt2-large row
+    # above) with one retry: the remote compile service sporadically
+    # 500s, and the persistent cache makes the retry cheap.
+    for attempt in (1, 2):
+        try:
+            _measure_offload_xl(record, deepspeed, mesh, rng)
+            record.pop("offload_xl_exc", None)
+            break
+        except Exception as e:  # pragma: no cover - depends on chip
+            record["offload_xl_exc"] = f"xl run failed (try {attempt}): {e!r:.300}"
+            gc.collect()
 
     print(json.dumps(record))
 
@@ -353,11 +371,15 @@ def _measure_offload_xl(record, deepspeed, mesh, rng):
     params).  Runs the full capacity configuration: host
     master/optimizer AND host gradients (offload_gradients), host-side
     init.  Separate from the gpt2-large leg so a failure here cannot
-    re-run (or lose) that row; BENCH_OFFLOAD_XL=0 skips.  First-ever
-    compile of this program is ~35 min on the tunneled toolchain — the
-    persistent compile cache (.jax_cache, warmed by any prior run of
-    this script at the same code state) makes later runs execute-only."""
-    if os.environ.get("BENCH_OFFLOAD_XL", "1") == "0":
+    re-run (or lose) that row.  OPT-IN (BENCH_OFFLOAD_XL=1): first-ever
+    compile of this program is ~35 min on the tunneled toolchain, which
+    would risk the whole driver run; the measured capacity receipts live
+    in PERF.md ("ZeRO-Offload capacity", 1.56B at 5.16 s/step via
+    examples/bench_offload_capacity.py + the probe scripts)."""
+    if os.environ.get("BENCH_OFFLOAD_XL", "0") != "1":
+        record["offload_xl_note"] = (
+            "opt-in (BENCH_OFFLOAD_XL=1): ~35 min first compile; measured "
+            "1.56B capacity receipts in PERF.md ZeRO-Offload section")
         return
     import jax
 
@@ -374,7 +396,13 @@ def _measure_offload_xl(record, deepspeed, mesh, rng):
         config={"train_batch_size": 4, "steps_per_print": 10 ** 9,
                 "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
                 "zero_optimization": {"stage": 2, "cpu_offload": True,
-                                      "offload_gradients": True},
+                                      "offload_gradients": True,
+                                      # fewer, bigger host buffers: the
+                                      # remote AOT compile helper crashes
+                                      # on the 16-buffer form of this
+                                      # program (measured; ladder receipt
+                                      # compiles at 3584)
+                                      "offload_group_mb": 3584},
                 "bf16": {"enabled": True}})
     batch = {"input_ids": rng.integers(
         0, cfg.vocab_size, size=(4, 1024)).astype(np.int32)}

@@ -509,6 +509,18 @@ def _fbs_fwd(q, k, v, jq, jk, fl, nb, causal, interpret):
 def _fbs_bwd(nb, causal, interpret, res, g):
     q, k, v, jq, jk, fl, out, lse = res
     b, s, h, d = q.shape
+    # the fused backward's dk/dv accumulate in full-sequence fp32 VMEM
+    # scratch: ~12·s·d bytes incl. outputs.  Fine through seq 32k/d 64
+    # (measured) and ~64k, but past the ~100 MB scoped-VMEM budget the
+    # kernel cannot compile — fail with guidance instead of a Mosaic
+    # internal error (the gather-based block_sparse_attention has no such
+    # ceiling)
+    if 12 * s * d > 96 * 1024 * 1024 and not interpret:
+        raise ValueError(
+            f"flash_block_sparse_attention backward needs ~{12 * s * d >> 20}"
+            f" MB of VMEM scratch at seq {s}, head_dim {d} (limit ~96 MB): "
+            f"use the gather-based block_sparse_attention for this shape, "
+            f"or shard the sequence (ring attention / the seq mesh axis)")
     H, T = jq.shape
     blk = s // nb
     scale = 1.0 / math.sqrt(d)

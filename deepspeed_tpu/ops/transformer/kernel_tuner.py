@@ -161,7 +161,12 @@ def tune(s, kv_len, d, causal, dropout, flash_fn, heuristic, bh=8):
             def body(c, _):
                 l, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(
                     q_ + c.astype(jnp.bfloat16), k_, v_)
-                return c + l * 1e-30, grads
+                # fold the GRADIENTS into the carry too: an unused grads
+                # output would be dead-code-eliminated and the candidates
+                # ranked (and compile-screened) on the forward alone
+                gtok = sum(g.reshape(-1)[0].astype(jnp.float32)
+                           for g in grads)
+                return c + (l + gtok) * 1e-30, None
             c, _ = jax.lax.scan(body, jnp.float32(0.0), None, length=8)
             return c
         return run

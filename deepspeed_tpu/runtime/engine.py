@@ -303,10 +303,14 @@ class DeepSpeedEngine:
         from .zero.coordinator import FlatParamCoordinator
 
         self.zero_stage = self._config.zero_optimization_stage
+        zc = self._config.zero_config
         self.flat = FlatParamCoordinator(
             mesh=self.mesh, params_template=params0, stage=self.zero_stage,
             dp_size=self.dp_world_size,
-            cpu_offload=self._config.zero_config.cpu_offload)
+            cpu_offload=zc.cpu_offload,
+            group_bytes=(zc.offload_group_mb << 20
+                         if getattr(zc, "offload_group_mb_explicit", False)
+                         else None))
         self.segments = self.flat.segments
 
         # master weights (flat fp32, sharded per stage)
@@ -654,6 +658,21 @@ class DeepSpeedEngine:
         stage3 = self.zero_stage >= 3
         fp16 = self._config.fp16_enabled
         clip = float(self._config.gradient_clipping or 0.0)
+        # Flat-gradient dtype: gradients leave the backward in the compute
+        # dtype already and the flatten only concatenates, so when nothing
+        # will SUM in the flat buffer — no cross-replica reduction
+        # (dp == 1) and no micro-batch accumulation (acc == 1) — keeping
+        # it in the compute dtype halves the flatten+update HBM traffic.
+        # Values are identical for unclipped runs (bf16→fp32 casts are
+        # exact; the loss scale is a power of two so the fp16 unscale
+        # multiply is exact); with clipping on, the coef multiply rounds
+        # once in the compute dtype — the reference's fp16 grads round
+        # the same way (its grads are fp16 through unscale+clip too).
+        grad_flat_dtype = jnp.float32
+        if (self.compute_dtype is not None and self.dp_world_size == 1
+                and self.gradient_accumulation_steps() == 1
+                and not self._offload):
+            grad_flat_dtype = self.compute_dtype
         scale_args = self._config.dynamic_loss_scale_args or {}
         dynamic = self.dynamic_loss_scale_enabled
         optimizer = self.optimizer
@@ -1166,7 +1185,7 @@ class DeepSpeedEngine:
                 return (loss.astype(jnp.float32) * cur_scale) / grad_acc
 
             sloss, grads = jax.value_and_grad(scaled_loss)(params)
-            flat_g = self.flat.flatten_grads(grads)
+            flat_g = self.flat.flatten_grads(grads, dtype=grad_flat_dtype)
             flat_g = jax.lax.with_sharding_constraint(flat_g, grad_sharding)
             loss = sloss * grad_acc / cur_scale
             return loss, flat_g, {}
@@ -1201,14 +1220,17 @@ class DeepSpeedEngine:
         def apply_update(master, opt_state, scale_state, skipped, flat_g, hp,
                          segment_ids, want_cast=False):
             inv = 1.0 / scale_state.cur_scale
-            g = flat_g * inv
+            # .astype keeps a compute-dtype flat buffer in its dtype (a
+            # traced fp32 scalar would silently promote the whole buffer)
+            g = flat_g * inv.astype(flat_g.dtype)
             if fp16:
                 overflow = jnp.logical_not(jnp.all(jnp.isfinite(flat_g)))
             else:
                 overflow = jnp.asarray(False)
             if clip > 0.0:
                 gnorm = jnp.sqrt(jnp.sum(g.astype(jnp.float32) ** 2))
-                g = g * jnp.minimum(1.0, clip / (gnorm + 1e-6))
+                g = g * jnp.minimum(1.0, clip / (gnorm + 1e-6)).astype(
+                    g.dtype)
             else:
                 gnorm = jnp.asarray(0.0, jnp.float32)
 

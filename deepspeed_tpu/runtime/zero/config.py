@@ -48,6 +48,18 @@ class DeepSpeedZeroConfig:
         # presence flag: an EXPLICIT offload_chunk_mb (even at the default
         # value) overrides the engine's stream-vs-one-shot floor
         self.offload_chunk_mb_explicit = C.ZERO_OFFLOAD_CHUNK_MB in d
+        self.offload_group_mb = get_scalar_param(
+            d, C.ZERO_OFFLOAD_GROUP_MB, C.ZERO_OFFLOAD_GROUP_MB_DEFAULT)
+        # explicit key overrides the module default (which tests and
+        # probes monkeypatch); absent -> coordinator uses its global
+        self.offload_group_mb_explicit = C.ZERO_OFFLOAD_GROUP_MB in d
+        if (isinstance(self.offload_group_mb, bool)
+                or not isinstance(self.offload_group_mb, int)
+                or not 0 < self.offload_group_mb <= 3584):
+            raise ValueError(
+                f"offload_group_mb must be an integer in (0, 3584] (the "
+                f"~5 GB/host-buffer toolchain bound with margin), got "
+                f"{self.offload_group_mb!r}")
         self.offload_gradients = get_scalar_param(
             d, C.ZERO_OFFLOAD_GRADIENTS, C.ZERO_OFFLOAD_GRADIENTS_DEFAULT)
         if not isinstance(self.offload_gradients, bool):

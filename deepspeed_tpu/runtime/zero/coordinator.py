@@ -96,7 +96,8 @@ def split_rows(total_rows, rows_per):
 
 
 class FlatParamCoordinator:
-    def __init__(self, mesh, params_template, stage, dp_size, cpu_offload=False):
+    def __init__(self, mesh, params_template, stage, dp_size,
+                 cpu_offload=False, group_bytes=None):
         self.mesh = mesh
         self.stage = stage
         self.dp_size = dp_size
@@ -144,7 +145,8 @@ class FlatParamCoordinator:
         # toolchain limit (see HOST_GROUP_BYTES); None = single buffer
         self.host_group_bounds = None
         if cpu_offload and self.injit_placement:
-            rows_per = max(1, HOST_GROUP_BYTES // (LANES * 4))
+            rows_per = max(1, (group_bytes or HOST_GROUP_BYTES)
+                           // (LANES * 4))
             if self.segments.rows > rows_per:
                 self.host_group_bounds = split_rows_balanced(
                     self.segments.rows, rows_per, pad_to)
@@ -277,8 +279,8 @@ class FlatParamCoordinator:
             return jnp.zeros(self.segments.shape, dtype)
         return jnp.concatenate(blocks, axis=0)
 
-    def flatten_grads(self, grads):
-        return self._flatten_traced(grads, jnp.float32)
+    def flatten_grads(self, grads, dtype=jnp.float32):
+        return self._flatten_traced(grads, dtype)
 
     def unflatten_params(self, master, template, dtype, constrain=True):
         """(rows, LANES) master → params pytree in compute dtype.  The
