@@ -1,0 +1,360 @@
+"""CheckpointManager: async commits, retention, retry, preemption drain.
+
+One manager per engine.  ``save()`` takes an already-captured
+:class:`~deepspeed_tpu.checkpoint.snapshot.CheckpointSnapshot` and either
+commits it inline (sync) or on a background thread (async) so
+``train_batch`` resumes immediately after the host gather.  Commits to the
+same directory serialize on a per-directory lock, and every in-flight
+async save is tracked in a module-level registry so loaders (including a
+different engine in the same process) can :func:`drain_inflight` before
+resolving ``latest``.
+
+Writer threads are non-daemon on purpose: a normal interpreter exit waits
+for the last commit instead of tearing a checkpoint.
+"""
+
+import os
+import shutil
+import signal
+import threading
+import time
+import weakref
+
+from ..utils.logging import log_dist, logger
+from . import writer
+from .constants import META_JSON, OLD_SUFFIX, TMP_SUFFIX
+
+# RLocks throughout: the preemption handler runs ON the main thread and
+# may interrupt a sync commit that already holds the dir/registry lock —
+# a plain Lock would deadlock the final save
+_REGISTRY_LOCK = threading.RLock()
+_INFLIGHT = {}    # realpath(save_dir) -> [Thread, ...]
+_DIR_LOCKS = {}   # realpath(save_dir) -> RLock (commit serialization)
+# module-global like the locks: the monotonic-`latest` guard must hold
+# across every manager/engine in the process writing the same dir
+_COMMITTED_STEPS = {}   # realpath(save_dir) -> newest committed step
+
+# monotonic deadline set while the preemption handler runs: commits must
+# not block indefinitely on a dir lock a hung writer thread still holds
+_PREEMPT_DEADLINE = None
+
+
+def _dir_key(save_dir):
+    return os.path.realpath(str(save_dir))
+
+
+def _dir_lock(save_dir):
+    with _REGISTRY_LOCK:
+        return _DIR_LOCKS.setdefault(_dir_key(save_dir), threading.RLock())
+
+
+# preemption-handler state: one OS-level handler per process; callbacks
+# are weakrefs for bound methods (dead engines drop out) or thunks for
+# plain functions
+_PREEMPT_CALLBACKS = []   # [ref()] -> final_save_fn or None when dead
+_PREEMPT_PREVIOUS = {}    # signum -> disposition we replaced
+
+
+def _preemption_handler(signum, frame):
+    global _PREEMPT_DEADLINE
+    logger.warning(f"signal {signum}: draining checkpoint writes and "
+                   "taking a final synchronous checkpoint")
+    _PREEMPT_CALLBACKS[:] = [r for r in _PREEMPT_CALLBACKS
+                             if r() is not None]
+    # bounded drain: a writer queued on a dir RLock the interrupted main
+    # thread owns can never finish while we join it — time-box to a slice
+    # of the launcher's kill grace and let the final save (which CAN
+    # re-enter that RLock) use the rest
+    grace = float(os.environ.get("DS_TERM_GRACE_SECS", "30"))
+    try:
+        if not drain_inflight(timeout=grace / 3):
+            logger.warning("preemption drain timed out; proceeding to the "
+                           "final synchronous checkpoint")
+    except Exception as e:  # noqa: BLE001 — dying anyway; say why
+        logger.error(f"preemption drain failed: {e}")
+    # a writer that survived the drain may still HOLD a dir lock (stuck
+    # storage); bound the final save's lock acquire so it skips with an
+    # error instead of pinning the process until the launcher's SIGKILL
+    _PREEMPT_DEADLINE = time.monotonic() + grace / 2
+    try:
+        for ref in reversed(_PREEMPT_CALLBACKS):  # newest engine first
+            fn = ref()
+            if fn is None:
+                continue
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001 — dying anyway; say why
+                logger.error(f"preemption checkpoint failed: {e}")
+    finally:
+        _PREEMPT_DEADLINE = None
+    prev = _PREEMPT_PREVIOUS.get(signum)
+    if callable(prev):
+        prev(signum, frame)
+    else:
+        # SIG_DFL/SIG_IGN, or None (installed outside python): restore
+        # and re-deliver so shutdown proceeds under that disposition
+        signal.signal(signum, signal.SIG_DFL if prev is None else prev)
+        signal.raise_signal(signum)
+
+
+def drain_inflight(save_dir=None, timeout=None):
+    """Join pending async saves (for ``save_dir``, or all).  Returns True
+    if everything drained within ``timeout``."""
+    with _REGISTRY_LOCK:
+        if save_dir is None:
+            threads = [t for ts in _INFLIGHT.values() for t in ts]
+        else:
+            threads = list(_INFLIGHT.get(_dir_key(save_dir), ()))
+    deadline = None if timeout is None else time.monotonic() + timeout
+    for t in threads:
+        t.join(None if deadline is None
+               else max(0.0, deadline - time.monotonic()))
+        if t.is_alive():
+            return False
+    return True
+
+
+class CheckpointManager:
+    """Owns the write side of the checkpoint subsystem for one engine."""
+
+    def __init__(self, config=None):
+        from .config import DeepSpeedCheckpointConfig
+
+        self.config = config or DeepSpeedCheckpointConfig({})
+        self.last_error = None            # last failed commit's exception
+        self._errors = {}                 # dir key -> last failed commit
+
+    # ------------------------------------------------------------- save
+    def save(self, snapshot, save_dir, async_save=None):
+        """Commit ``snapshot`` under ``save_dir``; returns True if the
+        commit succeeded (async saves return True optimistically — check
+        ``last_error`` / ``wait()`` for the outcome)."""
+        if async_save is None:
+            async_save = self.config.async_save
+        prior = self._errors.get(_dir_key(save_dir))
+        if prior is not None:
+            # async failures are otherwise only visible via wait(): keep
+            # shouting on every subsequent save so a disk-full job cannot
+            # run to completion having silently written zero checkpoints
+            logger.error(f"previous checkpoint save to {save_dir} FAILED "
+                         f"({prior}); call engine.wait_checkpoint() to "
+                         "turn async saves into a durable guarantee")
+        if not async_save:
+            return self._commit(snapshot, save_dir)
+
+        key = _dir_key(save_dir)
+        thread = threading.Thread(
+            target=self._commit_tracked, args=(snapshot, save_dir),
+            name=f"ckpt-writer-{snapshot.tag}", daemon=False)
+        # register + start under one lock so drain_inflight can never
+        # snapshot (and try to join) a not-yet-started thread
+        with _REGISTRY_LOCK:
+            _INFLIGHT.setdefault(key, []).append(thread)
+            try:
+                thread.start()
+            except Exception:
+                _INFLIGHT[key].remove(thread)
+                raise
+        return True
+
+    def wait(self, save_dir=None, timeout=None):
+        """Drain this process's pending async saves; raise if the most
+        recent commit for ``save_dir`` (or, with no dir, for any dir this
+        manager saved to) failed."""
+        ok = drain_inflight(save_dir, timeout)
+        if save_dir is None:
+            errors = list(self._errors.values())
+        else:
+            err = self._errors.get(_dir_key(save_dir))
+            errors = [err] if err is not None else []
+        if errors:
+            raise writer.CheckpointError(
+                f"async checkpoint save failed: {errors[-1]}"
+            ) from errors[-1]
+        return ok
+
+    def _commit_tracked(self, snapshot, save_dir):
+        try:
+            self._commit(snapshot, save_dir)
+        finally:
+            with _REGISTRY_LOCK:
+                threads = _INFLIGHT.get(_dir_key(save_dir), [])
+                threads[:] = [t for t in threads
+                              if t is not threading.current_thread()]
+
+    def _commit(self, snapshot, save_dir):
+        lock = _dir_lock(save_dir)
+        deadline = _PREEMPT_DEADLINE
+        if deadline is not None:
+            # preemption final save: never block past the kill grace on a
+            # lock a hung writer thread may hold (reentrant main-thread
+            # acquisition still succeeds instantly)
+            if not lock.acquire(timeout=max(0.0,
+                                            deadline - time.monotonic())):
+                e = writer.CheckpointError(
+                    f"checkpoint {snapshot.tag} skipped: dir lock for "
+                    f"{save_dir} still held at the preemption deadline")
+                self.last_error = e
+                self._errors[_dir_key(save_dir)] = e
+                logger.error(str(e))
+                return False
+        else:
+            lock.acquire()
+        try:
+            return self._commit_locked(snapshot, save_dir)
+        finally:
+            lock.release()
+
+    def _commit_locked(self, snapshot, save_dir):
+        attempts = self.config.save_retries + 1
+        final_dir = None
+        for attempt in range(attempts):
+            try:
+                final_dir = writer.write_checkpoint(
+                    save_dir, snapshot.tag, snapshot.file_writers(),
+                    extra_manifest=snapshot.manifest_extra())
+                break
+            except Exception as e:  # noqa: BLE001 — retry any I/O error
+                if attempt + 1 >= attempts:
+                    self.last_error = e
+                    self._errors[_dir_key(save_dir)] = e
+                    logger.error(
+                        f"checkpoint {snapshot.tag} failed after "
+                        f"{attempts} attempt(s): {e}")
+                    return False
+                backoff = self.config.retry_backoff_secs * (2 ** attempt)
+                logger.warning(
+                    f"checkpoint {snapshot.tag} attempt "
+                    f"{attempt + 1}/{attempts} failed ({e}); retrying "
+                    f"in {backoff:.1f}s")
+                time.sleep(backoff)
+
+        key = _dir_key(save_dir)
+        step = snapshot.global_steps
+        try:
+            if writer.read_latest(save_dir) is None:
+                # no `latest` on disk: the dir was wiped or is brand new —
+                # a stale guard from a previous run must not pin it
+                _COMMITTED_STEPS.pop(key, None)
+            # an out-of-order late commit must not move `latest` (or the
+            # retention window) backwards past a newer checkpoint
+            if snapshot.save_latest and step >= _COMMITTED_STEPS.get(
+                    key, -1):
+                writer.write_latest(save_dir, snapshot.tag)
+        except Exception as e:  # noqa: BLE001 — surface via wait()
+            self.last_error = e
+            self._errors[key] = e
+            logger.error(f"checkpoint {snapshot.tag} committed but "
+                         f"'latest' pointer update failed: {e}")
+            return False
+        if snapshot.save_latest:
+            # save_latest=False commits (archival tags) must not pin the
+            # guard: a later lower-step save that DOES want `latest` moved
+            # would otherwise be silently skipped
+            _COMMITTED_STEPS[key] = max(step, _COMMITTED_STEPS.get(key, -1))
+        self._errors.pop(key, None)
+        self.last_error = None
+        try:
+            self._apply_retention(save_dir)
+        except Exception as e:  # noqa: BLE001 — the save itself landed
+            logger.warning(f"retention sweep after {snapshot.tag} "
+                           f"failed (checkpoint is committed): {e}")
+        log_dist(f"saved checkpoint {final_dir}", ranks=[0])
+        return True
+
+    # -------------------------------------------------------- retention
+    def _list_committed(self, save_dir):
+        """[(step, tag)] for every committed checkpoint dir under
+        ``save_dir`` (manifest step, falling back to meta.json, then -1)."""
+        out = []
+        try:
+            names = os.listdir(save_dir)
+        except OSError:
+            return out
+        for name in names:
+            path = os.path.join(save_dir, name)
+            if (not os.path.isdir(path) or name.endswith(TMP_SUFFIX)
+                    or name.endswith(OLD_SUFFIX)):
+                continue
+            step = None
+            try:
+                manifest = writer.read_manifest(path)
+                if manifest is not None:
+                    step = manifest.get("global_steps")
+                elif os.path.isfile(os.path.join(path, META_JSON)):
+                    import json
+
+                    with open(os.path.join(path, META_JSON)) as f:
+                        step = json.load(f).get("global_steps")
+                else:
+                    continue  # not a checkpoint dir; never touch it
+            except (OSError, ValueError):
+                continue
+            out.append((int(step) if step is not None else -1, name))
+        return out
+
+    def _apply_retention(self, save_dir):
+        """Prune committed checkpoints down to the configured policy and
+        sweep stale ``*.tmp`` dirs.  Runs under the dir lock right after a
+        successful commit, so any tmp dir present is a dead write."""
+        for name in os.listdir(save_dir):
+            path = os.path.join(save_dir, name)
+            if name.endswith(TMP_SUFFIX):
+                (shutil.rmtree if os.path.isdir(path) else os.remove)(path)
+            elif name.endswith(OLD_SUFFIX) and os.path.isdir(path):
+                # parked-aside dir from a same-tag re-save: recover it if
+                # its final dir is gone (interrupted re-save), else it is
+                # superseded and dead
+                tag = name[:-len(OLD_SUFFIX)]
+                if not writer.recover_tag(save_dir, tag):
+                    shutil.rmtree(path, ignore_errors=True)
+
+        n = self.config.keep_last_n
+        if n <= 0:
+            return
+        committed = sorted(self._list_committed(save_dir))
+        latest_tag = writer.read_latest(save_dir)
+        every = self.config.keep_every_n_steps
+        keep = {tag for _, tag in committed[-n:]}
+        if latest_tag:
+            keep.add(latest_tag)
+        if every > 0:
+            keep.update(tag for step, tag in committed
+                        if step >= 0 and step % every == 0)
+        for _, tag in committed:
+            if tag not in keep:
+                shutil.rmtree(os.path.join(save_dir, tag),
+                              ignore_errors=True)
+                log_dist(f"retention: pruned checkpoint {tag}", ranks=[0])
+
+    # ------------------------------------------------------- preemption
+    def install_preemption_handler(self, final_save_fn,
+                                   signals=(signal.SIGTERM,)):
+        """On SIGTERM (TPU preemption notice), drain in-flight saves, run
+        one final SYNCHRONOUS ``final_save_fn()``, then re-deliver the
+        signal to the previous disposition so shutdown proceeds.  Only
+        callable from the main thread; chained handlers are preserved.
+
+        One OS-level handler is installed per process no matter how many
+        engines register: callbacks go into a module-level list, bound
+        methods as weakrefs so a discarded engine neither leaks nor gets
+        a pointless final checkpoint on preemption."""
+        if threading.current_thread() is not threading.main_thread():
+            logger.warning("preemption handler not installed: signal "
+                           "handlers require the main thread")
+            return False
+
+        try:
+            ref = weakref.WeakMethod(final_save_fn)
+        except TypeError:  # plain function/lambda: hold it strongly
+            ref = (lambda f=final_save_fn: f)
+        _PREEMPT_CALLBACKS.append(ref)
+
+        for sig in signals:
+            # (re)install only if something else holds the disposition —
+            # installing our own handler over itself would self-chain
+            current = signal.getsignal(sig)
+            if current is not _preemption_handler:
+                _PREEMPT_PREVIOUS[sig] = current
+                signal.signal(sig, _preemption_handler)
+        return True

@@ -1,0 +1,187 @@
+"""Host-side checkpoint snapshots.
+
+:func:`capture_engine_snapshot` performs the device->host gather ONCE (the
+only part of a save that must block training) and returns an immutable
+:class:`CheckpointSnapshot` of plain numpy arrays + JSON-able metadata that
+a background writer thread can serialize without touching live engine
+state.  Client state is pickled eagerly for the same reason.
+
+Model states are stored in their NATIVE dtype (a bf16 run no longer pays a
+2x fp32 checkpoint-size tax).  Non-numpy-native dtypes (bfloat16, fp8) are
+stored as same-width unsigned-int views with the true dtype recorded under
+``model_dtypes`` in ``meta.json`` and the manifest;
+:func:`load_model_states` reverses this, and old all-fp32 checkpoints
+(no dtype map) pass through unchanged.
+"""
+
+import json
+import pickle
+
+import jax
+import numpy as np
+
+from ..runtime.utils import tree_path_key
+from .constants import (CLIENT_STATE_PKL, META_JSON, MODEL_STATES_NPZ,
+                        OPTIM_STATES_NPZ)
+
+# dtypes np.savez round-trips faithfully; anything else (ml_dtypes
+# extension types) is stored as a same-width uint view + a dtype record
+_NPZ_NATIVE = frozenset(
+    "float16 float32 float64 int8 int16 int32 int64 "
+    "uint8 uint16 uint32 uint64 bool complex64 complex128".split())
+_WIDTH_VIEW = {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}
+
+
+def owned_host_copy(leaf):
+    """Device array -> host numpy array that OWNS its memory.
+
+    On CPU backends ``jax.device_get`` can return a zero-copy view of a
+    buffer the next (donating) step overwrites mid-async-write; TPU
+    transfers already materialize a fresh owning array, so only views get
+    the extra copy."""
+    arr = np.asarray(jax.device_get(leaf))
+    if arr.base is None and arr.flags.owndata:
+        return arr
+    return np.array(arr, copy=True)
+
+
+def encode_array(arr):
+    """numpy array -> (npz-safe array, recorded dtype name or None)."""
+    arr = np.asarray(arr)
+    if arr.dtype.name in _NPZ_NATIVE:
+        return arr, None
+    view = _WIDTH_VIEW.get(arr.dtype.itemsize)
+    if view is None:
+        raise TypeError(f"cannot serialize dtype {arr.dtype} "
+                        f"(itemsize {arr.dtype.itemsize})")
+    return arr.view(view), arr.dtype.name
+
+
+def decode_array(arr, dtype_name):
+    if dtype_name is None:
+        return arr
+    return arr.view(np.dtype(dtype_name))
+
+
+class CheckpointSnapshot:
+    """Immutable host copy of everything one checkpoint contains."""
+
+    __slots__ = ("tag", "model_states", "model_dtypes", "optim_states",
+                 "meta", "client_state_pkl", "save_latest")
+
+    def __init__(self, tag, model_states, model_dtypes, optim_states, meta,
+                 client_state_pkl=None, save_latest=True):
+        self.tag = str(tag)
+        self.model_states = model_states
+        self.model_dtypes = model_dtypes
+        self.optim_states = optim_states
+        self.meta = meta
+        self.client_state_pkl = client_state_pkl
+        self.save_latest = bool(save_latest)
+
+    @property
+    def global_steps(self):
+        return int(self.meta.get("global_steps", -1))
+
+    def nbytes(self):
+        return sum(int(a.nbytes) for a in self.model_states.values()) + sum(
+            int(a.nbytes) for a in self.optim_states.values())
+
+    def file_writers(self):
+        """Ordered {filename: fn(file_object)} for the atomic writer."""
+        writers = {
+            MODEL_STATES_NPZ:
+                lambda f: np.savez(f, **self.model_states),
+            OPTIM_STATES_NPZ:
+                lambda f: np.savez(f, **self.optim_states),
+            META_JSON:
+                lambda f: f.write(json.dumps(self.meta, indent=2).encode()),
+        }
+        if self.client_state_pkl is not None:
+            writers[CLIENT_STATE_PKL] = (
+                lambda f: f.write(self.client_state_pkl))
+        return writers
+
+    def manifest_extra(self):
+        return {"global_steps": self.global_steps,
+                "model_dtypes": self.model_dtypes}
+
+
+def capture_engine_snapshot(engine, tag, client_state=None, save_latest=True):
+    """Gather engine state to host and freeze it as a snapshot.
+
+    Layout mirrors the reference's (SURVEY §3.5): a model-states archive,
+    a ZeRO optimizer-states archive (flat master saved *unpadded* so a
+    different DP degree can re-pad on load — the reference's elastic
+    checkpoint trick, ``stage1.py:848-883``), and a meta json.
+    """
+    model_states, model_dtypes = {}, {}
+    for key, arr in engine._params_to_host(engine.get_params()).items():
+        enc, dtype_name = encode_array(arr)
+        model_states[key] = enc
+        if dtype_name is not None:
+            model_dtypes[key] = dtype_name
+
+    unpadded = engine.flat.gather_master_unpadded(engine.state["master"])
+    # flat-shaped optimizer-state leaves are saved unpadded too, so the
+    # whole optimizer checkpoint is DP-degree elastic.  Row-group tuples
+    # (grouped offload state) are treated as one logical leaf so the saved
+    # format stays identical to the ungrouped layout — checkpoints stay
+    # portable across offload modes and DP degrees.
+    optim_states = {"master": np.asarray(unpadded)}
+    flat_opt, _ = jax.tree_util.tree_flatten_with_path(
+        engine.state["opt"], is_leaf=lambda x: type(x) is tuple)
+    for path, leaf in flat_opt:
+        key = tree_path_key(path)
+        if type(leaf) is tuple or leaf.shape == engine.segments.shape:
+            optim_states[f"opt/{key}"] = engine.flat.gather_master_unpadded(
+                leaf)
+        else:
+            optim_states[f"opt/{key}"] = owned_host_copy(leaf)
+
+    scale = engine.state["scale"]
+    meta = {
+        "global_steps": engine.global_steps,
+        "micro_steps": engine.micro_steps,
+        "global_samples": engine.global_samples,
+        "skipped_steps": engine.skipped_steps,
+        "scale_state": {
+            "cur_scale": float(jax.device_get(scale.cur_scale)),
+            "cur_iter": int(jax.device_get(scale.cur_iter)),
+            "last_overflow_iter": int(jax.device_get(
+                scale.last_overflow_iter)),
+            "cur_hysteresis": int(jax.device_get(scale.cur_hysteresis)),
+        },
+        "ustep": int(jax.device_get(engine.state["ustep"])),
+        "lr_scheduler": (engine.lr_scheduler.state_dict()
+                         if engine.lr_scheduler is not None else None),
+        "dp_world_size": engine.dp_world_size,
+        "mp_world_size": engine.mp_world_size,
+        "zero_stage": engine.zero_stage,
+        "param_count": int(sum(engine.segments.sizes)),
+        "model_dtypes": model_dtypes,
+    }
+
+    client_state_pkl = (pickle.dumps(client_state)
+                        if client_state else None)
+    return CheckpointSnapshot(tag, model_states, model_dtypes, optim_states,
+                              meta, client_state_pkl, save_latest)
+
+
+def load_model_states(ckpt_dir):
+    """Read ``model_states.npz`` back in its true dtypes.
+
+    Pre-manifest checkpoints saved everything as fp32 and carry no dtype
+    map — their arrays pass through unchanged, so old checkpoints load
+    transparently into runs of any compute dtype.
+    """
+    import os
+
+    meta_path = os.path.join(str(ckpt_dir), META_JSON)
+    dtype_map = {}
+    if os.path.isfile(meta_path):
+        with open(meta_path) as f:
+            dtype_map = json.load(f).get("model_dtypes") or {}
+    with np.load(os.path.join(str(ckpt_dir), MODEL_STATES_NPZ)) as npz:
+        return {k: decode_array(npz[k], dtype_map.get(k))
+                for k in npz.files}

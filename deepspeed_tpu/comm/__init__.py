@@ -78,4 +78,6 @@ def axis_index(axis_name):
 
 def axis_size(axis_name):
     """Size of the axis (reference: dist.get_world_size(group))."""
-    return lax.axis_size(axis_name)
+    from ..utils.compat import axis_size as _axis_size
+
+    return _axis_size(axis_name)

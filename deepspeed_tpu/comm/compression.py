@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.compat import axis_size as compat_axis_size
+
 _BIT_WEIGHTS = np.asarray([128, 64, 32, 16, 8, 4, 2, 1], np.uint8)  # MSB-first
 
 
@@ -65,7 +67,7 @@ def compressed_allreduce(buf, worker_error, server_error, axis_name):
     Returns ``(out, new_worker_error, new_server_error)`` with ``out`` the
     compressed approximation of ``mean(buf)`` — identical on all ranks.
     """
-    world = jax.lax.axis_size(axis_name)
+    world = compat_axis_size(axis_name)
     n = buf.shape[0]
     assert n % (8 * world) == 0, (
         f"buffer size {n} must be divisible by 8*world ({8 * world})")

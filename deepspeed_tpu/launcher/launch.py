@@ -78,16 +78,39 @@ def main(argv=None):
                     f"{' '.join(cmd)}")
         procs.append(subprocess.Popen(cmd, env=env))
 
-    def terminate_all(sig=signal.SIGTERM):
+    # Children may install a preemption checkpoint hook (checkpoint
+    # subsystem, "save_on_preemption") that drains one final synchronous
+    # save on SIGTERM — give them a grace window before escalating to
+    # SIGKILL so that save can land.
+    grace_secs = float(os.environ.get("DS_TERM_GRACE_SECS", "30"))
+
+    def terminate_all(sig=signal.SIGTERM, grace=grace_secs):
         for p in procs:
             if p.poll() is None:
                 try:
                     p.send_signal(sig)
                 except ProcessLookupError:
                     pass
+        deadline = time.time() + grace
+        while (time.time() < deadline
+               and any(p.poll() is None for p in procs)):
+            time.sleep(0.1)
+        for p in procs:
+            if p.poll() is None:
+                logger.warning(f"process {p.pid} survived {grace:.0f}s "
+                               "grace after signal; killing")
+                p.kill()
 
     def forward_signal(signum, _frame):
-        terminate_all(signum)
+        # the long grace exists for the SIGTERM preemption-save path; a
+        # Ctrl-C should not pin the launcher for 30s (and a second Ctrl-C
+        # escalates straight to SIGKILL via the nested handler's 0 grace)
+        if signum == signal.SIGINT:
+            signal.signal(signal.SIGINT,
+                          lambda s, f: terminate_all(s, grace=0.0))
+            terminate_all(signum, grace=min(grace_secs, 2.0))
+        else:
+            terminate_all(signum)
         sys.exit(128 + signum)
 
     signal.signal(signal.SIGINT, forward_signal)

@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...utils.compat import shard_map
+
 
 class CPUAdamState(NamedTuple):
     exp_avg: jnp.ndarray
@@ -144,7 +146,7 @@ class DeepSpeedCPUAdam:
             rep = P()
             # callbacks require FULLY-manual spmd: take every mesh axis
             # manual (buffers replicate over the non-data axes)
-            new_p, new_m, new_v = jax.shard_map(
+            new_p, new_m, new_v = shard_map(
                 host_update, mesh=self.mesh,
                 in_specs=(sharded, sharded, sharded, sharded,
                           rep, rep, rep, rep, rep, rep),

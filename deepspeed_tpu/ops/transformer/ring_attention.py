@@ -33,6 +33,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ...parallel.mesh import SEQ_AXIS
+from ...utils.compat import shard_map
 
 NEG = -1e9
 
@@ -111,22 +112,31 @@ def ring_attention(q, k, v, mesh=None, axis_name=SEQ_AXIS, causal=False,
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
 
-    if nshards == 1:
+    from ...utils.compat import PARTIAL_MANUAL_SHARD_MAP
+
+    if nshards == 1 or not PARTIAL_MANUAL_SHARD_MAP:
+        # single seq shard — or old jax, where the partial-manual ring
+        # program cannot compile (see utils/compat.py): same math, dense,
+        # GSPMD-sharded instead of ring-scheduled
         from .attention import reference_attention
 
         mask4 = (key_padding_mask[:, None, None, :]
                  if key_padding_mask is not None else None)
-        return reference_attention(q, k, v, mask=mask4, causal=causal)
+        # reference_attention hard-codes 1/sqrt(d); fold any custom scale
+        # in by pre-scaling q so both paths compute the same scores
+        q_eff = q * (scale * math.sqrt(d)) if scale != 1.0 / math.sqrt(d) \
+            else q
+        return reference_attention(q_eff, k, v, mask=mask4, causal=causal)
 
     body = partial(_ring_attention_local, axis_name=axis_name,
                    nshards=nshards, causal=causal, scale=scale)
     spec = P(None, axis_name)  # shard the seq dim (axis 1)
     if key_padding_mask is None:
-        fn = jax.shard_map(lambda q, k, v: body(q, k, v, None), mesh=mesh,
+        fn = shard_map(lambda q, k, v: body(q, k, v, None), mesh=mesh,
                            in_specs=(spec, spec, spec), out_specs=spec,
                            axis_names={axis_name}, check_vma=False)
         return fn(q, k, v)
-    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec, spec),
+    fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec, spec),
                        out_specs=spec, axis_names={axis_name},
                        check_vma=False)
     return fn(q, k, v, key_padding_mask)

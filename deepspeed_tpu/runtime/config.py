@@ -24,6 +24,7 @@ from .config_utils import dict_raise_error_on_duplicate_keys, get_scalar_param
 from .zero.config import DeepSpeedZeroConfig
 from .activation_checkpointing.config import DeepSpeedActivationCheckpointingConfig
 from ..profiling.config import DeepSpeedFlopsProfilerConfig
+from ..checkpoint.config import DeepSpeedCheckpointConfig
 
 TENSOR_CORE_ALIGN_SIZE = 8
 ADAM_OPTIMIZER = C.ADAM_OPTIMIZER
@@ -320,6 +321,7 @@ class DeepSpeedConfig:
 
         self.activation_checkpointing_config = DeepSpeedActivationCheckpointingConfig(param_dict)
         self.flops_profiler_config = DeepSpeedFlopsProfilerConfig(param_dict)
+        self.checkpoint_config = DeepSpeedCheckpointConfig(param_dict)
 
         self.fp16_enabled = get_fp16_enabled(param_dict)
         self.bf16_enabled = get_bf16_enabled(param_dict)

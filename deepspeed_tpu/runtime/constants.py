@@ -236,6 +236,34 @@ SPARSE_NUM_SLIDING_WINDOW_BLOCKS = "num_sliding_window_blocks"
 SPARSE_NUM_SLIDING_WINDOW_BLOCKS_DEFAULT = 3
 
 #############################################
+# Checkpoint subsystem (deepspeed_tpu/checkpoint; new — the reference
+# saves synchronously inline in the engine, SURVEY §3.5)
+#############################################
+CHECKPOINT = "checkpoint"
+# hand the host-side snapshot to a background writer thread so
+# train_batch resumes immediately; commits stay atomic either way
+CHECKPOINT_ASYNC_SAVE = "async_save"
+CHECKPOINT_ASYNC_SAVE_DEFAULT = True
+# retention: keep the newest N committed checkpoints (0 = keep all) ...
+CHECKPOINT_KEEP_LAST_N = "keep_last_n"
+CHECKPOINT_KEEP_LAST_N_DEFAULT = 0
+# ... plus every checkpoint whose step is a multiple of this (0 = none)
+CHECKPOINT_KEEP_EVERY_N_STEPS = "keep_every_n_steps"
+CHECKPOINT_KEEP_EVERY_N_STEPS_DEFAULT = 0
+# re-checksum payload files against the manifest before restoring
+CHECKPOINT_VERIFY_ON_LOAD = "verify_on_load"
+CHECKPOINT_VERIFY_ON_LOAD_DEFAULT = True
+# retries (beyond the first attempt) for a failed commit, with
+# exponential backoff starting at retry_backoff_secs
+CHECKPOINT_SAVE_RETRIES = "save_retries"
+CHECKPOINT_SAVE_RETRIES_DEFAULT = 2
+CHECKPOINT_RETRY_BACKOFF_SECS = "retry_backoff_secs"
+CHECKPOINT_RETRY_BACKOFF_SECS_DEFAULT = 0.5
+# drain one final synchronous save on SIGTERM (TPU preemption notice)
+CHECKPOINT_SAVE_ON_PREEMPTION = "save_on_preemption"
+CHECKPOINT_SAVE_ON_PREEMPTION_DEFAULT = False
+
+#############################################
 # Ring / context parallel attention (TPU addition, SURVEY §5.7)
 #############################################
 RING_ATTENTION = "ring_attention"

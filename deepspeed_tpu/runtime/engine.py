@@ -41,6 +41,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .. import checkpoint as ckpt
+from ..checkpoint import CheckpointManager, capture_engine_snapshot, drain_inflight
+from ..checkpoint.snapshot import owned_host_copy
+from ..checkpoint.writer import CheckpointCorruptionError, CheckpointError
 from ..ops.adam.fused_adam import FusedAdam
 from ..ops.lamb.fused_lamb import FusedLamb
 from ..ops.op_common import LANES
@@ -55,6 +59,7 @@ from .fp16.loss_scaler import DynamicScaleState, update_scale_state
 from .lr_schedules import SCHEDULE_CLASSES
 from .progressive_layer_drop import ProgressiveLayerDrop
 from .utils import tree_path_key
+from ..utils.compat import shard_map
 
 def _pack_batches(micro_batches):
     """Stack ``grad_acc`` micro-batch pytrees and pack all leaves into ONE
@@ -102,11 +107,13 @@ def _unpack_batches(packed, spec):
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
-MODEL_STATES_NPZ = "model_states.npz"
-OPTIM_STATES_NPZ = "zero_optim_states.npz"
-META_JSON = "meta.json"
-CLIENT_STATE_PKL = "client_state.pkl"
-LATEST_FILE = "latest"
+# layout names live with the checkpoint subsystem; aliased here for
+# back-compat with older imports
+MODEL_STATES_NPZ = ckpt.MODEL_STATES_NPZ
+OPTIM_STATES_NPZ = ckpt.OPTIM_STATES_NPZ
+META_JSON = ckpt.META_JSON
+CLIENT_STATE_PKL = ckpt.CLIENT_STATE_PKL
+LATEST_FILE = ckpt.LATEST_FILE
 
 
 def initialize(args=None,
@@ -335,9 +342,14 @@ class DeepSpeedEngine:
         # themselves) or 'eager' (state parked in pinned host between steps)
         self._offload = self.flat.cpu_offload
         self._offload_eager = self._offload and not self.flat.injit_placement
-        if self._offload:
+        if self._offload and self.flat.injit_placement:
             self._opt_shardings_device = jax.tree_util.tree_map(
                 lambda s: s.with_memory_kind("device"), self._opt_shardings)
+        elif self._offload:
+            # eager backends (CPU) have a single memory space: the
+            # "device" copy of the shardings is the default-space variant
+            self._opt_shardings_device = jax.tree_util.tree_map(
+                lambda s: NamedSharding(s.mesh, s.spec), self._opt_shardings)
         else:
             self._opt_shardings_device = self._opt_shardings
         if (self.flat.host_group_bounds is not None
@@ -486,6 +498,14 @@ class DeepSpeedEngine:
             self._build_step_functions()
             with self.mesh:
                 self._refresh_module_params()
+
+        # -- checkpoint subsystem (deepspeed_tpu/checkpoint) --
+        self.checkpoint_config = self._config.checkpoint_config
+        self._ckpt_manager = CheckpointManager(self.checkpoint_config)
+        self._last_ckpt_dir = None
+        if self.checkpoint_config.save_on_preemption:
+            self._ckpt_manager.install_preemption_handler(
+                self._preemption_save)
 
         if self._config.dump_state:
             self._config.print("DeepSpeedEngine configuration")
@@ -1161,7 +1181,7 @@ class DeepSpeedEngine:
                 return jax.lax.pmean(sloss, DATA_AXIS), exchanged, drops
 
             rep = P()
-            sloss, grads, drops = jax.shard_map(
+            sloss, grads, drops = shard_map(
                 body, mesh=mesh,
                 in_specs=(P(DATA_AXIS), rep, rep, rep, rep),
                 out_specs=(rep, rep, rep),
@@ -1867,98 +1887,106 @@ class DeepSpeedEngine:
         flat, _ = jax.tree_util.tree_flatten_with_path(tree)
         out = {}
         for path, leaf in flat:
-            out[self._path_key(path)] = np.asarray(jax.device_get(leaf))
+            # snapshots handed to the async writer must own their memory
+            # (CPU device_get can return a view of a donated buffer)
+            out[self._path_key(path)] = owned_host_copy(leaf)
         return out
 
-    def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True):
-        """Save model + optimizer + engine state.
+    def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True,
+                        sync=None):
+        """Save model + optimizer + engine state (thin wrapper over
+        ``deepspeed_tpu/checkpoint``).
 
-        Layout mirrors the reference's (SURVEY §3.5): a model-states archive,
-        a ZeRO optimizer-states archive (flat master saved *unpadded* so a
-        different DP degree can re-pad on load — the reference's elastic
-        checkpoint trick, ``stage1.py:848-883``), a meta json, and a
-        ``latest`` tag pointer.
+        Layout mirrors the reference's (SURVEY §3.5): a model-states archive
+        in native dtype, a ZeRO optimizer-states archive (flat master saved
+        *unpadded* so a different DP degree can re-pad on load — the
+        reference's elastic checkpoint trick, ``stage1.py:848-883``), a meta
+        json, a checksummed ``manifest.json``, and a ``latest`` tag pointer.
+        The device->host gather happens here; with ``checkpoint.async_save``
+        (the default) serialization + the atomic commit run on a background
+        thread and training resumes immediately.  ``sync=True`` forces an
+        inline commit for this call.
         """
         self._check_sparse_overflow()
         tag = tag or f"global_step{self.global_steps}"
-        ckpt_dir = os.path.join(save_dir, str(tag))
-        os.makedirs(ckpt_dir, exist_ok=True)
+        snapshot = capture_engine_snapshot(self, tag, client_state,
+                                           save_latest)
+        self._last_ckpt_dir = save_dir
+        async_save = (self.checkpoint_config.async_save if sync is None
+                      else not sync)
+        ok = self._ckpt_manager.save(snapshot, save_dir,
+                                     async_save=async_save)
+        if not ok:
+            # sync commits keep the old inline-save contract: I/O failure
+            # raises instead of returning a flag no caller checks
+            raise CheckpointError(
+                f"checkpoint {tag} save to {save_dir} failed"
+            ) from self._ckpt_manager.last_error
+        return ok
 
-        params = self.get_params()
-        np.savez(os.path.join(ckpt_dir, MODEL_STATES_NPZ),
-                 **{k: v.astype(np.float32)
-                    for k, v in self._params_to_host(params).items()})
+    def wait_checkpoint(self, save_dir=None, timeout=None):
+        """Block until pending async checkpoint saves finish (for
+        ``save_dir``, or all of this engine's); raises
+        :class:`~deepspeed_tpu.checkpoint.writer.CheckpointError` if the
+        most recent commit failed.  The public way to turn an optimistic
+        async ``save_checkpoint`` return into a durable guarantee."""
+        return self._ckpt_manager.wait(save_dir, timeout)
 
-        unpadded = self.flat.gather_master_unpadded(self.state["master"])
-        # flat-shaped optimizer-state leaves are saved unpadded too, so the
-        # whole optimizer checkpoint is DP-degree elastic
-        opt_host = {}
-        # row-group tuples (grouped offload state) are treated as one
-        # logical leaf so the saved format stays identical to the
-        # ungrouped layout — checkpoints stay portable across offload
-        # modes and DP degrees
-        flat_opt, _ = jax.tree_util.tree_flatten_with_path(
-            self.state["opt"], is_leaf=lambda x: type(x) is tuple)
-        for path, leaf in flat_opt:
-            key = self._path_key(path)
-            if type(leaf) is tuple or leaf.shape == self.segments.shape:
-                opt_host[key] = self.flat.gather_master_unpadded(leaf)
-            else:
-                opt_host[key] = np.asarray(jax.device_get(leaf))
-        np.savez(os.path.join(ckpt_dir, OPTIM_STATES_NPZ),
-                 master=np.asarray(unpadded),
-                 **{f"opt/{k}": v for k, v in opt_host.items()})
-
-        meta = {
-            "global_steps": self.global_steps,
-            "micro_steps": self.micro_steps,
-            "global_samples": self.global_samples,
-            "skipped_steps": self.skipped_steps,
-            "scale_state": {
-                "cur_scale": float(jax.device_get(self.state["scale"].cur_scale)),
-                "cur_iter": int(jax.device_get(self.state["scale"].cur_iter)),
-                "last_overflow_iter": int(jax.device_get(
-                    self.state["scale"].last_overflow_iter)),
-                "cur_hysteresis": int(jax.device_get(
-                    self.state["scale"].cur_hysteresis)),
-            },
-            "ustep": int(jax.device_get(self.state["ustep"])),
-            "lr_scheduler": (self.lr_scheduler.state_dict()
-                             if self.lr_scheduler is not None else None),
-            "dp_world_size": self.dp_world_size,
-            "mp_world_size": self.mp_world_size,
-            "zero_stage": self.zero_stage,
-            "param_count": int(sum(self.segments.sizes)),
-        }
-        with open(os.path.join(ckpt_dir, META_JSON), "w") as f:
-            json.dump(meta, f, indent=2)
-
-        if client_state:
-            with open(os.path.join(ckpt_dir, CLIENT_STATE_PKL), "wb") as f:
-                pickle.dump(client_state, f)
-
-        if save_latest:
-            with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
-                f.write(str(tag))
-        log_dist(f"saved checkpoint {ckpt_dir}", ranks=[0])
-        return True
+    def _preemption_save(self):
+        """Final synchronous save on SIGTERM, into the last save dir."""
+        if self._last_ckpt_dir is None:
+            logger.warning("preemption save skipped: no checkpoint dir "
+                           "seen yet (call save_checkpoint once to set it)")
+            return
+        self.save_checkpoint(self._last_ckpt_dir,
+                             tag=f"global_step{self.global_steps}",
+                             sync=True)
 
     def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
-                        load_optimizer_states=True, load_lr_scheduler_states=True):
+                        load_optimizer_states=True, load_lr_scheduler_states=True,
+                        strict=False):
         """Restore a checkpoint (reference ``engine.py:1275-1446``); returns
         ``(path, client_state)``.  Loading into a different DP degree re-pads
-        the unpadded flat master (elastic restore, ``stage2.py:1714-1841``)."""
+        the unpadded flat master (elastic restore, ``stage2.py:1714-1841``).
+
+        With ``strict=False`` (default, reference behavior) a missing or
+        unverifiable checkpoint warns and returns ``(None, None)``;
+        ``strict=True`` raises so production resume scripts fail loudly.
+        Integrity is verified against ``manifest.json`` when
+        ``checkpoint.verify_on_load`` is set; pre-manifest checkpoint dirs
+        load unverified with a one-line notice.
+        """
+        drain_inflight(load_dir)  # a same-process async save may be landing
+
+        def _missing(msg, exc=CheckpointError):
+            if strict:
+                raise exc(msg)
+            logger.warning(f"{msg}, cannot load")
+            return None, None
+
         if tag is None:
-            latest_path = os.path.join(load_dir, LATEST_FILE)
-            if not os.path.isfile(latest_path):
-                logger.warning(f"no 'latest' file at {latest_path}, cannot load")
-                return None, None
-            with open(latest_path) as f:
-                tag = f.read().strip()
+            tag = ckpt.read_latest(load_dir)
+            if tag is None:
+                return _missing(
+                    f"no '{LATEST_FILE}' file in {load_dir}")
         ckpt_dir = os.path.join(load_dir, str(tag))
         if not os.path.isdir(ckpt_dir):
-            logger.warning(f"checkpoint dir {ckpt_dir} missing")
-            return None, None
+            # a crash inside a same-tag re-save's rename window leaves the
+            # previous committed dir parked at <tag>.old — heal it
+            if not ckpt.recover_tag(load_dir, tag):
+                return _missing(f"checkpoint dir {ckpt_dir} missing")
+        if not os.path.isfile(os.path.join(ckpt_dir, META_JSON)):
+            return _missing(f"checkpoint dir {ckpt_dir} has no {META_JSON} "
+                            "(torn or foreign directory)")
+        if self.checkpoint_config.verify_on_load:
+            status, problems = ckpt.verify_checkpoint(ckpt_dir)
+            if status == "bad":
+                return _missing(f"checkpoint {ckpt_dir} failed integrity "
+                                f"verification: {'; '.join(problems)}",
+                                exc=CheckpointCorruptionError)
+            if status == "legacy":
+                logger.info(f"checkpoint {ckpt_dir} predates manifests; "
+                            "loading without integrity verification")
 
         with open(os.path.join(ckpt_dir, META_JSON)) as f:
             meta = json.load(f)
@@ -1997,6 +2025,9 @@ class DeepSpeedEngine:
         if os.path.isfile(cs_path):
             with open(cs_path, "rb") as f:
                 client_state = pickle.load(f)
+        # a resumed job can now take its preemption save before the first
+        # periodic save_checkpoint sets a directory
+        self._last_ckpt_dir = load_dir
         log_dist(f"loaded checkpoint {ckpt_dir}", ranks=[0])
         return ckpt_dir, client_state
 

@@ -42,6 +42,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ...comm.compression import compressed_allreduce
 from ...parallel.mesh import DATA_AXIS
+from ...utils.compat import shard_map
 
 
 class OnebitAdamState(NamedTuple):
@@ -208,7 +209,7 @@ class OnebitAdam:
 
             rep = P()
             (loss, new_master, m_bar, new_we, new_se, new_params) = \
-                jax.shard_map(
+                shard_map(
                     body, mesh=mesh,
                     in_specs=(P(None, DATA_AXIS, None), rep, rep,
                               P(DATA_AXIS, None), P(DATA_AXIS, None), rep, rep),

@@ -50,6 +50,7 @@ from ...utils.logging import log_dist
 from ..engine import DeepSpeedEngine
 from .module import PipelineModule, split_batch
 from .schedule import InferenceSchedule, TrainSchedule
+from ...utils.compat import shard_map
 
 
 class _PipelinedModel:
@@ -244,23 +245,26 @@ class _PipelinedModel:
                                        valid, tick_rng, c)
                 x_next = jax.tree_util.tree_map(
                     lambda a: jax.lax.ppermute(a, PIPE_AXIS, perm), y)
-                return (x_next, loss_sum + loss), None
+                return (x_next, loss_sum + jnp.reshape(loss, (1,))), None
 
+            # loss accumulator kept 1-D: scalar residuals crossing the
+            # shard_map boundary trip a jax-0.4.x transpose bug (mis-named
+            # scalar residual -> _SpecError); see utils/compat.py
             (x_state, loss_sum), _ = jax.lax.scan(
-                tick, (zeros_boundary(), jnp.asarray(0.0, jnp.float32)),
+                tick, (zeros_boundary(), jnp.zeros((1,), jnp.float32)),
                 jnp.arange(ticks))
             # reference _aggregate_total_loss: last stage holds the sum;
             # broadcast down the pipe group == psum here (others hold 0)
-            return jax.lax.psum(loss_sum, PIPE_AXIS) / mb_count
+            return jax.lax.psum(loss_sum, PIPE_AXIS)[0] / mb_count
 
         if rng is None:
-            pipelined = jax.shard_map(
+            pipelined = shard_map(
                 lambda p, i, l: per_pipe(p, i, l, None),
                 mesh=self.engine.mesh,
                 in_specs=(P(), P(), P()), out_specs=P(),
                 axis_names={PIPE_AXIS}, check_vma=False)
             return pipelined(params, inputs, labels)
-        pipelined = jax.shard_map(
+        pipelined = shard_map(
             per_pipe, mesh=self.engine.mesh,
             in_specs=(P(), P(), P(), P()), out_specs=P(),
             axis_names={PIPE_AXIS}, check_vma=False)

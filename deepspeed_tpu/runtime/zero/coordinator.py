@@ -120,24 +120,36 @@ class FlatParamCoordinator:
         # TPU-backend feature; elsewhere the engine parks state in host
         # memory eagerly between steps
         self.injit_placement = mesh.devices.flat[0].platform == "tpu"
+        self._host_memory_kind = None
         if cpu_offload:
             try:
                 mesh.devices.flat[0].memory("pinned_host")
+                self._host_memory_kind = "pinned_host"
             except Exception as e:
-                # loud by design: a silent on-device fallback would claim the
-                # reference's "10x bigger models" capability
-                # (ZeRO-Offload, stage2.py:326-342) without delivering it
-                raise RuntimeError(
-                    "zero_optimization.cpu_offload=true but this backend has "
-                    "no pinned_host memory space") from e
-            self.master_sharding = NamedSharding(mesh, master_spec,
-                                                 memory_kind="pinned_host")
-        else:
-            self.master_sharding = NamedSharding(mesh, master_spec)
+                if mesh.devices.flat[0].platform != "cpu":
+                    # loud by design: a silent on-device fallback would
+                    # claim the reference's "10x bigger models" capability
+                    # (ZeRO-Offload, stage2.py:326-342) without delivering
+                    # it — only the CPU backend, where the default space
+                    # IS host memory, may fall through quietly
+                    raise RuntimeError(
+                        "zero_optimization.cpu_offload=true but this "
+                        "backend has no pinned_host memory space") from e
+                # eager-offload on CPU: host memory IS the default device
+                # memory, so the default space delivers the same
+                # placement semantics
+        # memory_kind=None selects the default space, so one expression
+        # covers pinned-host offload, eager offload, and no offload
+        self.master_sharding = NamedSharding(mesh, master_spec,
+                                             memory_kind=self._host_memory_kind)
         # same layout, device memory: the in-program stream-in target for
-        # offloaded buffers
-        self.master_device_sharding = NamedSharding(mesh, master_spec,
-                                                    memory_kind="device")
+        # offloaded buffers.  An explicit memory_kind="device" only names a
+        # real memory space on TPU; CPU backends expose a single default
+        # space and reject the kind outright, so fall back to the default
+        # sharding there (same placement either way).
+        self.master_device_sharding = (
+            NamedSharding(mesh, master_spec, memory_kind="device")
+            if self.injit_placement else NamedSharding(mesh, master_spec))
         self.grad_sharding = NamedSharding(mesh, grad_spec)
         self.replicated = NamedSharding(mesh, P())
 
@@ -153,7 +165,8 @@ class FlatParamCoordinator:
         # host-resident flat gradient buffer (offload_gradients): same
         # (rows, LANES) fp32 layout and grouping as the master
         self.grad_host_sharding = (
-            NamedSharding(mesh, grad_spec, memory_kind="pinned_host")
+            NamedSharding(mesh, grad_spec,
+                          memory_kind=self._host_memory_kind)
             if cpu_offload else None)
 
     def alloc_host_grads(self):

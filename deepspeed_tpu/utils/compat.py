@@ -1,0 +1,63 @@
+"""Cross-version jax API shims.
+
+The codebase targets the modern public ``jax.shard_map`` signature
+(``axis_names=...``, ``check_vma=...``).  On older jax (<= 0.4.x) that
+API lives at ``jax.experimental.shard_map.shard_map`` with the manual
+axes expressed inversely (``auto`` = the non-manual complement) and
+``check_vma`` spelled ``check_rep``; this wrapper translates.
+
+Partial-manual mode (a non-empty ``auto`` set) is unusable on the 0.4.x
+line: XLA's SPMD partitioner hard-aborts with an ``IsManualSubgroup``
+CHECK as soon as the region contains a ``ppermute`` and any auto axis
+has size > 1.  The shim therefore takes EVERY mesh axis manual on old
+jax — specs keep their meaning (``P()`` = replicated), so results are
+unchanged; operands sharded over would-be-auto axes are gathered at the
+region boundary instead of staying GSPMD-partitioned inside (a
+perf-only cost, and only on jax versions that lack the public API).
+
+Two caveats callers must respect on old jax, enforced at the two
+affected call sites:
+
+- differentiating THROUGH a shard_map whose backward needs a scalar
+  residual trips a transpose bug (mis-named residual -> ``_SpecError``,
+  or silently wrong values): the pipeline engine keeps its loss carry
+  1-D (runtime/pipe/engine.py), and ring attention — whose softmax
+  residuals cannot be controlled from outside — skips shard_map
+  entirely and computes the mathematically identical dense attention
+  under GSPMD (``PARTIAL_MANUAL_SHARD_MAP`` below).
+- regions that differentiate internally (engine sparse-grad step,
+  onebit/cpu-adam updates) are unaffected: nothing crosses the
+  boundary under AD.
+"""
+
+import jax
+
+if hasattr(jax.lax, "axis_size"):
+    axis_size = jax.lax.axis_size
+else:
+    def axis_size(axis_name):
+        # the pre-axis_size idiom: psum of a literal constant-folds to a
+        # static python int at trace time
+        return jax.lax.psum(1, axis_name)
+
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map_exp
+
+    def shard_map(f, mesh, in_specs, out_specs, axis_names=None,
+                  check_vma=True):
+        # axis_names accepted for signature parity; every axis goes manual
+        # (partial-manual mode aborts XLA on 0.4.x — see module docstring)
+        del axis_names
+        return _shard_map_exp(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs,
+                              check_rep=bool(check_vma))
+
+
+# True where jax supports manual collectives over a subset of mesh axes
+# with the rest left to GSPMD.  Ring attention requires that combination
+# when differentiated (see module docstring) and falls back to the dense
+# computation without it.
+PARTIAL_MANUAL_SHARD_MAP = hasattr(jax, "shard_map")

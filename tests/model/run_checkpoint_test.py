@@ -46,11 +46,11 @@ VOCAB = 2048
 SEQ = 32
 BATCH = 8
 
-CONFIGS = ("baseline", "zero1", "zero2", "zero2_offload", "pipeline",
-           "elastic_dp")
+CONFIGS = ("baseline", "zero1", "zero2", "zero2_async", "zero2_offload",
+           "pipeline", "elastic_dp")
 # legs that need >1 device (skipped on the single-chip TPU tier)
-MULTI_DEVICE = {"baseline": 2, "zero1": 2, "zero2": 2, "zero2_offload": 1,
-                "pipeline": 4, "elastic_dp": 4}
+MULTI_DEVICE = {"baseline": 2, "zero1": 2, "zero2": 2, "zero2_async": 2,
+                "zero2_offload": 1, "pipeline": 4, "elastic_dp": 4}
 
 
 def _ds_config(name, dp):
@@ -64,6 +64,12 @@ def _ds_config(name, dp):
         base["zero_optimization"] = {"stage": 1}
     elif name in ("zero2", "elastic_dp"):
         base["zero_optimization"] = {"stage": 2}
+    elif name == "zero2_async":
+        # the async checkpoint-subsystem leg: background commit +
+        # retention; save-then-process-exit must still land a complete
+        # checkpoint (non-daemon writer threads)
+        base["zero_optimization"] = {"stage": 2}
+        base["checkpoint"] = {"async_save": True, "keep_last_n": 2}
     elif name == "zero2_offload":
         base["zero_optimization"] = {"stage": 2, "cpu_offload": True}
     return base
@@ -73,7 +79,8 @@ def _dropout(name):
     # dropout ON where the leg pins the rng-stream restore (ustep); off
     # for legs where per-device generation order may differ across the
     # save/resume topology change
-    return 0.1 if name in ("baseline", "zero1", "zero2") else 0.0
+    return 0.1 if name in ("baseline", "zero1", "zero2",
+                           "zero2_async") else 0.0
 
 
 # ---------------------------------------------------------------- child

@@ -73,16 +73,18 @@ def test_qa_gate_fails_under_broken_mask():
 def test_checkpoint_resume_continuity_matrix():
     """Train -> save -> resume-in-a-fresh-process -> the resumed loss
     curve must match the uninterrupted run step-for-step (reference
-    ``tests/model/Megatron_GPT2/run_checkpoint_test.py``).  CPU tier runs
-    the three cheapest legs; the full 6-config matrix (incl. pipeline and
-    the elastic DP-degree change) is the standalone driver
+    ``tests/model/Megatron_GPT2/run_checkpoint_test.py``).  The
+    large-model checkpoint roundtrips all live in this slow tier; CPU
+    tier runs the cheapest legs plus the async checkpoint-subsystem leg,
+    and the full 7-config matrix (incl. pipeline and the elastic
+    DP-degree change) is the standalone driver
     ``tests/model/run_checkpoint_test.py``."""
     import tempfile
 
     from ..model import run_checkpoint_test as R
 
     with tempfile.TemporaryDirectory() as tmp:
-        for name in ("baseline", "zero2", "elastic_dp"):
+        for name in ("baseline", "zero2", "zero2_async", "elastic_dp"):
             R.run_config(name, steps=8, out_dir=tmp, force_cpu=True)
 
 

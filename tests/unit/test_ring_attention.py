@@ -151,3 +151,21 @@ def test_ring_attention_key_padding_mask(cpu_devices):
     ref = reference_attention(q, k, v, mask=jnp.asarray(kpm)[:, None, None, :])
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_dense_fallback_respects_custom_scale(cpu_devices):
+    """The single-shard/old-jax dense fallback must honor a caller scale
+    (reference_attention hard-codes 1/sqrt(d); the fallback pre-scales q)."""
+    mesh = make_mesh({"seq": 1}, devices=cpu_devices[:1])
+    q, k, v = _qkv(b=1, s=16, h=2, d=8, seed=5)
+    scale = 0.05
+    out = ring_attention(q, k, v, mesh=mesh, scale=scale)
+
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    ref = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    # and the default-scale output differs, i.e. scale isn't dropped
+    out_default = ring_attention(q, k, v, mesh=mesh)
+    assert not np.allclose(np.asarray(out), np.asarray(out_default))
