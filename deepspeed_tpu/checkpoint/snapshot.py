@@ -32,17 +32,24 @@ _NPZ_NATIVE = frozenset(
 _WIDTH_VIEW = {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}
 
 
-def owned_host_copy(leaf):
-    """Device array -> host numpy array that OWNS its memory.
+def ensure_owned(arr):
+    """Host array -> numpy array that OWNS its memory.
 
     On CPU backends ``jax.device_get`` can return a zero-copy view of a
     buffer the next (donating) step overwrites mid-async-write; TPU
     transfers already materialize a fresh owning array, so only views get
     the extra copy."""
-    arr = np.asarray(jax.device_get(leaf))
+    arr = np.asarray(arr)
     if arr.base is None and arr.flags.owndata:
         return arr
     return np.array(arr, copy=True)
+
+
+def owned_host_copy(leaf):
+    """Device array -> host numpy array that OWNS its memory (single-leaf
+    form; batch multi-leaf gathers with ONE ``jax.device_get`` of the
+    whole tree, then :func:`ensure_owned` per array)."""
+    return ensure_owned(jax.device_get(leaf))
 
 
 def encode_array(arr):
@@ -131,28 +138,41 @@ def capture_engine_snapshot(engine, tag, client_state=None, save_latest=True):
     optim_states = {"master": np.asarray(unpadded)}
     flat_opt, _ = jax.tree_util.tree_flatten_with_path(
         engine.state["opt"], is_leaf=lambda x: type(x) is tuple)
+    small = {}
     for path, leaf in flat_opt:
         key = tree_path_key(path)
         if type(leaf) is tuple or leaf.shape == engine.segments.shape:
             optim_states[f"opt/{key}"] = engine.flat.gather_master_unpadded(
                 leaf)
         else:
-            optim_states[f"opt/{key}"] = owned_host_copy(leaf)
+            small[f"opt/{key}"] = leaf
+    if small:
+        # non-flat leaves (step counters, per-rank scalars): ONE batched
+        # transfer instead of one blocking round-trip per leaf
+        optim_states.update({k: ensure_owned(v)
+                             for k, v in jax.device_get(small).items()})
 
     scale = engine.state["scale"]
+    # ONE transfer for every device scalar in the meta block: each
+    # separate device_get is its own blocking wire round-trip, and this
+    # gather runs with train_batch stalled behind it (dslint DSH203)
+    scalars = jax.device_get({
+        "skipped": engine.state["skipped"], "ustep": engine.state["ustep"],
+        "cur_scale": scale.cur_scale, "cur_iter": scale.cur_iter,
+        "last_overflow_iter": scale.last_overflow_iter,
+        "cur_hysteresis": scale.cur_hysteresis})
     meta = {
         "global_steps": engine.global_steps,
         "micro_steps": engine.micro_steps,
         "global_samples": engine.global_samples,
-        "skipped_steps": engine.skipped_steps,
+        "skipped_steps": int(scalars["skipped"]),
         "scale_state": {
-            "cur_scale": float(jax.device_get(scale.cur_scale)),
-            "cur_iter": int(jax.device_get(scale.cur_iter)),
-            "last_overflow_iter": int(jax.device_get(
-                scale.last_overflow_iter)),
-            "cur_hysteresis": int(jax.device_get(scale.cur_hysteresis)),
+            "cur_scale": float(scalars["cur_scale"]),
+            "cur_iter": int(scalars["cur_iter"]),
+            "last_overflow_iter": int(scalars["last_overflow_iter"]),
+            "cur_hysteresis": int(scalars["cur_hysteresis"]),
         },
-        "ustep": int(jax.device_get(engine.state["ustep"])),
+        "ustep": int(scalars["ustep"]),
         "lr_scheduler": (engine.lr_scheduler.state_dict()
                          if engine.lr_scheduler is not None else None),
         "dp_world_size": engine.dp_world_size,

@@ -135,6 +135,7 @@ def _dropout_thresh(rate):
     drop iff ``bits < thresh``.  Quantization error is < 2^-32, so the
     returned scale is unbiased for all practical purposes.
     """
+    # dslint: disable=DSH102 -- rate is a static kernel parameter (functools.partial-bound), never a tracer
     thresh = int(round(float(rate) * float(1 << 32)))
     thresh = min((1 << 32) - 1, max(1, thresh))
     keep_prob = 1.0 - thresh / float(1 << 32)
@@ -474,7 +475,7 @@ def _dropout_ops(dropout_rate, dropout_seed):
         seed = jnp.concatenate([seed, jnp.zeros((1,), jnp.int32)])
     assert seed.size == 2, f"dropout_seed must be 1 or 2 int32 words, got {seed.size}"
     return ((seed,), (pl.BlockSpec(memory_space=pltpu.SMEM),),
-            float(dropout_rate))
+            float(dropout_rate))  # dslint: disable=DSH102 -- dropout_rate rides custom_vjp nondiff_argnums: static by construction
 
 
 def _resolve_blocks(s, kv_len, d, block_q, block_k, causal=False,

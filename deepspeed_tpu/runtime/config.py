@@ -45,6 +45,15 @@ def get_bf16_enabled(param_dict):
     return False
 
 
+def get_amp_enabled(param_dict):
+    if C.AMP in param_dict:
+        amp = param_dict[C.AMP]
+        if isinstance(amp, bool):  # '"amp": true' shorthand
+            return amp
+        return get_scalar_param(amp, C.AMP_ENABLED, C.AMP_ENABLED_DEFAULT)
+    return C.AMP_ENABLED_DEFAULT
+
+
 def get_loss_scale(param_dict):
     if get_fp16_enabled(param_dict):
         return get_scalar_param(param_dict[C.FP16], C.FP16_LOSS_SCALE, C.FP16_LOSS_SCALE_DEFAULT)
@@ -233,6 +242,23 @@ class DeepSpeedConfig:
         else:
             self._param_dict = param_dict
 
+        # Unknown-key validation against the schema dslint extracts from
+        # the constants modules (tools/dslint/schema.py).  The reference's
+        # get_scalar_param lookups silently revert a misspelled key to its
+        # default; here it warns with a "did you mean" suggestion, and
+        # "strict_config": true upgrades the warning to a hard error.
+        from ..tools.dslint.schema import validate_config_dict
+
+        self.strict_config = bool(self._param_dict.get(
+            C.STRICT_CONFIG, C.STRICT_CONFIG_DEFAULT))
+        config_issues = validate_config_dict(self._param_dict)
+        for issue in config_issues:
+            logger.warning(f"DeepSpeedConfig: {issue.message}")
+        if self.strict_config and config_issues:
+            raise DeepSpeedConfigError(
+                "strict_config: rejected unknown configuration keys: "
+                + "; ".join(i.message for i in config_issues))
+
         # Data-parallel world size for the batch solver.  Priority: explicit
         # argument > mpu > mesh subsection > all visible devices.  (The
         # reference used torch.distributed world size / mpu,
@@ -306,6 +332,8 @@ class DeepSpeedConfig:
 
         self.disable_allgather = get_scalar_param(param_dict, C.DISABLE_ALLGATHER,
                                                   C.DISABLE_ALLGATHER_DEFAULT)
+        self.allgather_size = get_scalar_param(param_dict, C.ALLGATHER_SIZE,
+                                               C.ALLGATHER_SIZE_DEFAULT)
         self.allreduce_always_fp32 = get_scalar_param(param_dict, C.FP32_ALLREDUCE,
                                                       C.FP32_ALLREDUCE_DEFAULT)
         self.prescale_gradients = get_scalar_param(param_dict, C.PRESCALE_GRADIENTS,
@@ -325,6 +353,7 @@ class DeepSpeedConfig:
 
         self.fp16_enabled = get_fp16_enabled(param_dict)
         self.bf16_enabled = get_bf16_enabled(param_dict)
+        self.amp_enabled = get_amp_enabled(param_dict)
         self.loss_scale = get_loss_scale(param_dict)
         self.initial_dynamic_scale = get_initial_dynamic_scale(param_dict)
         self.dynamic_loss_scale_args = get_dynamic_loss_scale_args(param_dict)
@@ -358,6 +387,9 @@ class DeepSpeedConfig:
                                                      C.TENSORBOARD_JOB_NAME_DEFAULT)
 
         self.sparse_attention = get_sparse_attention(param_dict)
+        self.ring_attention_enabled = get_scalar_param(
+            param_dict.get(C.RING_ATTENTION, {}) or {},
+            C.RING_ATTENTION_ENABLED, C.RING_ATTENTION_ENABLED_DEFAULT)
         self.pipeline = get_pipeline_config(param_dict)
         self.pld_enabled = get_progressive_layer_drop(param_dict)["enabled"]
         self.pld_params = get_progressive_layer_drop(param_dict)
@@ -433,6 +465,13 @@ class DeepSpeedConfig:
                 f"{C.ZERO_OPTIMIZATION_GRADIENTS}")
         assert not (self.fp16_enabled and self.bf16_enabled), (
             "fp16 and bf16 modes are mutually exclusive")
+        if self.amp_enabled:
+            # the key parses (reference parity: config.py accepted an amp
+            # block) but the mode has no TPU analog — fail loudly rather
+            # than silently training full-precision
+            raise DeepSpeedConfigError(
+                "amp is a torch/apex mixed-precision mode with no TPU "
+                "analog; use bf16 (native) or fp16")
 
     def _do_warning_check(self):
         fp16_enabled = self.fp16_enabled

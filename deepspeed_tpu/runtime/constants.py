@@ -81,6 +81,7 @@ GRADIENT_CLIPPING_DEFAULT = 0.0
 #############################################
 # Communication / DP
 #############################################
+# dslint: disable=DSC401 -- reference-API alias of FP32_ALLREDUCE (same JSON key; parsing happens under that name)
 ALLREDUCE_ALWAYS_FP32 = "fp32_allreduce"
 DISABLE_ALLGATHER = "disable_allgather"
 DISABLE_ALLGATHER_DEFAULT = False
@@ -269,5 +270,14 @@ CHECKPOINT_SAVE_ON_PREEMPTION_DEFAULT = False
 RING_ATTENTION = "ring_attention"
 RING_ATTENTION_ENABLED = "enabled"
 RING_ATTENTION_ENABLED_DEFAULT = False
+
+#############################################
+# Config validation (dslint schema; new — reference config.py:432 only
+# checked a handful of keys by hand)
+#############################################
+# "strict_config": true turns unknown-key warnings (misspelled keys that
+# dict.get would silently default) into hard DeepSpeedConfigError
+STRICT_CONFIG = "strict_config"
+STRICT_CONFIG_DEFAULT = False
 
 ROUTE_PREFIX = "deepspeed"

@@ -89,12 +89,21 @@ class DynamicLossScaler(LossScalerBase):
         self.consecutive_hysteresis = consecutive_hysteresis
 
     def has_overflow_serial(self, params):
+        import jax
         import numpy as np
 
-        for p in params:
-            arr = np.asarray(p)
-            if not np.all(np.isfinite(arr)):
-                return True
+        params = list(params)
+        # Grouped batched transfer: one device_get per 32 leaves instead
+        # of one per leaf (the old form paid a blocking wire round-trip
+        # per parameter), while keeping host peak bounded to a group and
+        # the early exit on the first non-finite group — a single
+        # whole-model device_get would hold every leaf on host at once.
+        group = 32
+        for i in range(0, len(params), group):
+            # dslint: disable=DSH202 -- deliberately grouped: one transfer per 32 leaves bounds host memory and preserves early-exit
+            for arr in jax.device_get(params[i:i + group]):
+                if not np.all(np.isfinite(arr)):
+                    return True
         return False
 
     has_overflow = has_overflow_serial

@@ -1,0 +1,4 @@
+"""Developer tooling for DeepSpeed-TPU (kept import-light: nothing here
+may import jax — tools must work in environments without an accelerator
+stack, and ``runtime/config.py`` imports the config-schema validator from
+``tools.dslint.schema`` at engine-construction time)."""

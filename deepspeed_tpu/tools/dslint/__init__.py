@@ -1,0 +1,33 @@
+"""dslint — TPU-correctness static analysis for DeepSpeed-TPU.
+
+Three rule families (see ``docs/static_analysis.md``):
+
+- **hot-path** (DSH1xx/DSH2xx): host-sync and device-transfer
+  anti-patterns in code reachable from ``jax.jit``/``shard_map`` traces
+  and in step-cadence engine driver code;
+- **retrace** (DSR3xx): jit-cache hazards — mutable defaults, impure
+  captures, unhashable static args, Python branches on traced values;
+- **config-schema** (DSC4xx): the key/type/default schema extracted from
+  the constants modules, with dead-key detection and a runtime
+  ``validate_config_dict`` (unknown-key + "did you mean") that
+  ``DeepSpeedConfig`` calls on every construction.
+
+Suppression: ``# dslint: disable=<rule-id>[,<rule-id>...] [-- reason]``
+inline on the flagged line, or standalone on the line above.
+
+Stdlib-only by design — importable before jax, usable in any CI image.
+"""
+
+# importing the rule modules populates the registries
+from . import hotpath, retrace, schema  # noqa: F401
+from .cli import failing, lint_paths, main
+from .core import RULES, Diagnostic, Rule, register_rule, rule_catalog
+from .schema import (ConfigIssue, dead_key_diagnostics, extract_schema,
+                     get_schema, validate_config_dict)
+
+__all__ = [
+    "RULES", "Rule", "Diagnostic", "register_rule", "lint_paths",
+    "failing", "main", "extract_schema", "get_schema",
+    "validate_config_dict", "dead_key_diagnostics", "ConfigIssue",
+    "rule_catalog",
+]

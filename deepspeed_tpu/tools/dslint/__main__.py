@@ -1,0 +1,8 @@
+"""``python -m deepspeed_tpu.tools.dslint`` entry point."""
+
+import sys
+
+from .cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

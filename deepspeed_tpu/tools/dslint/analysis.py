@@ -1,0 +1,326 @@
+"""Module-level function index: scopes, call graph, jit-trace reachability.
+
+The hot-path and retrace rule families both need the same question
+answered: *which functions in this module execute under a jax trace?*
+A function is trace-rooted when it is decorated with (or passed to) a
+tracing wrapper — ``jax.jit``, ``shard_map``, ``pmap``, ``grad`` /
+``value_and_grad``, ``checkpoint``/``remat``, or a ``lax`` control-flow
+primitive — and everything reachable from a root through same-module
+calls (including bare-name references, which cover ``lax.scan(body, …)``
+styles) is *hot*.
+
+Functions handed to host-callback escapes (``pure_callback``,
+``io_callback``, ``jax.debug.*``) run on the HOST by design: they are
+excluded from the hot set even when referenced from hot code.
+
+Resolution is intentionally intra-module and name-based — no imports are
+followed.  That keeps the linter fast and dependency-free; cross-module
+reachability is approximated by every module's own roots being analyzed
+when that module is linted.
+"""
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .core import dotted_name
+
+# wrapper -> indices of positional args that are traced callables
+# (None index = every element of a list/tuple arg, for lax.switch)
+TRACE_WRAPPERS = {
+    "jit": (0,), "pmap": (0,), "shard_map": (0,), "vmap": (0,),
+    "grad": (0,), "value_and_grad": (0,), "checkpoint": (0,), "remat": (0,),
+    "scan": (0,), "while_loop": (0, 1), "fori_loop": (2,), "map": (0,),
+    "cond": (1, 2), "switch": (1,), "custom_vjp": (0,), "custom_jvp": (0,),
+}
+
+# callables whose function arguments execute on the host, not in the trace
+HOST_CALLBACK_WRAPPERS = {
+    "pure_callback", "io_callback", "callback", "debug_callback",
+}
+
+
+# names too generic to trust without a 'lax' qualifier (builtin map(),
+# dict-dispatch helpers named cond, ...)
+_GENERIC_WRAPPER_NAMES = {"map", "cond"}
+
+
+def _is_trace_wrapper(name: str) -> Optional[str]:
+    """'jax.jit' / 'jit' / 'jax.lax.scan' -> terminal wrapper name."""
+    leaf = name.rsplit(".", 1)[-1]
+    if leaf not in TRACE_WRAPPERS:
+        return None
+    if leaf in _GENERIC_WRAPPER_NAMES and "lax" not in name:
+        return None
+    return leaf
+
+
+def _is_host_callback(name: str) -> bool:
+    leaf = name.rsplit(".", 1)[-1]
+    return leaf in HOST_CALLBACK_WRAPPERS or name.startswith("jax.debug.")
+
+
+class FuncNode:
+    """One function/lambda definition in the module."""
+
+    __slots__ = ("node", "name", "qualname", "class_name", "scope",
+                 "parent", "is_property")
+
+    def __init__(self, node, name, qualname, class_name, parent):
+        self.node = node
+        self.name = name
+        self.qualname = qualname
+        self.class_name = class_name   # enclosing class, if a method
+        self.parent = parent           # enclosing FuncNode or None
+        self.scope: Dict[str, "FuncNode"] = {}  # functions defined inside
+        self.is_property = any(
+            dotted_name(d) in ("property", "functools.cached_property",
+                               "cached_property")
+            for d in getattr(node, "decorator_list", []))
+
+    def params(self) -> List[str]:
+        a = self.node.args
+        return [p.arg for p in a.posonlyargs + a.args]
+
+    def defaults_by_param(self) -> Dict[str, ast.expr]:
+        a = self.node.args
+        pos = a.posonlyargs + a.args
+        out = {}
+        for p, d in zip(pos[len(pos) - len(a.defaults):], a.defaults):
+            out[p.arg] = d
+        for p, d in zip(a.kwonlyargs, a.kw_defaults):
+            if d is not None:
+                out[p.arg] = d
+        return out
+
+
+class _Skip(Exception):
+    pass
+
+
+class ModuleIndex:
+    """Scoped function index + trace-reachability for one module."""
+
+    def __init__(self, tree: ast.Module):
+        self.tree = tree
+        self.functions: List[FuncNode] = []
+        self.module_scope: Dict[str, FuncNode] = {}
+        self.methods: Dict[str, Dict[str, FuncNode]] = {}  # class -> name->fn
+        self.classes: List[ast.ClassDef] = []
+        self.node_map: Dict[int, FuncNode] = {}  # id(ast node) -> FuncNode
+        self._build(tree)
+        self.roots: Set[FuncNode] = set()
+        self.host_exempt: Set[FuncNode] = set()
+        self._find_roots()
+        self.hot: Set[FuncNode] = self._closure(self.roots)
+
+    # -- construction ------------------------------------------------------
+
+    def _build(self, tree):
+        def walk(node, scope, class_name, parent):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    name = getattr(child, "name", "<lambda>")
+                    qual = (f"{class_name}.{name}" if class_name else name)
+                    fn = FuncNode(child, name, qual, class_name, parent)
+                    self.functions.append(fn)
+                    self.node_map[id(child)] = fn
+                    scope[name] = scope.get(name, fn)  # first def wins
+                    if class_name and parent is None:
+                        self.methods.setdefault(class_name, {})[name] = fn
+                    # function bodies open a new scope; decorators/defaults
+                    # evaluate in the enclosing one
+                    body = (child.body if not isinstance(child, ast.Lambda)
+                            else [child.body])
+                    for stmt in body if isinstance(body, list) else [body]:
+                        walk(stmt, fn.scope, None, fn)
+                elif isinstance(child, ast.ClassDef):
+                    self.classes.append(child)
+                    walk(child, {}, child.name, None)
+                else:
+                    walk(child, scope, class_name, parent)
+
+        walk(tree, self.module_scope, None, None)
+
+    # -- resolution --------------------------------------------------------
+
+    def resolve_name(self, name: str,
+                     from_fn: Optional[FuncNode]) -> Optional[FuncNode]:
+        fn = from_fn
+        while fn is not None:
+            if name in fn.scope:
+                return fn.scope[name]
+            fn = fn.parent
+        return self.module_scope.get(name)
+
+    def resolve_self_attr(self, attr: str,
+                          from_fn: Optional[FuncNode]) -> Optional[FuncNode]:
+        fn = from_fn
+        while fn is not None and fn.class_name is None:
+            fn = fn.parent
+        if fn is None:
+            return None
+        return self.methods.get(fn.class_name, {}).get(attr)
+
+    def _resolve_callable_expr(self, expr,
+                               from_fn: Optional[FuncNode]):
+        if isinstance(expr, ast.Lambda):
+            return self.node_map.get(id(expr))
+        if isinstance(expr, ast.Name):
+            return self.resolve_name(expr.id, from_fn)
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"):
+            return self.resolve_self_attr(expr.attr, from_fn)
+        return None
+
+    # -- roots -------------------------------------------------------------
+
+    def _decorator_roots(self, fn: FuncNode):
+        for dec in getattr(fn.node, "decorator_list", []):
+            name = dotted_name(dec)
+            if not name and isinstance(dec, ast.Call):
+                # @partial(jax.jit, ...) / @jax.jit(...) call-style
+                inner = dotted_name(dec.func)
+                if inner.rsplit(".", 1)[-1] == "partial" and dec.args:
+                    name = dotted_name(dec.args[0])
+                else:
+                    name = inner
+            if name and _is_trace_wrapper(name):
+                return True
+        return False
+
+    def _find_roots(self):
+        for fn in self.functions:
+            if self._decorator_roots(fn):
+                self.roots.add(fn)
+        enclosing = {}  # id(node) -> FuncNode owning it lexically
+
+        def mark(node, owner):
+            for child in ast.iter_child_nodes(node):
+                own = self.node_map.get(id(child), owner) \
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef, ast.Lambda)) \
+                    else owner
+                enclosing[id(child)] = owner
+                mark(child, own)
+
+        mark(self.tree, None)
+
+        for call in ast.walk(self.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            name = dotted_name(call.func)
+            owner = enclosing.get(id(call))
+            if _is_host_callback(name):
+                for arg in call.args:
+                    target = self._resolve_callable_expr(arg, owner)
+                    if target is not None:
+                        self.host_exempt.add(target)
+                continue
+            wrapper = _is_trace_wrapper(name)
+            if not wrapper:
+                continue
+            for idx in TRACE_WRAPPERS[wrapper]:
+                if idx >= len(call.args):
+                    continue
+                arg = call.args[idx]
+                if wrapper == "switch" and isinstance(arg, (ast.List,
+                                                            ast.Tuple)):
+                    cands = arg.elts
+                else:
+                    cands = [arg]
+                for cand in cands:
+                    target = self._resolve_callable_expr(cand, owner)
+                    if target is not None:
+                        self.roots.add(target)
+        self.roots -= self.host_exempt
+
+    # -- reachability ------------------------------------------------------
+
+    def edges_from(self, fn: FuncNode) -> Set[FuncNode]:
+        """Same-module call/reference edges from ``fn``'s own body (nested
+        function bodies are their own nodes; host-callback arguments are
+        not edges)."""
+        out: Set[FuncNode] = set()
+
+        def walk(node, top=False):
+            if not top and id(node) in self.node_map:
+                return  # nested def: its body is its own FuncNode
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                if _is_host_callback(name):
+                    walk(node.func)
+                    return  # don't follow args into the host escape
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                target = self.resolve_name(node.id, fn)
+                if target is not None:
+                    out.add(target)
+            elif (isinstance(node, ast.Attribute)
+                  and isinstance(node.value, ast.Name)
+                  and node.value.id == "self"):
+                target = self.resolve_self_attr(node.attr, fn)
+                if target is not None:
+                    out.add(target)
+            for child in ast.iter_child_nodes(node):
+                walk(child)
+
+        walk(fn.node, top=True)
+        return out - {fn}
+
+    def _closure(self, seeds: Set[FuncNode]) -> Set[FuncNode]:
+        seen = set(seeds)
+        frontier = list(seeds)
+        while frontier:
+            fn = frontier.pop()
+            for nxt in self.edges_from(fn):
+                if nxt not in seen and nxt not in self.host_exempt:
+                    seen.add(nxt)
+                    frontier.append(nxt)
+        return seen
+
+
+def body_nodes(fn: FuncNode, node_map):
+    """Yield (node, in_loop) over ``fn``'s own body, excluding nested
+    function/lambda bodies (they are separate FuncNodes).  ``in_loop`` is
+    per-*iteration* precise: a ``for``'s iterable and a comprehension's
+    first source evaluate once and are NOT in-loop."""
+
+    def walk(node, in_loop, top=False):
+        if not top and id(node) in node_map:
+            return
+        yield node, in_loop
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            yield from walk(node.target, in_loop)
+            yield from walk(node.iter, in_loop)
+            for child in node.body + node.orelse:
+                yield from walk(child, True)
+        elif isinstance(node, ast.While):
+            yield from walk(node.test, True)
+            for child in node.body + node.orelse:
+                yield from walk(child, True)
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                               ast.GeneratorExp)):
+            gens = node.generators
+            yield from walk(gens[0].iter, in_loop)
+            for g in gens:
+                yield from walk(g.target, True)
+                for cond in g.ifs:
+                    yield from walk(cond, True)
+            for g in gens[1:]:
+                yield from walk(g.iter, True)
+            if isinstance(node, ast.DictComp):
+                yield from walk(node.key, True)
+                yield from walk(node.value, True)
+            else:
+                yield from walk(node.elt, True)
+        else:
+            for child in ast.iter_child_nodes(node):
+                yield from walk(child, in_loop)
+
+    root = fn.node
+    if isinstance(root, ast.Lambda):
+        yield from walk(root.body, False)
+    else:
+        for stmt in root.body:
+            yield from walk(stmt, False)

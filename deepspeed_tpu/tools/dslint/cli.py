@@ -1,0 +1,173 @@
+"""dslint CLI.
+
+    python -m deepspeed_tpu.tools.dslint deepspeed_tpu/
+    python -m deepspeed_tpu.tools.dslint --config ds_config.json
+    python -m deepspeed_tpu.tools.dslint --list-rules
+    python -m deepspeed_tpu.tools.dslint deepspeed_tpu/ --json report.json
+
+Exit status: 0 when no unsuppressed error/warning diagnostics, 1 when
+violations exist, 2 on usage/parse errors.
+"""
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+# rule modules register their checkers on import
+from . import hotpath, retrace  # noqa: F401
+from .core import (Diagnostic, FAILING_SEVERITIES, RULES, ParsedFile,
+                   check_file, rule_catalog)
+from .schema import (dead_key_diagnostics, get_schema,
+                     issues_to_diagnostics, validate_config_dict)
+
+
+def iter_python_files(paths) -> List[str]:
+    out = []
+    for path in paths:
+        if os.path.isfile(path):
+            out.append(path)
+        elif os.path.isdir(path):
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git", "build",
+                                            "node_modules")]
+                out.extend(os.path.join(dirpath, f)
+                           for f in sorted(filenames) if f.endswith(".py"))
+        else:
+            raise FileNotFoundError(path)
+    return sorted(set(out))
+
+
+def lint_paths(paths, select=None, ignore=None) -> List[Diagnostic]:
+    """Lint files/dirs; returns all diagnostics (suppressed ones marked)."""
+    return lint_files(iter_python_files(paths), select=select,
+                      ignore=ignore)
+
+
+def lint_files(files, select=None, ignore=None) -> List[Diagnostic]:
+    """Lint an explicit file list.
+
+    The dead-key cross-check runs once when the scanned set includes the
+    package's ``runtime/constants.py`` (i.e. when linting the package
+    itself rather than a stray file).
+    """
+    diags: List[Diagnostic] = []
+    constants_file = None
+    for path in files:
+        try:
+            pf = ParsedFile.parse(path)
+        except SyntaxError as e:
+            diags.append(Diagnostic(path=path, line=e.lineno or 1, col=1,
+                                    rule_id="DSC402",
+                                    message=f"file does not parse: {e.msg}"))
+            continue
+        diags.extend(check_file(pf))
+        norm = path.replace(os.sep, "/")
+        if norm.endswith("runtime/constants.py"):
+            constants_file = os.path.abspath(path)
+    if constants_file is not None:
+        pkg_root = os.path.dirname(os.path.dirname(constants_file))
+        dead = dead_key_diagnostics(pkg_root)
+        src = open(constants_file, "r", encoding="utf-8").read()
+        pf = ParsedFile.parse(constants_file, src)
+        pf.apply_suppressions(dead)
+        diags.extend(dead)
+    if select:
+        diags = [d for d in diags if d.rule_id in select]
+    if ignore:
+        diags = [d for d in diags if d.rule_id not in ignore]
+    return diags
+
+
+def failing(diags) -> List[Diagnostic]:
+    return [d for d in diags
+            if not d.suppressed and d.severity in FAILING_SEVERITIES]
+
+
+def lint_config_files(paths) -> List[Diagnostic]:
+    diags = []
+    for path in paths:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                cfg = json.load(f)
+        except (OSError, ValueError) as e:
+            diags.append(Diagnostic(path=path, line=1, col=1,
+                                    rule_id="DSC402",
+                                    message=f"config does not load: {e}"))
+            continue
+        diags.extend(issues_to_diagnostics(validate_config_dict(cfg), path))
+    return diags
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dslint",
+        description="TPU-correctness static analysis for DeepSpeed-TPU: "
+                    "hot-path host-sync rules, retrace-hazard rules, and "
+                    "config-schema validation.")
+    ap.add_argument("paths", nargs="*",
+                    help="python files/directories to lint")
+    ap.add_argument("--config", action="append", default=[],
+                    metavar="JSON",
+                    help="validate a DeepSpeed JSON config file against "
+                         "the extracted schema")
+    ap.add_argument("--json", metavar="FILE", dest="json_out",
+                    help="write a machine-readable report")
+    ap.add_argument("--select", metavar="IDS",
+                    help="comma-separated rule ids to run exclusively")
+    ap.add_argument("--ignore", metavar="IDS",
+                    help="comma-separated rule ids to skip")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also print pragma-suppressed diagnostics")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        print(rule_catalog())
+        return 0
+    if not args.paths and not args.config:
+        ap.print_usage(sys.stderr)
+        return 2
+
+    select = set(args.select.split(",")) if args.select else None
+    ignore = set(args.ignore.split(",")) if args.ignore else None
+    try:
+        files = iter_python_files(args.paths) if args.paths else []
+    except FileNotFoundError as e:
+        print(f"dslint: no such path: {e}", file=sys.stderr)
+        return 2
+    diags = lint_files(files, select=select, ignore=ignore)
+    diags.extend(lint_config_files(args.config))
+    diags.sort(key=lambda d: (d.path, d.line, d.col, d.rule_id))
+
+    fail = failing(diags)
+    suppressed = [d for d in diags if d.suppressed]
+    for d in diags:
+        if d.suppressed and not args.show_suppressed:
+            continue
+        print(d.format())
+    print(f"dslint: {len(fail)} violation(s), {len(suppressed)} "
+          f"suppressed, {len(files)} file(s) scanned, "
+          f"{len(RULES)} rules")
+
+    if args.json_out:
+        report = {
+            "violations": len(fail),
+            "suppressed": len(suppressed),
+            "files_scanned": len(files),
+            "schema_keys": len(get_schema().all_keys()),
+            "diagnostics": [d.to_json() for d in diags],
+            "rules": {r.id: {"name": r.name, "severity": r.severity,
+                             "summary": r.summary}
+                      for r in RULES.values()},
+        }
+        with open(args.json_out, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+    return 1 if fail else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

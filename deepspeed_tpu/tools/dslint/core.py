@@ -1,0 +1,206 @@
+"""dslint core: rule registry, diagnostics, suppression pragmas, file model.
+
+``dslint`` is an AST-level linter for the TPU-correctness hazards that
+JSON-dict config systems and jit-compiled training loops make *silent*:
+a misspelled config key quietly reverts to its default, a stray
+``.item()`` inside a compiled step quietly costs a device→host round
+trip every step, and a retrace hazard quietly recompiles a minute-long
+program.  Rules are small, registered objects so a new hazard class is a
+~20-line addition (see ``docs/static_analysis.md``).
+
+Everything in this package is stdlib-only (``ast`` + ``tokenize``-free
+line scanning): the linter must run in CI images and pre-commit hooks
+that have no jax installed.
+"""
+
+import ast
+import dataclasses
+import re
+from typing import Callable, Dict, List, Optional
+
+# ---------------------------------------------------------------------------
+# Rules
+# ---------------------------------------------------------------------------
+
+SEVERITIES = ("error", "warning", "info")
+# severities that make the CLI exit non-zero when unsuppressed
+FAILING_SEVERITIES = ("error", "warning")
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    """One checkable hazard class."""
+
+    id: str                 # e.g. "DSH101"
+    name: str               # kebab-case slug, e.g. "hot-item-sync"
+    severity: str           # "error" | "warning" | "info"
+    summary: str            # one-line message template context
+    rationale: str          # why this is a TPU-correctness hazard
+    autofix_hint: str = ""  # how a human (or tool) repairs it
+
+    def __post_init__(self):
+        assert self.severity in SEVERITIES, self.severity
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def register_rule(rule: Rule) -> Rule:
+    assert rule.id not in RULES, f"duplicate rule id {rule.id}"
+    RULES[rule.id] = rule
+    return rule
+
+
+@dataclasses.dataclass
+class Diagnostic:
+    """One finding at a source location."""
+
+    path: str
+    line: int
+    col: int
+    rule_id: str
+    message: str
+    end_line: Optional[int] = None
+    suppressed: bool = False
+
+    @property
+    def severity(self) -> str:
+        return RULES[self.rule_id].severity
+
+    def format(self) -> str:
+        state = " (suppressed)" if self.suppressed else ""
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule_id} "
+                f"[{self.severity}]{state} {self.message}")
+
+    def to_json(self) -> dict:
+        return {
+            "path": self.path, "line": self.line, "col": self.col,
+            "rule": self.rule_id, "severity": self.severity,
+            "message": self.message, "suppressed": self.suppressed,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Suppression pragmas
+# ---------------------------------------------------------------------------
+#
+#   x = arr.item()          # dslint: disable=DSH101 -- reason (optional)
+#   # dslint: disable=DSH101,DSC401   <- standalone: applies to next line
+#
+# A pragma suppresses matching diagnostics on its own physical line; a
+# standalone (comment-only) pragma line additionally covers the line below
+# it, so long statements can carry the pragma above themselves.
+
+_PRAGMA_RE = re.compile(
+    r"#\s*dslint:\s*disable=([A-Za-z0-9_*,\s]+?)(?:\s*--.*)?$")
+
+
+def collect_pragmas(source: str) -> Dict[int, set]:
+    """Map line number (1-based) -> set of suppressed rule ids ('all' ok)."""
+    pragmas: Dict[int, set] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _PRAGMA_RE.search(line)
+        if not m:
+            continue
+        ids = {tok.strip() for tok in m.group(1).split(",") if tok.strip()}
+        pragmas.setdefault(i, set()).update(ids)
+        if line.lstrip().startswith("#"):
+            # standalone pragma line: also covers the statement below
+            pragmas.setdefault(i + 1, set()).update(ids)
+    return pragmas
+
+
+def is_suppressed(pragmas: Dict[int, set], rule_id: str, line: int,
+                  end_line: Optional[int] = None) -> bool:
+    """A diagnostic is suppressed when any physical line of its statement
+    carries a matching pragma."""
+    for ln in range(line, (end_line or line) + 1):
+        ids = pragmas.get(ln)
+        if ids and (rule_id in ids or "all" in ids):
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Parsed-file model + checker registry
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ParsedFile:
+    path: str
+    source: str
+    tree: ast.AST
+    pragmas: Dict[int, set]
+
+    @classmethod
+    def parse(cls, path: str, source: Optional[str] = None) -> "ParsedFile":
+        if source is None:
+            with open(path, "r", encoding="utf-8") as f:
+                source = f.read()
+        tree = ast.parse(source, filename=path)
+        return cls(path=path, source=source, tree=tree,
+                   pragmas=collect_pragmas(source))
+
+    def apply_suppressions(self,
+                           diags: List[Diagnostic]) -> List[Diagnostic]:
+        for d in diags:
+            d.suppressed = is_suppressed(self.pragmas, d.rule_id, d.line,
+                                         d.end_line)
+        return diags
+
+
+# per-file checkers: fn(ParsedFile) -> list[Diagnostic]
+FILE_CHECKERS: List[Callable[[ParsedFile], List[Diagnostic]]] = []
+
+
+def register_file_checker(fn):
+    FILE_CHECKERS.append(fn)
+    return fn
+
+
+def check_file(pf: ParsedFile) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    for checker in FILE_CHECKERS:
+        diags.extend(checker(pf))
+    pf.apply_suppressions(diags)
+    diags.sort(key=lambda d: (d.line, d.col, d.rule_id))
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# Small AST helpers shared by the rule modules
+# ---------------------------------------------------------------------------
+
+def dotted_name(node) -> str:
+    """'jax.lax.scan' for nested Attribute/Name chains, '' otherwise."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def call_name(call: ast.Call) -> str:
+    return dotted_name(call.func)
+
+
+def diag(pf: ParsedFile, node, rule_id: str, message: str) -> Diagnostic:
+    return Diagnostic(path=pf.path, line=node.lineno,
+                      col=getattr(node, "col_offset", 0) + 1,
+                      rule_id=rule_id, message=message,
+                      end_line=getattr(node, "end_lineno", None))
+
+
+def rule_catalog() -> str:
+    """Human-readable rule table (also: ``--list-rules``)."""
+    lines = []
+    for rule in sorted(RULES.values(), key=lambda r: r.id):
+        lines.append(f"{rule.id} [{rule.severity}] {rule.name}")
+        lines.append(f"    {rule.summary}")
+        lines.append(f"    why: {rule.rationale}")
+        if rule.autofix_hint:
+            lines.append(f"    fix: {rule.autofix_hint}")
+    return "\n".join(lines)

@@ -1,0 +1,220 @@
+"""Retrace-hazard rules.
+
+``jax.jit`` caches compiled programs keyed on (treedef, shapes, dtypes,
+static-arg *values*).  Anything that perturbs that key — or that the
+trace captures by Python reference and silently freezes — either
+recompiles a minutes-long program mid-training or trains on stale
+state.  These rules flag the statically-detectable shapes of that bug.
+"""
+
+import ast
+from typing import List
+
+from .analysis import ModuleIndex, body_nodes
+from .core import (ParsedFile, Rule, call_name, diag,
+                   register_file_checker, register_rule)
+
+register_rule(Rule(
+    id="DSR301", name="retrace-mutable-default", severity="warning",
+    summary="dict/list/set default argument on a jitted callable",
+    rationale="A mutable default is one shared object across calls: "
+              "mutating it changes traced behavior without retriggering "
+              "a trace, and passing it as a static arg fails hashing.",
+    autofix_hint="Default to None and construct inside, or use a tuple / "
+                 "frozen structure."))
+
+register_rule(Rule(
+    id="DSR302", name="retrace-static-unhashable", severity="error",
+    summary="static_argnums/static_argnames names a missing or "
+            "non-hashable parameter",
+    rationale="Static args are hashed into the jit cache key: a "
+              "list/dict static arg raises TypeError at call time, and "
+              "an out-of-range index marks the wrong parameter static — "
+              "retracing on every distinct value.",
+    autofix_hint="Point at a hashable (tuple/str/int) parameter; check "
+                 "indices after signature changes."))
+
+register_rule(Rule(
+    id="DSR303", name="retrace-impure-capture", severity="warning",
+    summary="jit-traced code mutates external Python state",
+    rationale="global/self-attribute writes and module-level RNG calls "
+              "inside a trace run ONCE at trace time, not per step: the "
+              "mutation silently stops happening, and captured state "
+              "goes stale across retraces.",
+    autofix_hint="Thread state through function arguments/returns; use "
+                 "jax.random with explicit keys."))
+
+register_rule(Rule(
+    id="DSR304", name="retrace-traced-branch", severity="warning",
+    summary="Python if/while on a traced argument of a jitted callable",
+    rationale="`if array:` forces bool() on a tracer "
+              "(ConcretizationTypeError) — or, with static/weak types, "
+              "silently traces only one branch.",
+    autofix_hint="Use jnp.where / lax.cond / lax.select for data-"
+                 "dependent control flow."))
+
+_MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                     ast.DictComp, ast.SetComp)
+_MUTABLE_CTORS = {"dict", "list", "set", "bytearray", "defaultdict",
+                  "Counter", "OrderedDict"}
+_RNG_CALLS = {"random.random", "random.randint", "random.uniform",
+              "random.choice", "random.shuffle", "random.seed"}
+_NP_RNG_PREFIXES = ("np.random.", "numpy.random.")
+
+
+def _is_mutable_default(node) -> bool:
+    if isinstance(node, _MUTABLE_LITERALS):
+        return True
+    return (isinstance(node, ast.Call)
+            and call_name(node).rsplit(".", 1)[-1] in _MUTABLE_CTORS)
+
+
+def _jit_call_targets(index: ModuleIndex):
+    """(call_node, FuncNode, wrapper) for jit/pmap call-forms whose target
+    resolves in-module — the sites where static_argnums can be checked."""
+    enclosing = {}
+
+    def mark(node, owner):
+        for child in ast.iter_child_nodes(node):
+            enclosing[id(child)] = owner
+            own = index.node_map.get(id(child), owner) \
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda)) else owner
+            mark(child, own)
+
+    mark(index.tree, None)
+    out = []
+    for call in ast.walk(index.tree):
+        if not isinstance(call, ast.Call):
+            continue
+        leaf = call_name(call).rsplit(".", 1)[-1]
+        if leaf not in ("jit", "pmap") or not call.args:
+            continue
+        target = index._resolve_callable_expr(call.args[0],
+                                              enclosing.get(id(call)))
+        if target is not None:
+            out.append((call, target, leaf))
+    return out
+
+
+def _static_arg_diags(pf: ParsedFile, call: ast.Call, target) -> List:
+    out = []
+    params = target.params()
+    defaults = target.defaults_by_param()
+    # bound self.method references hide the self slot from argnums;
+    # a plain in-class function passed by local name does not, but jit'd
+    # inner functions in this codebase are closures, not methods — treat
+    # the declared parameter list as the signature.
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            indices = []
+            vals = (kw.value.elts if isinstance(kw.value, (ast.Tuple,
+                                                           ast.List))
+                    else [kw.value])
+            for v in vals:
+                if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                    indices.append(v.value)
+            for idx in indices:
+                if idx >= len(params) and not (target.node.args.vararg):
+                    out.append(diag(
+                        pf, kw.value, "DSR302",
+                        f"static_argnums index {idx} is out of range for "
+                        f"'{target.qualname}' ({len(params)} positional "
+                        "parameters) — a stale index after a signature "
+                        "change marks the wrong argument static"))
+                elif idx < len(params):
+                    d = defaults.get(params[idx])
+                    if d is not None and _is_mutable_default(d):
+                        out.append(diag(
+                            pf, kw.value, "DSR302",
+                            f"static_argnums marks parameter "
+                            f"'{params[idx]}' of '{target.qualname}' "
+                            "static, but its default is unhashable "
+                            "(dict/list): TypeError at call time"))
+        elif kw.arg == "static_argnames":
+            names = []
+            vals = (kw.value.elts if isinstance(kw.value, (ast.Tuple,
+                                                           ast.List))
+                    else [kw.value])
+            for v in vals:
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    names.append(v.value)
+            all_names = params + [a.arg for a in target.node.args.kwonlyargs]
+            for nm in names:
+                if nm not in all_names and not target.node.args.kwarg:
+                    out.append(diag(
+                        pf, kw.value, "DSR302",
+                        f"static_argnames names '{nm}' which is not a "
+                        f"parameter of '{target.qualname}'"))
+                else:
+                    d = target.defaults_by_param().get(nm)
+                    if d is not None and _is_mutable_default(d):
+                        out.append(diag(
+                            pf, kw.value, "DSR302",
+                            f"static_argnames marks '{nm}' of "
+                            f"'{target.qualname}' static, but its default "
+                            "is unhashable (dict/list)"))
+    return out
+
+
+@register_file_checker
+def check_retrace(pf: ParsedFile) -> List:
+    index = ModuleIndex(pf.tree)
+    out = []
+
+    # DSR301/DSR304 apply to the direct jit entry points
+    for fn in sorted(index.roots, key=lambda f: f.node.lineno):
+        if isinstance(fn.node, ast.Lambda):
+            continue
+        nondefault_params = set(fn.params()) - set(fn.defaults_by_param())
+        for pname, d in fn.defaults_by_param().items():
+            if _is_mutable_default(d):
+                out.append(diag(
+                    pf, d, "DSR301",
+                    f"parameter '{pname}' of jitted '{fn.qualname}' "
+                    "defaults to a mutable dict/list/set: shared across "
+                    "traces and unhashable as a static arg"))
+        for node, _ in body_nodes(fn, index.node_map):
+            if (isinstance(node, (ast.If, ast.While))
+                    and isinstance(node.test, ast.Name)
+                    and node.test.id in nondefault_params):
+                out.append(diag(
+                    pf, node, "DSR304",
+                    f"Python branch on traced argument "
+                    f"'{node.test.id}' in jitted '{fn.qualname}': bool() "
+                    "of a tracer; use jnp.where/lax.cond"))
+
+    # DSR303 applies to everything executing under a trace
+    for fn in sorted(index.hot, key=lambda f: f.node.lineno):
+        for node, _ in body_nodes(fn, index.node_map):
+            if isinstance(node, ast.Global):
+                out.append(diag(
+                    pf, node, "DSR303",
+                    f"'global {', '.join(node.names)}' inside jit-traced "
+                    f"'{fn.qualname}': the write happens at trace time "
+                    "only"))
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        out.append(diag(
+                            pf, node, "DSR303",
+                            f"assignment to self.{t.attr} inside "
+                            f"jit-traced '{fn.qualname}': mutation runs "
+                            "once at trace time, not per step"))
+            elif isinstance(node, ast.Call):
+                name = call_name(node)
+                if name in _RNG_CALLS or name.startswith(_NP_RNG_PREFIXES):
+                    out.append(diag(
+                        pf, node, "DSR303",
+                        f"{name}() inside jit-traced '{fn.qualname}': "
+                        "module-level RNG freezes at trace time; use "
+                        "jax.random with explicit keys"))
+
+    # DSR302 at jit call sites
+    for call, target, _ in _jit_call_targets(index):
+        out.extend(_static_arg_diags(pf, call, target))
+    return out

@@ -1,0 +1,346 @@
+"""Config-schema extraction + validation.
+
+The reference DeepSpeed config is a loosely-typed JSON dict read through
+``dict.get(key, default)``: a misspelled key silently reverts to its
+default.  This module derives the canonical key/type/default schema
+*statically* from the package's own constants modules (the ``KEY =
+"literal"`` / ``KEY_DEFAULT = value`` pairs in ``runtime/constants.py``
+and the feature-config modules), then:
+
+- ``validate_config_dict()`` — runtime unknown-key detection with
+  difflib "did you mean" suggestions, called from ``DeepSpeedConfig``
+  (warn by default; ``"strict_config": true`` raises), and
+
+- ``dead_key_diagnostics()`` — the static inverse: every declared key
+  constant must be *read* somewhere in the package, else declaring it
+  was a lie (DSC401).
+
+Stdlib-only and import-free with respect to the package itself: the
+constants modules are parsed as AST, never imported, so the validator
+works before (and independently of) jax initialization.
+"""
+
+import ast
+import difflib
+import os
+import re
+from typing import Dict, List, NamedTuple, Optional
+
+from .core import Diagnostic, Rule, register_rule
+
+register_rule(Rule(
+    id="DSC401", name="config-dead-key", severity="warning",
+    summary="declared config-key constant is never read by the package",
+    rationale="A declared-but-unread key is worse than an unknown one: "
+              "users set it, the dict carries it, and nothing ever "
+              "honors it — the exact silent-default failure mode this "
+              "schema exists to kill.",
+    autofix_hint="Wire the key into the config parser, or delete the "
+                 "constant; suppress only documented parity "
+                 "placeholders."))
+
+register_rule(Rule(
+    id="DSC402", name="config-unknown-key", severity="error",
+    summary="unknown config key (possible misspelling)",
+    rationale="dict.get(key, default) lookups silently revert misspelled "
+              "keys to defaults — e.g. 'gradient_acumulation_steps' "
+              "trains with accumulation 1 and nobody notices.",
+    autofix_hint="Fix the spelling (see the suggestion) or add the key "
+                 "to the schema's constants module."))
+
+
+class KeyInfo(NamedTuple):
+    key: str                  # JSON key string
+    const_name: str           # python constant name
+    section: Optional[str]    # None = top-level
+    default: object           # extracted literal (None if no *_DEFAULT)
+    has_default: bool
+    source: str               # module path the constant came from
+    line: int
+
+
+class ConfigSchema(NamedTuple):
+    top_level: Dict[str, KeyInfo]
+    sections: Dict[str, Dict[str, KeyInfo]]
+
+    def all_keys(self) -> Dict[str, KeyInfo]:
+        out = dict(self.top_level)
+        for sec in self.sections.values():
+            out.update(sec)
+        return out
+
+
+class ConfigIssue(NamedTuple):
+    key: str
+    section: Optional[str]    # section the unknown key appeared under
+    suggestion: Optional[str]
+    message: str
+
+
+# ---------------------------------------------------------------------------
+# Extraction
+# ---------------------------------------------------------------------------
+
+def package_root() -> str:
+    """deepspeed_tpu/ directory (this file is tools/dslint/schema.py)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+# (relative module, default section for unprefixed names, names that are
+# plain value-constants rather than config keys)
+_CONSTANT_MODULES = (
+    ("runtime/constants.py", None, {
+        # optimizer names / zero stage ints / modes: values, not keys
+        "ADAM_OPTIMIZER", "LAMB_OPTIMIZER", "ONEBIT_ADAM_OPTIMIZER",
+        "DEEPSPEED_OPTIMIZERS", "SPARSE_DENSE_MODE", "SPARSE_FIXED_MODE",
+        "SPARSE_VARIABLE_MODE", "SPARSE_BIGBIRD_MODE",
+        "SPARSE_BSLONGFORMER_MODE", "ROUTE_PREFIX",
+    }),
+    ("runtime/activation_checkpointing/config.py", "activation_checkpointing",
+     set()),
+    ("profiling/config.py", "flops_profiler", set()),
+    ("elasticity/constants.py", "elasticity", {
+        "MINIMUM_DEEPSPEED_VERSION", "DEEPSPEED_ELASTICITY_CONFIG",
+    }),
+)
+
+# constant-name prefix -> config section (for runtime/constants.py, whose
+# single module declares keys for many JSON subsections)
+_SECTION_PREFIXES = (
+    ("FP16_", "fp16"), ("BF16_", "bf16"), ("AMP_", "amp"),
+    ("TENSORBOARD_", "tensorboard"), ("ZERO_", "zero_optimization"),
+    ("PIPELINE_", "pipeline"), ("PLD_", "progressive_layer_drop"),
+    ("MESH_", "mesh"), ("SPARSE_", "sparse_attention"),
+    ("CHECKPOINT_", "checkpoint"), ("RING_ATTENTION_", "ring_attention"),
+    ("ACT_CHKPT_", "activation_checkpointing"),
+    ("FLOPS_PROFILER_", "flops_profiler"),
+)
+
+# prefixed names that are nonetheless TOP-LEVEL json keys
+_TOP_LEVEL_OVERRIDES = {
+    "ZERO_ALLOW_UNTESTED_OPTIMIZER", "SPARSE_GRADIENTS",
+    # section names themselves (FP16 = "fp16", ...) carry no underscore
+    # prefix and fall through to top-level naturally
+}
+
+# exact-name section placements the prefix convention cannot express
+_SECTION_NAME_OVERRIDES = {
+    "LEGACY_FUSION": "optimizer", "TYPE": "optimizer",
+    "OPTIMIZER_PARAMS": "optimizer", "SCHEDULER_PARAMS": "scheduler",
+    "MAX_GRAD_NORM": "optimizer",
+}
+
+# keys read straight off the top-level dict without a constant (raw
+# ``param_dict.get("...")`` sites in runtime/config.py + engine.py)
+SUPPLEMENTAL_TOP_LEVEL_KEYS = ("seed", "prng_impl", "vocabulary_size")
+
+# sections whose sub-schema is hand-listed (their keys live inline in
+# config parsing, not as prefixed constants)
+_EXPLICIT_SECTIONS = {
+    "optimizer": ("type", "params", "legacy_fusion"),
+    "scheduler": ("type", "params"),
+}
+
+# dict-valued sections whose *contents* are free-form (validated by their
+# consumers, not by key-schema): optimizer/scheduler params already nest
+# under 'params' which we skip.
+_FREEFORM_SUBKEYS = {"params"}
+
+
+def _parse_constants(path: str):
+    """(name -> (string_value, line)) and (name -> default literal)."""
+    with open(path, "r", encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    strings, defaults, env = {}, {}, {}
+    _missing = object()
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        name = node.targets[0].id
+        try:
+            value = ast.literal_eval(node.value)
+        except (ValueError, SyntaxError):
+            # aliased constants (ZERO_STAGE_DEFAULT =
+            # ZERO_OPTIMIZATION_DISABLED) resolve through the module's own
+            # earlier literal bindings
+            if isinstance(node.value, ast.Name):
+                value = env.get(node.value.id, _missing)
+                if value is _missing:
+                    continue
+            else:
+                continue
+        env[name] = value
+        if name.endswith("_DEFAULT"):
+            defaults[name] = value
+        elif isinstance(value, str):
+            strings[name] = (value, node.lineno)
+    return strings, defaults
+
+
+def extract_schema(root: Optional[str] = None) -> ConfigSchema:
+    root = root or package_root()
+    top: Dict[str, KeyInfo] = {}
+    sections: Dict[str, Dict[str, KeyInfo]] = {}
+
+    for rel, default_section, excluded in _CONSTANT_MODULES:
+        path = os.path.join(root, rel)
+        if not os.path.isfile(path):
+            continue
+        strings, defaults = _parse_constants(path)
+        for name, (key, line) in strings.items():
+            if name in excluded:
+                continue
+            section = default_section
+            if rel == "runtime/constants.py":
+                if name in _TOP_LEVEL_OVERRIDES:
+                    section = None
+                elif name in _SECTION_NAME_OVERRIDES:
+                    section = _SECTION_NAME_OVERRIDES[name]
+                else:
+                    for prefix, sec in _SECTION_PREFIXES:
+                        if name.startswith(prefix):
+                            section = sec
+                            break
+            # a section-name constant (FP16 = "fp16") stays top-level even
+            # when the module maps to a section (ACT_CHKPT, FLOPS_PROFILER,
+            # ELASTICITY declare their own section key)
+            if section is not None and key == section:
+                section = None
+            info = KeyInfo(key=key, const_name=name, section=section,
+                           default=defaults.get(name + "_DEFAULT"),
+                           has_default=(name + "_DEFAULT") in defaults,
+                           source=rel, line=line)
+            if section is None:
+                top.setdefault(key, info)
+            else:
+                sections.setdefault(section, {}).setdefault(key, info)
+
+    for sec, keys in _EXPLICIT_SECTIONS.items():
+        bucket = sections.setdefault(sec, {})
+        for key in keys:
+            bucket.setdefault(key, KeyInfo(
+                key=key, const_name="", section=sec, default=None,
+                has_default=False, source="<explicit>", line=0))
+    for key in SUPPLEMENTAL_TOP_LEVEL_KEYS:
+        top.setdefault(key, KeyInfo(
+            key=key, const_name="", section=None, default=None,
+            has_default=False, source="<supplemental>", line=0))
+    return ConfigSchema(top_level=top, sections=sections)
+
+
+_SCHEMA_CACHE: Optional[ConfigSchema] = None
+
+
+def get_schema() -> ConfigSchema:
+    global _SCHEMA_CACHE
+    if _SCHEMA_CACHE is None:
+        _SCHEMA_CACHE = extract_schema()
+    return _SCHEMA_CACHE
+
+
+# ---------------------------------------------------------------------------
+# Runtime validation (wired into DeepSpeedConfig)
+# ---------------------------------------------------------------------------
+
+def _suggest(key: str, candidates) -> Optional[str]:
+    matches = difflib.get_close_matches(key, list(candidates), n=1,
+                                        cutoff=0.75)
+    return matches[0] if matches else None
+
+
+def validate_config_dict(param_dict: dict,
+                         schema: Optional[ConfigSchema] = None,
+                         extra_keys=()) -> List[ConfigIssue]:
+    """Unknown-key scan of a DeepSpeed config dict.
+
+    Returns one :class:`ConfigIssue` per unknown top-level key and per
+    unknown sub-key of a known section, each with a "did you mean"
+    suggestion when a close schema key exists.  Free-form subtrees
+    (``optimizer.params`` / ``scheduler.params``) are skipped.
+    """
+    schema = schema or get_schema()
+    issues: List[ConfigIssue] = []
+    known_top = set(schema.top_level) | set(schema.sections) | set(extra_keys)
+
+    for key, value in param_dict.items():
+        if key not in known_top:
+            sug = _suggest(key, known_top)
+            hint = f"; did you mean '{sug}'?" if sug else ""
+            issues.append(ConfigIssue(
+                key=key, section=None, suggestion=sug,
+                message=f"unknown config key '{key}'{hint} (unknown keys "
+                        "are silently ignored by dict.get lookups)"))
+            continue
+        section_schema = schema.sections.get(key)
+        if section_schema is None or not isinstance(value, dict):
+            continue  # scalar key, free-form section, or deprecated bool
+        known_sub = set(section_schema) | _FREEFORM_SUBKEYS
+        for sub in value:
+            if sub in known_sub:
+                continue
+            sug = _suggest(sub, known_sub)
+            hint = f"; did you mean '{sug}'?" if sug else ""
+            issues.append(ConfigIssue(
+                key=sub, section=key, suggestion=sug,
+                message=f"unknown key '{sub}' in config section "
+                        f"'{key}'{hint}"))
+    return issues
+
+
+def issues_to_diagnostics(issues: List[ConfigIssue],
+                          path: str) -> List[Diagnostic]:
+    return [Diagnostic(path=path, line=1, col=1, rule_id="DSC402",
+                       message=i.message) for i in issues]
+
+
+# ---------------------------------------------------------------------------
+# Static dead-key detection (DSC401)
+# ---------------------------------------------------------------------------
+
+def _package_sources(root: str, skip_rel) -> List[str]:
+    """Concatenable source list for reference scanning: every package .py
+    except the constants modules themselves and the linter package."""
+    out = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        rel_dir = os.path.relpath(dirpath, root)
+        if rel_dir.split(os.sep)[0] == "tools":
+            dirnames[:] = []
+            continue
+        for fname in filenames:
+            if not fname.endswith(".py"):
+                continue
+            rel = os.path.normpath(os.path.join(rel_dir, fname))
+            if rel in skip_rel:
+                continue
+            with open(os.path.join(dirpath, fname), "r",
+                      encoding="utf-8") as f:
+                out.append(f.read())
+    return out
+
+
+def dead_key_diagnostics(root: Optional[str] = None) -> List[Diagnostic]:
+    """DSC401: key constants in ``runtime/constants.py`` that no package
+    module references — declared configuration surface nothing honors."""
+    root = root or package_root()
+    rel = "runtime/constants.py"
+    path = os.path.join(root, rel)
+    if not os.path.isfile(path):
+        return []
+    strings, _ = _parse_constants(path)
+    excluded = next(x for r, _, x in _CONSTANT_MODULES if r == rel)
+    corpus = "\n".join(_package_sources(
+        root, skip_rel={os.path.normpath(rel)}))
+    diags = []
+    for name, (key, line) in sorted(strings.items(),
+                                    key=lambda kv: kv[1][1]):
+        if name in excluded:
+            continue
+        if re.search(rf"\b{re.escape(name)}\b", corpus) is None:
+            diags.append(Diagnostic(
+                path=path, line=line, col=1, rule_id="DSC401",
+                message=f"config key constant {name} (json key "
+                        f"'{key}') is never read outside constants.py: "
+                        "setting it in a config silently does nothing"))
+    return diags

@@ -1,0 +1,646 @@
+"""dslint unit tests: every rule id has a triggering fixture AND a clean
+twin, plus config-schema extraction/validation round-trips."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.tools.dslint import (RULES, failing, lint_paths,
+                                        validate_config_dict)
+from deepspeed_tpu.tools.dslint.cli import main as dslint_main
+from deepspeed_tpu.tools.dslint.schema import (dead_key_diagnostics,
+                                               extract_schema)
+
+
+def lint_source(tmp_path, source, name="snippet.py"):
+    """Rule ids of unsuppressed diagnostics for one source snippet."""
+    path = tmp_path / name
+    path.write_text(source)
+    return sorted({d.rule_id for d in failing(lint_paths([str(path)]))})
+
+
+# ---------------------------------------------------------------------------
+# hot-path rules (in-jit)
+# ---------------------------------------------------------------------------
+
+def test_dsh101_item_in_jit(tmp_path):
+    ids = lint_source(tmp_path, """
+import jax
+
+@jax.jit
+def step(x):
+    return x.item()
+""")
+    assert ids == ["DSH101"]
+
+
+def test_dsh101_clean_twin(tmp_path):
+    ids = lint_source(tmp_path, """
+import jax
+
+@jax.jit
+def step(x):
+    return x + 1
+
+def driver(x):
+    return float(jax.device_get(step(x)))
+""")
+    assert ids == []
+
+
+def test_dsh101_reaches_through_call_graph(tmp_path):
+    # the helper is not decorated; it is hot because a jitted root calls it
+    ids = lint_source(tmp_path, """
+import jax
+
+def helper(x):
+    return x.item()
+
+@jax.jit
+def step(x):
+    return helper(x)
+""")
+    assert ids == ["DSH101"]
+
+
+def test_dsh102_scalar_cast(tmp_path):
+    ids = lint_source(tmp_path, """
+import jax
+
+@jax.jit
+def step(x):
+    return float(x) + 1.0
+""")
+    assert ids == ["DSH102"]
+
+
+def test_dsh102_shape_arithmetic_is_exempt(tmp_path):
+    ids = lint_source(tmp_path, """
+import jax
+
+@jax.jit
+def step(x):
+    scale = float(x.shape[0]) * float(1 << 8) + int(len(x.shape))
+    return x * scale
+""")
+    assert ids == []
+
+
+def test_dsh103_numpy_materialize(tmp_path):
+    ids = lint_source(tmp_path, """
+import jax
+import numpy as np
+
+@jax.jit
+def step(x):
+    return np.asarray(x).sum()
+""")
+    assert ids == ["DSH103"]
+
+
+def test_dsh103_jnp_is_clean(tmp_path):
+    ids = lint_source(tmp_path, """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(x):
+    return jnp.asarray(x).sum()
+""")
+    assert ids == []
+
+
+def test_dsh104_print_in_jit(tmp_path):
+    ids = lint_source(tmp_path, """
+import jax
+
+@jax.jit
+def step(x):
+    print(x)
+    return x
+""")
+    assert ids == ["DSH104"]
+
+
+def test_dsh104_debug_print_is_clean(tmp_path):
+    ids = lint_source(tmp_path, """
+import jax
+
+@jax.jit
+def step(x):
+    jax.debug.print("loss={}", x)
+    return x
+""")
+    assert ids == []
+
+
+def test_dsh105_wall_clock_in_jit(tmp_path):
+    ids = lint_source(tmp_path, """
+import time
+import jax
+
+@jax.jit
+def step(x):
+    t0 = time.time()
+    return x + t0
+""")
+    assert ids == ["DSH105"]
+
+
+def test_dsh105_host_timing_is_clean(tmp_path):
+    ids = lint_source(tmp_path, """
+import time
+import jax
+
+def bench(step, x):
+    t0 = time.perf_counter()
+    jax.device_get(step(x))
+    return time.perf_counter() - t0
+""")
+    assert ids == []
+
+
+def test_dsh106_device_loop_in_jit(tmp_path):
+    ids = lint_source(tmp_path, """
+import jax
+
+@jax.jit
+def step(x):
+    for d in jax.devices():
+        x = x + 1
+    return x
+""")
+    assert ids == ["DSH106"]
+
+
+def test_dsh106_host_device_loop_is_clean(tmp_path):
+    ids = lint_source(tmp_path, """
+import jax
+
+def placement_report():
+    return [d.platform for d in jax.devices()]
+""")
+    assert ids == []
+
+
+def test_shard_map_body_is_hot(tmp_path):
+    ids = lint_source(tmp_path, """
+import jax
+from jax.experimental.shard_map import shard_map
+
+def body(x):
+    return x.item()
+
+def build(mesh, spec):
+    return shard_map(body, mesh=mesh, in_specs=(spec,), out_specs=spec)
+""")
+    assert ids == ["DSH101"]
+
+
+def test_host_callback_bodies_are_exempt(tmp_path):
+    # functions handed to pure_callback run on the HOST: numpy there is
+    # the whole point, not a violation
+    ids = lint_source(tmp_path, """
+import jax
+import numpy as np
+
+def host_update(p):
+    return np.asarray(p) * 2
+
+@jax.jit
+def step(p):
+    return jax.pure_callback(
+        host_update, jax.ShapeDtypeStruct(p.shape, p.dtype), p)
+""")
+    assert ids == []
+
+
+# ---------------------------------------------------------------------------
+# driver (step-cadence) rules
+# ---------------------------------------------------------------------------
+
+def test_dsh201_item_in_driver(tmp_path):
+    ids = lint_source(tmp_path, """
+class TrainEngine:
+    def train_batch(self):
+        loss = self._step_fn()
+        return loss.item()
+""")
+    assert ids == ["DSH201"]
+
+
+def test_dsh202_sync_in_loop(tmp_path):
+    ids = lint_source(tmp_path, """
+import jax
+
+class TrainEngine:
+    def step(self):
+        out = []
+        for l in self._losses:
+            out.append(jax.device_get(l))
+        return out
+""")
+    assert ids == ["DSH202"]
+
+
+def test_dsh202_batched_fetch_is_clean(tmp_path):
+    ids = lint_source(tmp_path, """
+import jax
+
+class TrainEngine:
+    def step(self):
+        return jax.device_get(list(self._losses))
+""")
+    assert ids == []
+
+
+def test_dsh203_unbatched_syncs(tmp_path):
+    ids = lint_source(tmp_path, """
+import jax
+
+class TrainEngine:
+    def train_batch(self):
+        loss = jax.device_get(self._loss)
+        scale = jax.device_get(self._scale)
+        return loss, scale
+""")
+    assert ids == ["DSH203"]
+
+
+def test_dsh203_single_batched_sync_is_clean(tmp_path):
+    ids = lint_source(tmp_path, """
+import jax
+
+class TrainEngine:
+    def train_batch(self):
+        loss, scale = jax.device_get((self._loss, self._scale))
+        return loss, scale
+""")
+    assert ids == []
+
+
+def test_dsh203_sees_through_sync_properties(tmp_path):
+    ids = lint_source(tmp_path, """
+import jax
+
+class TrainEngine:
+    @property
+    def loss_scale(self):
+        return float(jax.device_get(self._scale))
+
+    def train_batch(self):
+        loss = jax.device_get(self._loss)
+        return loss, self.loss_scale
+""")
+    assert ids == ["DSH203"]
+
+
+def test_non_engine_class_is_not_driver_scope(tmp_path):
+    # benchmarks/profilers sync deliberately; only Engine/Scaler classes
+    # carry step-cadence semantics
+    ids = lint_source(tmp_path, """
+import jax
+
+class Prober:
+    def step(self):
+        a = jax.device_get(self._a)
+        b = jax.device_get(self._b)
+        return a, b
+""")
+    assert ids == []
+
+
+# ---------------------------------------------------------------------------
+# retrace rules
+# ---------------------------------------------------------------------------
+
+def test_dsr301_mutable_default(tmp_path):
+    ids = lint_source(tmp_path, """
+import jax
+
+@jax.jit
+def step(x, extra={}):
+    return x
+""")
+    assert ids == ["DSR301"]
+
+
+def test_dsr301_none_default_is_clean(tmp_path):
+    ids = lint_source(tmp_path, """
+import jax
+
+@jax.jit
+def step(x, extra=None):
+    return x
+""")
+    assert ids == []
+
+
+def test_dsr302_static_argnums_out_of_range(tmp_path):
+    ids = lint_source(tmp_path, """
+import jax
+
+def step(x, spec):
+    return x
+
+step_fn = jax.jit(step, static_argnums=(5,))
+""")
+    assert ids == ["DSR302"]
+
+
+def test_dsr302_unhashable_static_default(tmp_path):
+    ids = lint_source(tmp_path, """
+import jax
+
+def step(x, spec=[1, 2]):
+    return x
+
+step_fn = jax.jit(step, static_argnums=(1,))
+""")
+    assert "DSR302" in ids
+
+
+def test_dsr302_static_argnames_unknown(tmp_path):
+    ids = lint_source(tmp_path, """
+import jax
+
+def step(x, spec):
+    return x
+
+step_fn = jax.jit(step, static_argnames=("sepc",))
+""")
+    assert ids == ["DSR302"]
+
+
+def test_dsr302_hashable_static_is_clean(tmp_path):
+    ids = lint_source(tmp_path, """
+import jax
+
+def step(x, spec):
+    return x
+
+step_fn = jax.jit(step, static_argnums=(1,))
+""")
+    assert ids == []
+
+
+def test_dsr303_global_and_module_rng(tmp_path):
+    ids = lint_source(tmp_path, """
+import jax
+import numpy as np
+
+@jax.jit
+def step(x):
+    global COUNT
+    COUNT = 1
+    return x + np.random.rand()
+""")
+    assert ids == ["DSR303"]
+
+
+def test_dsr303_self_mutation_in_trace(tmp_path):
+    ids = lint_source(tmp_path, """
+import jax
+
+class Model:
+    @jax.jit
+    def step(self, x):
+        self.cache = x
+        return x
+""")
+    assert ids == ["DSR303"]
+
+
+def test_dsr303_threaded_state_is_clean(tmp_path):
+    ids = lint_source(tmp_path, """
+import jax
+
+@jax.jit
+def step(x, rng):
+    noise = jax.random.normal(rng, x.shape)
+    return x + noise, jax.random.split(rng)[0]
+""")
+    assert ids == []
+
+
+def test_dsr304_branch_on_traced_arg(tmp_path):
+    ids = lint_source(tmp_path, """
+import jax
+
+@jax.jit
+def step(x):
+    if x:
+        return x + 1
+    return x
+""")
+    assert ids == ["DSR304"]
+
+
+def test_dsr304_jnp_where_is_clean(tmp_path):
+    ids = lint_source(tmp_path, """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(x):
+    return jnp.where(x > 0, x + 1, x)
+""")
+    assert ids == []
+
+
+# ---------------------------------------------------------------------------
+# pragmas
+# ---------------------------------------------------------------------------
+
+def test_inline_pragma_suppresses(tmp_path):
+    path = tmp_path / "snippet.py"
+    path.write_text("""
+import jax
+
+@jax.jit
+def step(x):
+    return x.item()  # dslint: disable=DSH101 -- fixture
+""")
+    diags = lint_paths([str(path)])
+    assert failing(diags) == []
+    assert [d.rule_id for d in diags if d.suppressed] == ["DSH101"]
+
+
+def test_standalone_pragma_covers_next_line(tmp_path):
+    path = tmp_path / "snippet.py"
+    path.write_text("""
+import jax
+
+@jax.jit
+def step(x):
+    # dslint: disable=DSH101
+    return x.item()
+""")
+    assert failing(lint_paths([str(path)])) == []
+
+
+def test_pragma_for_other_rule_does_not_suppress(tmp_path):
+    ids = lint_source(tmp_path, """
+import jax
+
+@jax.jit
+def step(x):
+    return x.item()  # dslint: disable=DSH104
+""")
+    assert ids == ["DSH101"]
+
+
+# ---------------------------------------------------------------------------
+# config schema: extraction, validation, dead keys
+# ---------------------------------------------------------------------------
+
+def test_schema_is_nonempty_and_typed():
+    schema = extract_schema()
+    assert len(schema.all_keys()) > 60
+    top = schema.top_level
+    assert "train_batch_size" in top
+    assert "gradient_accumulation_steps" in top
+    zero = schema.sections["zero_optimization"]
+    assert "stage" in zero and "cpu_offload" in zero
+    assert zero["stage"].has_default and zero["stage"].default == 0
+    fp16 = schema.sections["fp16"]
+    assert fp16["loss_scale_window"].default == 1000
+    assert "keep_last_n" in schema.sections["checkpoint"]
+    assert "partition_activations" in schema.sections[
+        "activation_checkpointing"]
+    assert "enabled" in schema.sections["flops_profiler"]
+    assert "micro_batch_sizes" in schema.sections["elasticity"]
+
+
+def test_validator_did_you_mean():
+    issues = validate_config_dict(
+        {"train_batch_size": 8, "gradient_acumulation_steps": 2})
+    assert len(issues) == 1
+    assert issues[0].suggestion == "gradient_accumulation_steps"
+    assert "did you mean 'gradient_accumulation_steps'" in issues[0].message
+
+
+def test_validator_section_typo():
+    issues = validate_config_dict(
+        {"zero_optimization": {"stage": 2, "cpu_offlaod": True}})
+    assert [i.section for i in issues] == ["zero_optimization"]
+    assert issues[0].suggestion == "cpu_offload"
+
+
+def test_validator_round_trips_known_good_configs():
+    # the configs exercised by tests/unit/test_config.py (and the README
+    # quick start) must validate clean
+    good_configs = [
+        {"train_batch_size": 32, "train_micro_batch_size_per_gpu": 16,
+         "gradient_accumulation_steps": 1},
+        {"train_batch_size": 8, "bf16": {"enabled": True},
+         "zero_optimization": {"stage": 2, "cpu_offload": True}},
+        {"train_batch_size": 8, "fp16": {
+            "enabled": True, "initial_scale_power": 16,
+            "loss_scale_window": 500, "hysteresis": 4,
+            "min_loss_scale": 0.5}},
+        {"train_batch_size": 8,
+         "optimizer": {"type": "Adam", "params": {"lr": 0.001}},
+         "scheduler": {"type": "WarmupLR",
+                       "params": {"warmup_num_steps": 10}}},
+        {"train_batch_size": 8, "sparse_attention": {
+            "mode": "fixed", "block": 32, "num_local_blocks": 8}},
+        {"train_batch_size": 2, "steps_per_print": 10 ** 9, "seed": 1,
+         "mesh": {"data": 1}, "pipeline": {"stages": 2},
+         "checkpoint": {"async_save": True, "keep_last_n": 3},
+         "zero_allow_untested_optimizer": True, "strict_config": True},
+    ]
+    for cfg in good_configs:
+        assert validate_config_dict(cfg) == [], cfg
+
+
+def test_validator_skips_freeform_params():
+    issues = validate_config_dict({
+        "optimizer": {"type": "Adam",
+                      "params": {"lr": 1e-3, "exotic_knob": 7}}})
+    assert issues == []
+
+
+def test_dead_key_detection(tmp_path):
+    pkg = tmp_path / "runtime"
+    pkg.mkdir()
+    (pkg / "constants.py").write_text(
+        'USED = "used_key"\nUSED_DEFAULT = 1\n'
+        'DEAD = "dead_key"\nDEAD_DEFAULT = 2\n'
+        'SUPPRESSED = "ok"  # dslint: disable=DSC401\n')
+    (pkg / "config.py").write_text(
+        "from . import constants as C\nx = C.USED\n")
+    diags = lint_paths([str(tmp_path)])
+    dead = [d for d in diags if d.rule_id == "DSC401"]
+    assert [("DEAD" in d.message, d.suppressed) for d in dead] == [
+        (True, False), (False, True)]
+    assert len(failing(diags)) == 1
+
+
+def test_strict_config_raises_in_deepspeed_config():
+    from deepspeed_tpu.runtime.config import (DeepSpeedConfig,
+                                              DeepSpeedConfigError)
+
+    with pytest.raises(DeepSpeedConfigError,
+                       match="gradient_accumulation_steps"):
+        DeepSpeedConfig({"train_batch_size": 8,
+                         "gradient_acumulation_steps": 2,
+                         "strict_config": True}, world_size=1)
+    # warn-by-default: same typo parses (and silently defaults, which is
+    # exactly what the warning reports)
+    cfg = DeepSpeedConfig({"train_batch_size": 8,
+                           "gradient_acumulation_steps": 2}, world_size=1)
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_amp_key_is_now_wired():
+    from deepspeed_tpu.runtime.config import (DeepSpeedConfig,
+                                              DeepSpeedConfigError)
+
+    with pytest.raises(DeepSpeedConfigError, match="amp"):
+        DeepSpeedConfig({"train_batch_size": 8,
+                         "amp": {"enabled": True}}, world_size=1)
+    cfg = DeepSpeedConfig({"train_batch_size": 8,
+                           "ring_attention": {"enabled": True}},
+                          world_size=1)
+    assert cfg.ring_attention_enabled
+    assert cfg.allgather_size == 500000000
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def test_cli_exit_codes_and_json_report(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax\n\n@jax.jit\ndef f(x):\n    return x.item()\n")
+    clean = tmp_path / "clean.py"
+    clean.write_text("import jax\n\n@jax.jit\ndef f(x):\n    return x + 1\n")
+    report = tmp_path / "report.json"
+
+    assert dslint_main([str(clean)]) == 0
+    assert dslint_main([str(bad), "--json", str(report)]) == 1
+    data = json.loads(report.read_text())
+    assert data["violations"] == 1
+    assert data["diagnostics"][0]["rule"] == "DSH101"
+    assert data["diagnostics"][0]["line"] == 5
+    assert data["schema_keys"] > 60
+    assert dslint_main([str(bad), "--ignore", "DSH101"]) == 0
+    assert dslint_main(["--list-rules"]) == 0
+
+
+def test_cli_validates_config_files(tmp_path):
+    bad_cfg = tmp_path / "ds_config.json"
+    bad_cfg.write_text(json.dumps(
+        {"train_batch_size": 8, "gradient_acumulation_steps": 2}))
+    good_cfg = tmp_path / "good.json"
+    good_cfg.write_text(json.dumps(
+        {"train_batch_size": 8, "bf16": {"enabled": True}}))
+    assert dslint_main(["--config", str(good_cfg)]) == 0
+    assert dslint_main(["--config", str(bad_cfg)]) == 1
+
+
+def test_every_rule_id_is_documented():
+    for rule in RULES.values():
+        assert rule.summary and rule.rationale, rule.id
+        assert rule.id[:3] in ("DSH", "DSR", "DSC")

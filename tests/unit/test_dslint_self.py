@@ -1,0 +1,52 @@
+"""Tier-1 CI guard: the shipped tree must be dslint-clean.
+
+This is the "wired into CI" part of the static-analysis pass: it rides
+the existing pytest tier-1 command, so any PR that introduces an
+unsuppressed hot-path sync, retrace hazard, or dead config key fails the
+suite with the exact file:line diagnostics in the assertion message.
+"""
+
+import os
+
+import deepspeed_tpu
+from deepspeed_tpu.tools.dslint import failing, lint_paths
+
+PKG_DIR = os.path.dirname(os.path.abspath(deepspeed_tpu.__file__))
+
+# Every suppression in the tree is an explicit, reasoned pragma; this
+# budget keeps "add a pragma" from becoming the path of least resistance.
+# Raise it only with a `-- reason` on the new pragma line.
+MAX_SUPPRESSIONS = 8
+ALLOWED_SUPPRESSED_RULES = {"DSC401", "DSH102", "DSH202", "DSH203"}
+
+
+def _diags():
+    return lint_paths([PKG_DIR])
+
+
+def test_package_is_dslint_clean():
+    bad = failing(_diags())
+    listing = "\n".join(d.format() for d in bad)
+    assert not bad, (
+        f"dslint found {len(bad)} unsuppressed violation(s) in the "
+        f"shipped tree — fix them or add a reasoned "
+        f"'# dslint: disable=<id> -- why' pragma:\n{listing}")
+
+
+def test_suppression_budget():
+    suppressed = [d for d in _diags() if d.suppressed]
+    listing = "\n".join(d.format() for d in suppressed)
+    assert len(suppressed) <= MAX_SUPPRESSIONS, (
+        f"suppression budget exceeded ({len(suppressed)} > "
+        f"{MAX_SUPPRESSIONS}):\n{listing}")
+    stray = {d.rule_id for d in suppressed} - ALLOWED_SUPPRESSED_RULES
+    assert not stray, (
+        f"new suppressed rule famil{'ies' if len(stray) > 1 else 'y'} "
+        f"{sorted(stray)} — extend ALLOWED_SUPPRESSED_RULES only with a "
+        f"review of:\n{listing}")
+
+
+def test_cli_exit_zero_on_shipped_tree():
+    from deepspeed_tpu.tools.dslint.cli import main
+
+    assert main([PKG_DIR]) == 0

@@ -33,7 +33,7 @@ def test_install_ships_package_and_native_sources(installed_tree):
 
 
 @pytest.mark.parametrize("script", ["deepspeed", "ds", "ds_report",
-                                    "ds_ssh", "ds_elastic"])
+                                    "ds_ssh", "ds_elastic", "dslint"])
 def test_console_scripts_run_off_tree(installed_tree, script, tmp_path):
     """Each console script must import and print help using ONLY the
     installed tree — cwd is outside the repo and sys.path excludes it."""
