@@ -62,7 +62,7 @@ def main():
     try:
         mems = [str(m) for m in devs[0].addressable_memories()]
         print(f"memory spaces ......... {', '.join(mems)}")
-    except Exception:
+    except Exception:  # dslint: disable=DSE502 -- optional backend API probe; the report line is simply omitted
         pass
     print("-" * 64)
     print(f"{'op name':<28} {'compatible':<12} detail")

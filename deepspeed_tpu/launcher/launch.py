@@ -6,6 +6,19 @@ decodes the world info, computes each local process's global id, sets the
 (which feeds ``jax.distributed.initialize``), spawns one Python process per
 local slot, monitors them, and tears the node down if any child dies.
 SIGINT/SIGTERM are forwarded to the children (reference ``:131-146``).
+
+Resilience contract (``deepspeed_tpu/resilience``):
+
+- a child killed by a signal exits the launcher with ``128 + signum``
+  (shell convention) and the signal is named in the log — a raw negative
+  ``poll()`` code would wrap to a meaningless 24x value;
+- ``--max-restarts N`` respawns a failed child up to N times with
+  exponential backoff (``DS_RESTART_BACKOFF_SECS``, default 2s, doubling
+  per restart of that slot) — pair with ``deepspeed.initialize(...,
+  auto_resume=True)`` so respawns land on the last committed checkpoint;
+- **poison** exit codes (:data:`POISON_EXIT_CODES`, e.g. a divergence
+  abort) never respawn: restarting would replay the same data into the
+  same divergence.
 """
 
 import argparse
@@ -16,6 +29,7 @@ import subprocess
 import sys
 import time
 
+from ..resilience.constants import POISON_EXIT_CODES
 from ..utils.logging import logger
 from .constants import (ENV_COORDINATOR, ENV_LOCAL_RANK, ENV_NUM_PROCESSES,
                         ENV_PROCESS_ID)
@@ -29,6 +43,10 @@ def parse_args(args=None):
                         help="this node's index, or 'auto' (match hostname)")
     parser.add_argument("--master_addr", type=str, required=True)
     parser.add_argument("--master_port", type=int, required=True)
+    parser.add_argument("--max-restarts", "--max_restarts", type=int,
+                        default=0, dest="max_restarts",
+                        help="respawn a failed child up to N times with "
+                             "backoff (poison exit codes never respawn)")
     parser.add_argument("training_script", type=str)
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     ns = parser.parse_args(args)
@@ -37,6 +55,20 @@ def parse_args(args=None):
         ns.training_script = ns.script_args[0]
         ns.script_args = ns.script_args[1:]
     return ns
+
+
+def map_exit_code(ret):
+    """Normalize ``Popen.poll()``'s return into a shell-meaningful exit
+    code: signal deaths (negative) map to ``128 + signum``.  Returns
+    ``(code, signal_name_or_None)``."""
+    if ret is None or ret >= 0:
+        return ret, None
+    signum = -ret
+    try:
+        name = signal.Signals(signum).name
+    except ValueError:
+        name = f"signal {signum}"
+    return 128 + signum, name
 
 
 def resolve_node_rank(node_rank, world):
@@ -63,7 +95,7 @@ def main(argv=None):
     local_slots = world[hosts[node_rank]]
     total = sum(len(v) for v in world.values())
 
-    procs = []
+    children = []   # [{proc, cmd, env, rank, restarts}]
     for local_rank, slot in enumerate(local_slots):
         env = os.environ.copy()
         env[ENV_COORDINATOR] = f"{args.master_addr}:{args.master_port}"
@@ -76,7 +108,10 @@ def main(argv=None):
         cmd = [sys.executable, "-u", args.training_script, *args.script_args]
         logger.info(f"launching process {first_id + local_rank}/{total}: "
                     f"{' '.join(cmd)}")
-        procs.append(subprocess.Popen(cmd, env=env))
+        children.append({"proc": subprocess.Popen(cmd, env=env),
+                         "cmd": cmd, "env": env,
+                         "rank": first_id + local_rank, "restarts": 0,
+                         "respawn_at": None})
 
     # Children may install a preemption checkpoint hook (checkpoint
     # subsystem, "save_on_preemption") that drains one final synchronous
@@ -84,8 +119,11 @@ def main(argv=None):
     # SIGKILL so that save can land.
     grace_secs = float(os.environ.get("DS_TERM_GRACE_SECS", "30"))
 
+    def live_procs():
+        return [c["proc"] for c in children if c["proc"] is not None]
+
     def terminate_all(sig=signal.SIGTERM, grace=grace_secs):
-        for p in procs:
+        for p in live_procs():
             if p.poll() is None:
                 try:
                     p.send_signal(sig)
@@ -93,9 +131,9 @@ def main(argv=None):
                     pass
         deadline = time.time() + grace
         while (time.time() < deadline
-               and any(p.poll() is None for p in procs)):
+               and any(p.poll() is None for p in live_procs())):
             time.sleep(0.1)
-        for p in procs:
+        for p in live_procs():
             if p.poll() is None:
                 logger.warning(f"process {p.pid} survived {grace:.0f}s "
                                "grace after signal; killing")
@@ -116,22 +154,62 @@ def main(argv=None):
     signal.signal(signal.SIGINT, forward_signal)
     signal.signal(signal.SIGTERM, forward_signal)
 
-    # monitor: any child failure tears down the node (reference :151-167)
-    alive = list(procs)
+    # monitor: a failed child is respawned (up to --max-restarts, with
+    # exponential backoff) unless its exit code is poison; anything past
+    # the budget tears down the node (reference :151-167)
+    backoff_base = float(os.environ.get("DS_RESTART_BACKOFF_SECS", "2"))
+    alive = list(children)
     rc = 0
+    tearing_down = False
     while alive:
-        time.sleep(1)
-        for p in list(alive):
-            ret = p.poll()
+        time.sleep(float(os.environ.get("DS_MONITOR_POLL_SECS", "1")))
+        for child in list(alive):
+            if child["proc"] is None:
+                # backoff window: the respawn deadline is checked per poll
+                # tick instead of sleeping inline, so a sibling's poison
+                # exit or signal death still tears the node down promptly
+                if tearing_down:
+                    alive.remove(child)
+                elif time.time() >= child["respawn_at"]:
+                    child["respawn_at"] = None
+                    child["proc"] = subprocess.Popen(child["cmd"],
+                                                     env=child["env"])
+                continue
+            ret = child["proc"].poll()
             if ret is None:
                 continue
-            alive.remove(p)
-            if ret != 0:
-                logger.error(f"process {p.pid} exited with code {ret}; "
+            code, signame = map_exit_code(ret)
+            if code == 0:
+                alive.remove(child)
+                continue
+            where = (f"process {child['proc'].pid} (rank {child['rank']})")
+            if signame is not None:
+                logger.error(f"{where} killed by {signame}; exit code "
+                             f"mapped to {code}")
+            if code in POISON_EXIT_CODES:
+                logger.error(
+                    f"{where} exited with poison code {code} (e.g. "
+                    "divergence abort): never respawning — terminating "
+                    "the node")
+            elif (not tearing_down
+                    and child["restarts"] < args.max_restarts):
+                child["restarts"] += 1
+                delay = backoff_base * (2 ** (child["restarts"] - 1))
+                logger.warning(
+                    f"{where} exited with code {code}; respawning "
+                    f"(restart {child['restarts']}/{args.max_restarts}) "
+                    f"after {delay:.1f}s backoff")
+                child["proc"] = None
+                child["respawn_at"] = time.time() + delay
+                continue
+            else:
+                logger.error(f"{where} exited with code {code}; "
                              "terminating remaining processes")
-                terminate_all()
-                if rc == 0:  # keep the FIRST failure, not siblings' SIGTERM
-                    rc = ret
+            alive.remove(child)
+            tearing_down = True
+            terminate_all()
+            if rc == 0:  # keep the FIRST failure, not siblings' SIGTERM
+                rc = code
     sys.exit(rc)
 
 

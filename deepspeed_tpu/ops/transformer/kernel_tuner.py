@@ -73,7 +73,7 @@ def _load_disk():
     try:
         with open(_CACHE_PATH) as f:
             _memory_cache.update(json.load(f))
-    except Exception:
+    except Exception:  # dslint: disable=DSE502 -- cache file absent/corrupt on first run; tuner just re-measures
         pass
 
 
@@ -82,7 +82,7 @@ def _save_disk():
         os.makedirs(os.path.dirname(_CACHE_PATH), exist_ok=True)
         with open(_CACHE_PATH, "w") as f:
             json.dump(_memory_cache, f, indent=1, sort_keys=True)
-    except Exception:  # read-only FS etc. — in-memory cache still works
+    except Exception:  # dslint: disable=DSE502 -- read-only FS etc.; in-memory cache still works
         pass
 
 

@@ -18,13 +18,47 @@ dominate); ``steps >= 5`` after ``warmup >= 2`` for the big programs.
 
 import contextlib
 import time
+from collections import deque
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["timed_loop", "timed_scan", "wall_breakdown",
-           "model_scope_breakdown", "grad_fold"]
+           "model_scope_breakdown", "grad_fold", "StepLatencyRing"]
+
+
+class StepLatencyRing:
+    """Fixed-size ring of recent per-step wall latencies (beat-to-beat
+    intervals of the engine's step loop).
+
+    The always-on counterpart of :func:`wall_breakdown`: O(1) host work
+    per step, no device access, safe on the step critical path.  The
+    resilience watchdog dumps :meth:`summary` in its hang post-mortem so
+    "was the job slowing down before it wedged?" is answerable from the
+    crash log alone.  Appends are GIL-atomic; the watchdog thread reads
+    without locking.
+    """
+
+    def __init__(self, capacity=64):
+        self._buf = deque(maxlen=int(capacity))
+        self.total_steps = 0
+
+    def record(self, seconds):
+        self._buf.append(float(seconds))
+        self.total_steps += 1
+
+    def recent(self):
+        return list(self._buf)
+
+    def summary(self):
+        vals = self.recent()
+        if not vals:
+            return "no completed steps recorded"
+        arr = np.asarray(vals)
+        return (f"last={arr[-1]:.3f}s mean={arr.mean():.3f}s "
+                f"p50={np.median(arr):.3f}s max={arr.max():.3f}s "
+                f"over {len(arr)} of {self.total_steps} step(s)")
 
 
 def _fence(x):

@@ -1,0 +1,52 @@
+"""Training resilience subsystem: detect bad steps, recover, prove it.
+
+Four pieces (see ``docs/resilience.md``):
+
+- :mod:`~deepspeed_tpu.resilience.guard` — per-step anomaly detection
+  (non-finite grads/loss, rolling loss-spike z-score, pinned loss
+  scale), folded into the engine's existing batched overflow fetch so
+  the happy path gains no host syncs, with policies
+  ``skip | rescale | rollback | abort``;
+- :mod:`~deepspeed_tpu.resilience.rollback` — restore from the latest
+  committed checkpoint on sustained divergence, with a rollback budget
+  and cooldown;
+- :mod:`~deepspeed_tpu.resilience.watchdog` — heartbeat thread that
+  catches hung steps, dumps all-thread stacks + recent step latencies,
+  and exits with a distinct respawnable code;
+- :mod:`~deepspeed_tpu.resilience.chaos` — seeded fault injector
+  (NaN batches, torn/corrupt/delayed checkpoints, synthetic SIGTERM,
+  step hangs) driving the chaos tests.
+
+Exit-code contract and :class:`TrainingDivergedError` live in
+:mod:`~deepspeed_tpu.resilience.constants` (stdlib-only: the launcher
+imports it to pick respawn vs poison without touching jax).  The heavier
+modules load lazily so ``from deepspeed_tpu.resilience.constants import
+POISON_EXIT_CODES`` stays cheap.
+"""
+
+from .constants import (EXIT_DIVERGENCE_ABORT, EXIT_STEP_HANG,  # noqa: F401
+                        GUARD_POLICIES, POISON_EXIT_CODES,
+                        TrainingDivergedError)
+
+_LAZY = {
+    "AnomalyGuard": ("guard", "AnomalyGuard"),
+    "RollbackManager": ("rollback", "RollbackManager"),
+    "StepWatchdog": ("watchdog", "StepWatchdog"),
+    "ChaosMonkey": ("chaos", "ChaosMonkey"),
+    "DeepSpeedResilienceConfig": ("config", "DeepSpeedResilienceConfig"),
+}
+
+__all__ = ["EXIT_DIVERGENCE_ABORT", "EXIT_STEP_HANG", "GUARD_POLICIES",
+           "POISON_EXIT_CODES", "TrainingDivergedError", *_LAZY]
+
+
+def __getattr__(name):
+    entry = _LAZY.get(name)
+    if entry is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    module = importlib.import_module(f".{entry[0]}", __name__)
+    value = getattr(module, entry[1])
+    globals()[name] = value
+    return value

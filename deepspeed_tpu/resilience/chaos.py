@@ -1,0 +1,159 @@
+"""Chaos harness: deterministic, seeded fault injection for testing the
+resilience subsystem against the failures it claims to survive.
+
+Every fault a ``ChaosMonkey`` injects is reproducible from its seed (or
+from an explicit step list), so a chaos test failure replays exactly.
+Faults mirror the real-world menagerie:
+
+- ``nan_steps`` — poison every float leaf of the batch with NaN (a bad
+  record / overflowed activation burst: non-finite loss AND gradients);
+- ``sigterm_steps`` — synthetic preemption notice, delivered to this
+  process right before the step runs;
+- ``hang_steps`` — the step wedges (stuck collective / dead remote
+  attachment): blocks on an event (test-controlled) or sleeps;
+- :meth:`corrupt_checkpoint` — flip bytes in a committed payload file
+  (bit rot / torn storage);
+- :meth:`torn_tmp_dir` — fabricate a half-written ``<tag>.tmp`` dir (a
+  writer killed mid-commit);
+- :meth:`delayed_commit` / :meth:`crash_mid_save` — context managers
+  hooking the atomic writer to stall or die between payload files.
+
+Batch-level injection (wrapping the data iterator) is deliberate: it
+drives the REAL production path — model forward produces NaN loss, the
+backward produces NaN grads, the in-jit guard skips the update, the
+host guard escalates — rather than monkeypatching engine internals.
+"""
+
+import contextlib
+import os
+import signal
+import time
+
+import numpy as np
+
+from ..checkpoint import constants as ckpt_const
+from ..checkpoint import writer as ckpt_writer
+
+
+class ChaosMonkey:
+    """Seeded fault injector.  ``log`` records every injected fault as
+    ``(pull_index, kind)`` so tests can assert the schedule fired."""
+
+    def __init__(self, seed=0):
+        self.seed = int(seed)
+        self._rng = np.random.default_rng(self.seed)
+        self.log = []
+
+    # ------------------------------------------------------------- plan
+    def schedule_steps(self, n_steps, n_faults):
+        """``n_faults`` distinct step indices in ``[0, n_steps)``, drawn
+        from the seeded stream — same seed, same schedule."""
+        n_faults = min(int(n_faults), int(n_steps))
+        picks = self._rng.choice(int(n_steps), size=n_faults, replace=False)
+        return tuple(sorted(int(i) for i in picks))
+
+    # ------------------------------------------------- batch-level faults
+    @staticmethod
+    def nan_batch(batch):
+        """Every float leaf replaced with NaN (structure/dtypes intact)."""
+        def poison(x):
+            x = np.asarray(x)
+            if np.issubdtype(x.dtype, np.floating):
+                return np.full_like(x, np.nan)
+            return x
+
+        if isinstance(batch, (tuple, list)):
+            return type(batch)(ChaosMonkey.nan_batch(b) for b in batch)
+        if isinstance(batch, dict):
+            return {k: ChaosMonkey.nan_batch(v) for k, v in batch.items()}
+        return poison(batch)
+
+    def wrap_iter(self, data_iter, nan_steps=(), sigterm_steps=(),
+                  hang_steps=(), hang_event=None, hang_secs=None):
+        """Wrap a batch iterator, injecting faults at the given PULL
+        indices (0-based; with gradient accumulation one optimizer step
+        pulls ``acc`` batches).  ``hang_steps`` blocks on ``hang_event``
+        when given (the test releases it), else sleeps ``hang_secs``."""
+        nan_steps = frozenset(nan_steps)
+        sigterm_steps = frozenset(sigterm_steps)
+        hang_steps = frozenset(hang_steps)
+
+        def gen():
+            for i, batch in enumerate(data_iter):
+                if i in sigterm_steps:
+                    self.log.append((i, "sigterm"))
+                    signal.raise_signal(signal.SIGTERM)
+                if i in hang_steps:
+                    self.log.append((i, "hang"))
+                    if hang_event is not None:
+                        hang_event.wait()
+                    elif hang_secs is not None:
+                        time.sleep(hang_secs)
+                if i in nan_steps:
+                    self.log.append((i, "nan"))
+                    batch = self.nan_batch(batch)
+                yield batch
+
+        return gen()
+
+    # --------------------------------------------- checkpoint-level faults
+    def corrupt_checkpoint(self, ckpt_dir,
+                           filename=ckpt_const.OPTIM_STATES_NPZ, nbytes=1):
+        """Flip ``nbytes`` seeded-random bytes of a committed payload
+        file; ``verify_checkpoint``/``verify_on_load`` must catch it."""
+        path = os.path.join(str(ckpt_dir), filename)
+        data = bytearray(open(path, "rb").read())
+        for off in self._rng.integers(0, len(data), size=int(nbytes)):
+            data[int(off)] ^= 0xFF
+        with open(path, "wb") as f:
+            f.write(bytes(data))
+        self.log.append((filename, "corrupt"))
+        return path
+
+    def torn_tmp_dir(self, save_dir, tag):
+        """Fabricate the wreckage of a writer killed mid-commit: a
+        ``<tag>.tmp`` dir holding one truncated payload file."""
+        tmp = os.path.join(str(save_dir), str(tag) + ckpt_const.TMP_SUFFIX)
+        os.makedirs(tmp, exist_ok=True)
+        junk = self._rng.bytes(64)
+        with open(os.path.join(tmp, ckpt_const.MODEL_STATES_NPZ), "wb") as f:
+            f.write(junk)
+        self.log.append((tag, "torn_tmp"))
+        return tmp
+
+    @contextlib.contextmanager
+    def delayed_commit(self, delay_secs=None, gate=None,
+                       at_file=ckpt_const.META_JSON):
+        """While active, the atomic writer stalls on ``at_file`` —
+        blocking on ``gate`` (a ``threading.Event``) when given, else
+        sleeping ``delay_secs`` — so tests can hold a commit in flight."""
+        def hook(tmp_dir, name):
+            if name == at_file:
+                self.log.append((name, "delayed_commit"))
+                if gate is not None:
+                    gate.wait(timeout=60)
+                elif delay_secs:
+                    time.sleep(delay_secs)
+
+        prev = ckpt_writer._file_written_hook
+        ckpt_writer._file_written_hook = hook
+        try:
+            yield self
+        finally:
+            ckpt_writer._file_written_hook = prev
+
+    @contextlib.contextmanager
+    def crash_mid_save(self, at_file=ckpt_const.MODEL_STATES_NPZ):
+        """While active, the atomic writer dies after writing ``at_file``
+        (leaving a torn tmp dir the commit protocol must never promote)."""
+        def hook(tmp_dir, name):
+            if name == at_file:
+                self.log.append((name, "crash_mid_save"))
+                raise OSError("chaos: simulated crash mid-save")
+
+        prev = ckpt_writer._file_written_hook
+        ckpt_writer._file_written_hook = hook
+        try:
+            yield self
+        finally:
+            ckpt_writer._file_written_hook = prev

@@ -1,0 +1,66 @@
+"""``"resilience"`` config block.
+
+Parsed by :class:`~deepspeed_tpu.runtime.config.DeepSpeedConfig` like every
+other feature subsection; the key constants live in
+``runtime/constants.py`` so the dslint DSC4xx schema extractor validates
+unknown/misspelled keys for free (``"polcy"`` gets a "did you mean
+'policy'?" at engine construction).
+"""
+
+from ..runtime import constants as C
+from ..runtime.config_utils import get_scalar_param
+from .constants import GUARD_POLICIES
+
+
+class DeepSpeedResilienceConfig:
+    """Typed view of the ``resilience`` subsection (all keys optional)."""
+
+    def __init__(self, param_dict):
+        res = param_dict.get(C.RESILIENCE, {}) or {}
+        self.enabled = bool(get_scalar_param(
+            res, C.RESILIENCE_ENABLED, C.RESILIENCE_ENABLED_DEFAULT))
+        self.policy = str(get_scalar_param(
+            res, C.RESILIENCE_POLICY, C.RESILIENCE_POLICY_DEFAULT)).lower()
+        assert self.policy in GUARD_POLICIES, (
+            f"resilience.policy {self.policy!r} not one of {GUARD_POLICIES}")
+        self.spike_window = int(get_scalar_param(
+            res, C.RESILIENCE_SPIKE_WINDOW, C.RESILIENCE_SPIKE_WINDOW_DEFAULT))
+        assert self.spike_window >= 0, "resilience.spike_window must be >= 0"
+        self.spike_zscore = float(get_scalar_param(
+            res, C.RESILIENCE_SPIKE_ZSCORE, C.RESILIENCE_SPIKE_ZSCORE_DEFAULT))
+        assert self.spike_zscore > 0, "resilience.spike_zscore must be > 0"
+        self.divergence_patience = int(get_scalar_param(
+            res, C.RESILIENCE_DIVERGENCE_PATIENCE,
+            C.RESILIENCE_DIVERGENCE_PATIENCE_DEFAULT))
+        assert self.divergence_patience >= 1, (
+            "resilience.divergence_patience must be >= 1")
+        self.max_rollbacks = int(get_scalar_param(
+            res, C.RESILIENCE_MAX_ROLLBACKS,
+            C.RESILIENCE_MAX_ROLLBACKS_DEFAULT))
+        assert self.max_rollbacks >= 0, "resilience.max_rollbacks must be >= 0"
+        self.rollback_cooldown_steps = int(get_scalar_param(
+            res, C.RESILIENCE_ROLLBACK_COOLDOWN_STEPS,
+            C.RESILIENCE_ROLLBACK_COOLDOWN_STEPS_DEFAULT))
+        assert self.rollback_cooldown_steps >= 0, (
+            "resilience.rollback_cooldown_steps must be >= 0")
+        self.hang_timeout_secs = float(get_scalar_param(
+            res, C.RESILIENCE_HANG_TIMEOUT_SECS,
+            C.RESILIENCE_HANG_TIMEOUT_SECS_DEFAULT))
+        assert self.hang_timeout_secs >= 0, (
+            "resilience.hang_timeout_secs must be >= 0 (0 disables the "
+            "watchdog)")
+        self.floor_scale_patience = int(get_scalar_param(
+            res, C.RESILIENCE_FLOOR_SCALE_PATIENCE,
+            C.RESILIENCE_FLOOR_SCALE_PATIENCE_DEFAULT))
+        assert self.floor_scale_patience >= 1, (
+            "resilience.floor_scale_patience must be >= 1")
+        self.checkpoint_dir = get_scalar_param(
+            res, C.RESILIENCE_CHECKPOINT_DIR,
+            C.RESILIENCE_CHECKPOINT_DIR_DEFAULT)
+
+    def __repr__(self):
+        return (f"DeepSpeedResilienceConfig(enabled={self.enabled}, "
+                f"policy={self.policy!r}, "
+                f"patience={self.divergence_patience}, "
+                f"max_rollbacks={self.max_rollbacks}, "
+                f"hang_timeout_secs={self.hang_timeout_secs})")

@@ -25,6 +25,7 @@ from .zero.config import DeepSpeedZeroConfig
 from .activation_checkpointing.config import DeepSpeedActivationCheckpointingConfig
 from ..profiling.config import DeepSpeedFlopsProfilerConfig
 from ..checkpoint.config import DeepSpeedCheckpointConfig
+from ..resilience.config import DeepSpeedResilienceConfig
 
 TENSOR_CORE_ALIGN_SIZE = 8
 ADAM_OPTIMIZER = C.ADAM_OPTIMIZER
@@ -350,6 +351,7 @@ class DeepSpeedConfig:
         self.activation_checkpointing_config = DeepSpeedActivationCheckpointingConfig(param_dict)
         self.flops_profiler_config = DeepSpeedFlopsProfilerConfig(param_dict)
         self.checkpoint_config = DeepSpeedCheckpointConfig(param_dict)
+        self.resilience_config = DeepSpeedResilienceConfig(param_dict)
 
         self.fp16_enabled = get_fp16_enabled(param_dict)
         self.bf16_enabled = get_bf16_enabled(param_dict)

@@ -265,6 +265,45 @@ CHECKPOINT_SAVE_ON_PREEMPTION = "save_on_preemption"
 CHECKPOINT_SAVE_ON_PREEMPTION_DEFAULT = False
 
 #############################################
+# Resilience subsystem (deepspeed_tpu/resilience; new — the reference's
+# only runtime failure handling is fp16 overflow skip-and-rescale)
+#############################################
+RESILIENCE = "resilience"
+RESILIENCE_ENABLED = "enabled"
+RESILIENCE_ENABLED_DEFAULT = False
+# what to do about anomalous steps beyond the always-on in-jit skip of
+# non-finite updates: skip | rescale | rollback | abort
+RESILIENCE_POLICY = "policy"
+RESILIENCE_POLICY_DEFAULT = "skip"
+# rolling window (in steps) for the loss-spike z-score; 0 disables
+# spike detection (non-finite detection stays on)
+RESILIENCE_SPIKE_WINDOW = "spike_window"
+RESILIENCE_SPIKE_WINDOW_DEFAULT = 64
+RESILIENCE_SPIKE_ZSCORE = "spike_zscore"
+RESILIENCE_SPIKE_ZSCORE_DEFAULT = 6.0
+# consecutive anomalous steps before rollback/abort policies escalate
+RESILIENCE_DIVERGENCE_PATIENCE = "divergence_patience"
+RESILIENCE_DIVERGENCE_PATIENCE_DEFAULT = 3
+# rollback budget per run; exhausting it aborts with the poison code
+RESILIENCE_MAX_ROLLBACKS = "max_rollbacks"
+RESILIENCE_MAX_ROLLBACKS_DEFAULT = 2
+# re-diverging within this many steps of the restored step = thrashing
+RESILIENCE_ROLLBACK_COOLDOWN_STEPS = "rollback_cooldown_steps"
+RESILIENCE_ROLLBACK_COOLDOWN_STEPS_DEFAULT = 0
+# step watchdog: heartbeat stall (seconds) before the all-thread stack
+# dump + respawnable exit; 0 disables the watchdog
+RESILIENCE_HANG_TIMEOUT_SECS = "hang_timeout_secs"
+RESILIENCE_HANG_TIMEOUT_SECS_DEFAULT = 0.0
+# consecutive overflows with the fp16 loss scale pinned at min_scale
+# before the guard declares the scaler stuck (loud error + anomaly event)
+RESILIENCE_FLOOR_SCALE_PATIENCE = "floor_scale_patience"
+RESILIENCE_FLOOR_SCALE_PATIENCE_DEFAULT = 8
+# where rollback + auto_resume look for the latest committed checkpoint;
+# default: the last directory this engine saved to or loaded from
+RESILIENCE_CHECKPOINT_DIR = "checkpoint_dir"
+RESILIENCE_CHECKPOINT_DIR_DEFAULT = None
+
+#############################################
 # Ring / context parallel attention (TPU addition, SURVEY §5.7)
 #############################################
 RING_ATTENTION = "ring_attention"

@@ -127,10 +127,18 @@ def initialize(args=None,
                collate_fn=None,
                config=None,
                config_params=None,
-               mesh=None):
+               mesh=None,
+               auto_resume=False):
     """Initialize the DeepSpeed-TPU engine (reference ``__init__.py:50-139``).
 
     Returns ``(engine, optimizer, training_dataloader, lr_scheduler)``.
+
+    With ``auto_resume=True`` the engine restores the latest committed
+    checkpoint from ``resilience.checkpoint_dir`` via the atomic
+    ``latest`` pointer (warn-and-start-fresh when none exists) — the
+    respawn half of the resilience contract: a launcher restarting a
+    crashed/hung job re-runs the same script and lands on the last good
+    step instead of step 0.
     """
     log_dist("DeepSpeed-TPU initialize", ranks=[0])
     from .pipe.module import PipelineModule
@@ -151,6 +159,19 @@ def initialize(args=None,
                                  mpu=mpu, dist_init_required=dist_init_required,
                                  collate_fn=collate_fn, config=config,
                                  config_params=config_params, mesh=mesh)
+    if auto_resume:
+        load_dir = engine.resilience_config.checkpoint_dir
+        if load_dir is None:
+            logger.warning(
+                "auto_resume: resilience.checkpoint_dir is not configured; "
+                "starting fresh (set it so respawned jobs resume)")
+        else:
+            path, _ = engine.load_checkpoint(load_dir)
+            if path is None:
+                log_dist(f"auto_resume: no committed checkpoint under "
+                         f"{load_dir}; starting fresh", ranks=[0])
+            else:
+                log_dist(f"auto_resume: resumed from {path}", ranks=[0])
     return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
 
 
@@ -209,6 +230,12 @@ class DeepSpeedEngine:
         self.static_loss_scale = (self._config.loss_scale
                                   if self._config.fp16_enabled and self._config.loss_scale != 0
                                   else 1.0)
+
+        # -- resilience (deepspeed_tpu/resilience): the config is needed
+        # here because _build_step_functions folds the guard's non-finite
+        # detection into the compiled step; the guard/watchdog objects are
+        # built after the checkpoint subsystem below --
+        self.resilience_config = self._config.resilience_config
 
         # -- activation checkpointing (reference checkpointing.configure;
         # VERDICT: config must drive remat, not per-model flags) --
@@ -507,6 +534,41 @@ class DeepSpeedEngine:
             self._ckpt_manager.install_preemption_handler(
                 self._preemption_save)
 
+        # -- resilience runtime guards (deepspeed_tpu/resilience) --
+        rcfg = self.resilience_config
+        self._guard = None
+        self._rollback_mgr = None
+        self._watchdog = None
+        self._step_latencies = None
+        if rcfg.enabled:
+            from ..resilience.guard import AnomalyGuard
+            from ..resilience.rollback import RollbackManager
+
+            scale_args = self._config.dynamic_loss_scale_args or {}
+            self._guard = AnomalyGuard(
+                policy=rcfg.policy, spike_window=rcfg.spike_window,
+                spike_zscore=rcfg.spike_zscore,
+                divergence_patience=rcfg.divergence_patience,
+                floor_scale_patience=rcfg.floor_scale_patience,
+                min_scale=float(scale_args.get("min_scale", 1.0)),
+                fp16=self._config.fp16_enabled)
+            self._rollback_mgr = RollbackManager(
+                self, max_rollbacks=rcfg.max_rollbacks,
+                cooldown_steps=rcfg.rollback_cooldown_steps,
+                checkpoint_dir=rcfg.checkpoint_dir)
+            if rcfg.hang_timeout_secs > 0:
+                from ..profiling.step_profiler import StepLatencyRing
+                from ..resilience.watchdog import StepWatchdog
+
+                self._step_latencies = StepLatencyRing()
+                self._watchdog = StepWatchdog(
+                    rcfg.hang_timeout_secs,
+                    latency_ring=self._step_latencies,
+                    describe=lambda: (
+                        f"global_step={self.global_steps} "
+                        f"micro_steps={self.micro_steps}")).start()
+            log_dist(f"resilience enabled: {rcfg}", ranks=[0])
+
         if self._config.dump_state:
             self._config.print("DeepSpeedEngine configuration")
 
@@ -677,6 +739,15 @@ class DeepSpeedEngine:
                          or self.gradient_accumulation_steps())
         stage3 = self.zero_stage >= 3
         fp16 = self._config.fp16_enabled
+        # Resilience guard: with the subsystem enabled the step computes
+        # the non-finite-gradient flag for EVERY precision (the fp16
+        # loss-scaler's overflow check, generalized) and skips the
+        # optimizer update on it — a NaN burst can never contaminate the
+        # master weights or optimizer moments.  All device-side: the flag
+        # rides the step outputs and the host fetches it in the same
+        # batched transfer fp16 already paid for (no new host syncs).
+        guard_on = bool(self.resilience_config.enabled)
+        skip_bad = fp16 or guard_on
         clip = float(self._config.gradient_clipping or 0.0)
         # Flat-gradient dtype: gradients leave the backward in the compute
         # dtype already and the flatten only concatenates, so when nothing
@@ -774,7 +845,7 @@ class DeepSpeedEngine:
             ms = mesh.devices.flat[0].memory_stats()
             if ms and ms.get("bytes_limit"):
                 stream_min_bytes = int(ms["bytes_limit"] * 0.11)
-        except Exception:
+        except Exception:  # dslint: disable=DSE502 -- memory_stats is an optional backend API; calibration default applies
             pass
         chunk_mb_forced = (chunk_mb > 0 and getattr(
             self._config.zero_config, "offload_chunk_mb_explicit", False))
@@ -915,7 +986,7 @@ class DeepSpeedEngine:
                     gc_ = jax.lax.slice_in_dim(g, gr0 + r0, gr0 + r0 + rc)
                 new_p, new_st = optimizer.update(st, pm, gc_, hp)
                 tok2, tok1 = tok1, new_p[0, 0]
-                if fp16:
+                if skip_bad:
                     new_p = jnp.where(overflow, pm, new_p)
                 if cast_parts is not None:
                     # fold the compute-dtype param cast into the update:
@@ -928,17 +999,18 @@ class DeepSpeedEngine:
                 for li, (old_c, new_l) in enumerate(zip(
                         chunk_leaves, jax.tree_util.tree_leaves(new_st))):
                     if is_flat[li]:
-                        if fp16:
+                        if skip_bad:
                             new_l = jnp.where(overflow, old_c, new_l)
                         leaves[li] = jax.lax.dynamic_update_slice(
                             leaves[li], jax.device_put(new_l, host_big),
                             (r0, 0))
                     elif scalar_out[li] is None:
                         # non-flat state (the step counter): identical per
-                        # chunk; fp16 pick applies as in the full path
+                        # chunk; the overflow pick applies as in the full
+                        # path
                         scalar_out[li] = (jnp.where(overflow, leaves[li],
                                                     new_l)
-                                          if fp16 else new_l)
+                                          if skip_bad else new_l)
 
             cast_list = None
             if cast_parts is not None:
@@ -1014,7 +1086,7 @@ class DeepSpeedEngine:
                              else jnp.concatenate(parts)).reshape(rc, LANES)
                     if clip > 0.0:
                         sq = sq + jnp.sum(chunk ** 2)
-                    if fp16:
+                    if skip_bad:
                         finite = jnp.logical_and(
                             finite, jnp.all(jnp.isfinite(chunk)))
                     tok2, tok1 = tok1, chunk[0, 0]
@@ -1030,7 +1102,7 @@ class DeepSpeedEngine:
             the pinned-host buffer per chunk; unscale + clip fold into a
             single per-chunk multiply (``coef``)."""
             inv = 1.0 / scale_state.cur_scale
-            overflow = (jnp.logical_not(finite) if fp16
+            overflow = (jnp.logical_not(finite) if skip_bad
                         else jnp.asarray(False))
             if clip > 0.0:
                 gnorm = jnp.sqrt(sq) * inv
@@ -1047,7 +1119,7 @@ class DeepSpeedEngine:
                     scale_window=scale_args.get("scale_window", 1000),
                     min_scale=scale_args.get("min_scale", 1.0),
                     delayed_shift=scale_args.get("delayed_shift", 1))
-            if fp16:
+            if skip_bad:
                 skipped = skipped + overflow.astype(jnp.int32)
             return (new_master, new_opt, scale_state, skipped, overflow,
                     gnorm, cast_list)
@@ -1243,7 +1315,7 @@ class DeepSpeedEngine:
             # .astype keeps a compute-dtype flat buffer in its dtype (a
             # traced fp32 scalar would silently promote the whole buffer)
             g = flat_g * inv.astype(flat_g.dtype)
-            if fp16:
+            if skip_bad:
                 overflow = jnp.logical_not(jnp.all(jnp.isfinite(flat_g)))
             else:
                 overflow = jnp.asarray(False)
@@ -1264,7 +1336,7 @@ class DeepSpeedEngine:
                         scale_window=scale_args.get("scale_window", 1000),
                         min_scale=scale_args.get("min_scale", 1.0),
                         delayed_shift=scale_args.get("delayed_shift", 1))
-                if fp16:
+                if skip_bad:
                     skipped = skipped + overflow.astype(jnp.int32)
                 base = (new_master, new_opt, scale_state, skipped, overflow,
                         gnorm)
@@ -1278,11 +1350,11 @@ class DeepSpeedEngine:
             new_master, new_opt = optimizer.update(
                 opt_state, master, g, hp, segments=segments, segment_ids=segment_ids)
 
-            if fp16:
+            if skip_bad:
                 pick = lambda new, old: jnp.where(overflow, old, new)
                 new_master = pick(new_master, master)
                 new_opt = jax.tree_util.tree_map(pick, new_opt, opt_state)
-                if dynamic:
+                if fp16 and dynamic:
                     scale_state = update_scale_state(
                         scale_state, overflow,
                         scale_window=scale_args.get("scale_window", 1000),
@@ -1650,13 +1722,34 @@ class DeepSpeedEngine:
         self._acc_grads = None
         self.global_steps += 1
 
-        if self._config.fp16_enabled:
+        guard_action = None
+        if self._guard is not None or self._config.fp16_enabled:
             # fp16 parity: the reference also syncs on the overflow flag each
             # step (CheckOverflow all_reduce, utils.py:100); scheduler must
-            # not step on a skipped update (engine.py:978-986).
-            self._overflow = bool(jax.device_get(overflow))
+            # not step on a skipped update (engine.py:978-986).  One batched
+            # transfer also carries the guard's loss/scale scalars.
+            fetch = {"overflow": overflow}
+            if self._guard is not None:
+                fetch["losses"] = list(self._losses)
+                fetch["scale"] = self.state["scale"].cur_scale
+            stats = jax.device_get(fetch)
+            self._overflow = bool(stats["overflow"])
+            if self._guard is not None:
+                mean_loss = (float(np.mean(stats["losses"]))
+                             if stats["losses"] else float("nan"))
+                guard_action = self._guard.observe(
+                    mean_loss, self._overflow,
+                    scale=float(stats["scale"]), step=self.global_steps)
         else:
             self._overflow = False
+        if guard_action is not None and self._apply_guard_action(
+                guard_action):
+            self._losses = []
+            if self.wall_clock_breakdown():
+                self.timers("step").stop(sync=False)
+            if self._watchdog is not None:
+                self._watchdog.beat()
+            return
 
         if self.lr_scheduler is not None and not self._overflow:
             self.lr_scheduler.step()
@@ -1693,6 +1786,46 @@ class DeepSpeedEngine:
         if self.wall_clock_breakdown():
             self.timers("step").stop(sync=False)
             self.timers.log(["forward", "step"])
+        if self._watchdog is not None:
+            self._watchdog.beat()
+
+    def _apply_guard_action(self, action):
+        """Escalate an anomaly-guard verdict.  Returns True when a
+        rollback restored earlier state (the caller's remaining step
+        bookkeeping is void); raises
+        :class:`~deepspeed_tpu.resilience.constants.TrainingDivergedError`
+        on abort (directly, or when rollback itself is impossible)."""
+        from ..resilience.constants import TrainingDivergedError
+        from ..resilience.guard import ACTION_ABORT, ACTION_ROLLBACK
+
+        if action == ACTION_ROLLBACK:
+            if self._watchdog is not None:
+                # a checkpoint restore (drain + verify + device_put of the
+                # full state) can legitimately outlast the hang timeout;
+                # disarm until the caller's post-rollback beat re-arms
+                self._watchdog.pause()
+            try:
+                self._rollback_mgr.rollback(
+                    reason=f"{self._guard.consecutive_anomalies} consecutive "
+                           f"anomalous step(s)")
+            except TrainingDivergedError:
+                if self._watchdog is not None:
+                    self._watchdog.stop()
+                raise
+            self._guard.notify_rollback()
+            return True
+        if action == ACTION_ABORT:
+            if self._watchdog is not None:
+                # the abort teardown (final saves, logging, sys.exit with
+                # the POISON code) must never be preempted by the
+                # watchdog's RESPAWNABLE os._exit
+                self._watchdog.stop()
+            raise TrainingDivergedError(
+                f"training diverged at step {self.global_steps}: "
+                f"{self._guard.consecutive_anomalies} consecutive anomalous "
+                f"step(s) under policy={self._guard.policy}; recent "
+                f"anomalies: {self._guard.recent_events()[-5:]}")
+        return False
 
     def train_batch(self, data_iter=None):
         """One full training batch = grad_acc micro steps + update
@@ -1776,10 +1909,39 @@ class DeepSpeedEngine:
             * self.dp_world_size
         self.global_steps += 1
 
-        if self._config.fp16_enabled:
-            self._overflow = bool(jax.device_get(overflow))
+        guard_action = None
+        if self._guard is not None or self._config.fp16_enabled:
+            # ONE batched transfer for every per-step scalar the driver
+            # needs: the overflow flag (fp16 parity: the reference also
+            # syncs on it each step, CheckOverflow all_reduce,
+            # utils.py:100) and — guard on — the loss + loss scale the
+            # anomaly guard classifies.  The guard rides the transfer
+            # fp16 already paid for; it never adds a second sync.
+            fetch = {"overflow": overflow}
+            if self._guard is not None:
+                fetch["loss"] = loss
+                fetch["scale"] = self.state["scale"].cur_scale
+            stats = jax.device_get(fetch)
+            # with the guard on, a skipped (non-finite) update must not
+            # advance the scheduler in ANY precision, same as fp16
+            self._overflow = bool(stats["overflow"])
+            if self._guard is not None:
+                guard_action = self._guard.observe(
+                    float(stats["loss"]), self._overflow,
+                    scale=float(stats["scale"]), step=self.global_steps)
         else:
             self._overflow = False
+        if guard_action is not None and self._apply_guard_action(
+                guard_action):
+            # rolled back: counters, scheduler, and scale state now come
+            # from the restored checkpoint; this step's remaining
+            # bookkeeping belongs to the abandoned timeline
+            if self.wall_clock_breakdown():
+                self.timers("train_batch").stop(sync=False)
+            self.tput_timer.stop()
+            if self._watchdog is not None:
+                self._watchdog.beat()
+            return loss
         if self.lr_scheduler is not None and not self._overflow:
             self.lr_scheduler.step()
         if self.progressive_layer_drop:
@@ -1822,6 +1984,8 @@ class DeepSpeedEngine:
             self.timers("train_batch").stop(sync=True)
             self.timers.log(["train_batch"])
         self.tput_timer.stop()
+        if self._watchdog is not None:
+            self._watchdog.beat()
         return loss
 
     def _train_batch_stepwise(self, micro_batches):

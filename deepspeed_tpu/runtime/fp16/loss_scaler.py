@@ -77,7 +77,9 @@ class DynamicLossScaler(LossScalerBase):
                  scale_window=1000,
                  min_scale=1,
                  delayed_shift=1,
-                 consecutive_hysteresis=False):
+                 consecutive_hysteresis=False,
+                 floor_patience=8,
+                 anomaly_hook=None):
         super().__init__(init_scale)
         self.cur_iter = 0
         self.last_overflow_iter = -1
@@ -87,6 +89,19 @@ class DynamicLossScaler(LossScalerBase):
         self.delayed_shift = delayed_shift
         self.cur_hysteresis = delayed_shift
         self.consecutive_hysteresis = consecutive_hysteresis
+        # pinned-at-floor detection: `cur_scale` silently clamping to
+        # `min_scale` forever used to loop without a word — after
+        # `floor_patience` CONSECUTIVE overflows at the floor this scaler
+        # shouts once and fires `anomaly_hook(consecutive_count)` so a
+        # resilience layer (or the training script) can intervene.
+        # Engine runs use the functional DynamicScaleState form in-jit;
+        # the same detector for THAT path lives host-side in
+        # resilience/guard.py (AnomalyGuard's scale_floor event) — keep
+        # the two thresholds' semantics in sync.
+        self.floor_patience = int(floor_patience)
+        self.anomaly_hook = anomaly_hook
+        self.consecutive_floor_overflows = 0
+        self.floor_stuck = False
 
     def has_overflow_serial(self, params):
         import jax
@@ -121,7 +136,26 @@ class DynamicLossScaler(LossScalerBase):
             else:
                 self.cur_hysteresis -= 1
             self.last_overflow_iter = self.cur_iter
+            if self.cur_scale <= self.min_scale:
+                self.consecutive_floor_overflows += 1
+                if (self.consecutive_floor_overflows >= self.floor_patience
+                        and not self.floor_stuck):
+                    self.floor_stuck = True
+                    from ...utils.logging import logger
+
+                    logger.error(
+                        "DynamicLossScaler: %d consecutive overflows with "
+                        "the loss scale pinned at min_scale=%s — halving "
+                        "can no longer recover this run; the model is "
+                        "producing non-finite gradients at the smallest "
+                        "representable scale (diverged weights or a data "
+                        "problem). Roll back to a checkpoint or abort.",
+                        self.consecutive_floor_overflows, self.min_scale)
+                    if self.anomaly_hook is not None:
+                        self.anomaly_hook(self.consecutive_floor_overflows)
         else:
+            self.consecutive_floor_overflows = 0
+            self.floor_stuck = False
             if self.consecutive_hysteresis:
                 self.cur_hysteresis = self.delayed_shift
             if (self.cur_iter - self.last_overflow_iter) % self.scale_window == 0:

@@ -10,7 +10,10 @@ Three rule families (see ``docs/static_analysis.md``):
 - **config-schema** (DSC4xx): the key/type/default schema extracted from
   the constants modules, with dead-key detection and a runtime
   ``validate_config_dict`` (unknown-key + "did you mean") that
-  ``DeepSpeedConfig`` calls on every construction.
+  ``DeepSpeedConfig`` calls on every construction;
+- **robustness** (DSE5xx): swallowed-failure patterns — bare
+  ``except:`` and broad except-with-empty-body handlers that hide
+  failures from the resilience guard and the logs.
 
 Suppression: ``# dslint: disable=<rule-id>[,<rule-id>...] [-- reason]``
 inline on the flagged line, or standalone on the line above.
@@ -19,7 +22,7 @@ Stdlib-only by design — importable before jax, usable in any CI image.
 """
 
 # importing the rule modules populates the registries
-from . import hotpath, retrace, schema  # noqa: F401
+from . import hotpath, retrace, robustness, schema  # noqa: F401
 from .cli import failing, lint_paths, main
 from .core import RULES, Diagnostic, Rule, register_rule, rule_catalog
 from .schema import (ConfigIssue, dead_key_diagnostics, extract_schema,

@@ -16,7 +16,7 @@ import sys
 from typing import List
 
 # rule modules register their checkers on import
-from . import hotpath, retrace  # noqa: F401
+from . import hotpath, retrace, robustness  # noqa: F401
 from .core import (Diagnostic, FAILING_SEVERITIES, RULES, ParsedFile,
                    check_file, rule_catalog)
 from .schema import (dead_key_diagnostics, get_schema,

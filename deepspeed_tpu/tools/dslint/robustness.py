@@ -1,0 +1,100 @@
+"""Robustness rules: swallowed-failure anti-patterns (DSE5xx).
+
+The resilience subsystem's whole premise is that failures must be LOUD:
+a guard can only escalate anomalies it gets to see.  A ``try`` block
+that eats the exception breaks that chain silently — the classic way a
+"fault-tolerant" training job turns into one that trains garbage for a
+week.  Two shapes are flagged:
+
+- **DSE501** — a bare ``except:`` clause.  Beyond hiding the error it
+  also catches ``SystemExit``/``KeyboardInterrupt``, so it can eat the
+  watchdog's teardown or a Ctrl-C.
+- **DSE502** — an ``except Exception``/``BaseException`` (or bare)
+  handler whose body does literally nothing (``pass`` / ``...``): the
+  failure is not logged, not re-raised, not recorded — gone.
+
+Handlers that narrow the exception type, log, re-raise, or return a
+sentinel are all fine; the rules target only the discard-everything
+shapes.  Legitimate sites (e.g. probing an optional backend API)
+suppress with a reasoned pragma:
+``# dslint: disable=DSE502 -- why``.
+"""
+
+import ast
+from typing import List
+
+from .core import (ParsedFile, Rule, diag, register_file_checker,
+                   register_rule)
+
+register_rule(Rule(
+    id="DSE501", name="bare-except", severity="warning",
+    summary="bare 'except:' clause",
+    rationale="Catches EVERYTHING, including SystemExit and "
+              "KeyboardInterrupt — it can eat a watchdog teardown or a "
+              "Ctrl-C, and hides the real failure from the anomaly "
+              "guard and the logs.",
+    autofix_hint="Catch the narrowest exception type that can actually "
+                 "occur (at widest 'except Exception'), and log or "
+                 "re-raise."))
+
+register_rule(Rule(
+    id="DSE502", name="swallowed-exception", severity="warning",
+    summary="except handler silently discards the failure (body is only "
+            "pass/...)",
+    rationale="A broad handler with an empty body erases the failure: "
+              "nothing is logged, nothing is re-raised, and the "
+              "resilience guard never sees the anomaly — the job keeps "
+              "'succeeding' while broken.",
+    autofix_hint="Log the exception (logger.warning('...: %s', e)), "
+                 "re-raise, or record it; suppress with a reasoned "
+                 "pragma only for genuinely-optional probes."))
+
+_BROAD_TYPES = {"Exception", "BaseException"}
+
+
+def _type_names(expr):
+    """Exception class names named by a handler's type expression."""
+    if expr is None:
+        return set()
+    nodes = expr.elts if isinstance(expr, ast.Tuple) else [expr]
+    out = set()
+    for node in nodes:
+        if isinstance(node, ast.Name):
+            out.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            out.add(node.attr)
+    return out
+
+
+def _is_noop(stmt):
+    return isinstance(stmt, ast.Pass) or (
+        isinstance(stmt, ast.Expr)
+        and isinstance(stmt.value, ast.Constant)
+        and stmt.value.value is Ellipsis)
+
+
+@register_file_checker
+def check_robustness(pf: ParsedFile) -> List:
+    out = []
+    for node in ast.walk(pf.tree):
+        if not isinstance(node, ast.Try):
+            continue
+        for handler in node.handlers:
+            bare = handler.type is None
+            if bare:
+                out.append(diag(
+                    pf, handler, "DSE501",
+                    "bare 'except:' catches SystemExit/KeyboardInterrupt "
+                    "too; name the exception type (at widest 'except "
+                    "Exception')"))
+            broad = bare or (_type_names(handler.type) & _BROAD_TYPES)
+            if broad and all(_is_noop(s) for s in handler.body):
+                caught = ("everything" if bare
+                          else "/".join(sorted(_type_names(handler.type)
+                                               & _BROAD_TYPES)))
+                out.append(diag(
+                    pf, handler, "DSE502",
+                    f"handler catches {caught} and silently discards it "
+                    "(body is only pass/...); log, re-raise, or record "
+                    "the failure"))
+    return out

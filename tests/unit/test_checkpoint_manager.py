@@ -6,6 +6,7 @@ states, and the elastic DP-degree restore through the manager path."""
 import json
 import os
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -478,6 +479,88 @@ def test_wait_errors_are_per_directory(tmp_path, no_hook):
     assert m.save(fake_snapshot(2), str(dir_a), async_save=False)
     m.wait(str(dir_a))
     m.wait()
+
+
+def test_drain_inflight_timeout_path(tmp_path, no_hook):
+    """drain_inflight with a timeout returns False while a writer is
+    stuck (instead of blocking forever) and True once it finishes; the
+    checkpoint still commits intact afterwards."""
+    from deepspeed_tpu.checkpoint.manager import drain_inflight
+
+    gate = threading.Event()
+    started = threading.Event()
+
+    def block(tmp_dir, name):
+        if name == ckpt.OPTIM_STATES_NPZ:
+            started.set()
+            assert gate.wait(timeout=60), "test deadlock"
+
+    ckpt_writer._file_written_hook = block
+    m = manager()
+    try:
+        assert m.save(fake_snapshot(1), str(tmp_path), async_save=True)
+        assert started.wait(timeout=60), "writer never started"
+        t0 = time.monotonic()
+        assert not drain_inflight(str(tmp_path), timeout=0.2)
+        assert time.monotonic() - t0 < 5  # timed out, didn't hang
+        # a zero timeout is a pure poll
+        assert not drain_inflight(str(tmp_path), timeout=0.0)
+    finally:
+        gate.set()
+        ckpt_writer._file_written_hook = None
+    assert drain_inflight(str(tmp_path), timeout=60)
+    m.wait(str(tmp_path))
+    assert ckpt.read_latest(str(tmp_path)) == "global_step1"
+
+
+def test_preemption_handler_chained_not_self_chained(tmp_path):
+    """Installing handlers from several managers must chain the ORIGINAL
+    disposition exactly once — never the preemption handler over itself
+    (which would re-run every callback recursively on delivery)."""
+    import signal
+
+    from deepspeed_tpu.checkpoint import manager as mgr_mod
+
+    chained = []
+    old = signal.signal(signal.SIGTERM, lambda s, f: chained.append(s))
+    cbs_before = list(mgr_mod._PREEMPT_CALLBACKS)
+    prev_before = dict(mgr_mod._PREEMPT_PREVIOUS)
+    try:
+        calls = []
+        m1, m2 = manager(), manager()
+        assert m1.install_preemption_handler(lambda: calls.append(1))
+        assert m2.install_preemption_handler(lambda: calls.append(2))
+        # the second install saw our handler already in place and must
+        # NOT have recorded it as the disposition to chain to
+        assert (signal.getsignal(signal.SIGTERM)
+                is mgr_mod._preemption_handler)
+        assert (mgr_mod._PREEMPT_PREVIOUS[signal.SIGTERM]
+                is not mgr_mod._preemption_handler)
+        signal.raise_signal(signal.SIGTERM)
+        assert sorted(calls) == [1, 2]       # every callback ran once
+        assert chained == [signal.SIGTERM]   # original handler ran ONCE
+    finally:
+        mgr_mod._PREEMPT_CALLBACKS[:] = cbs_before
+        mgr_mod._PREEMPT_PREVIOUS.clear()
+        mgr_mod._PREEMPT_PREVIOUS.update(prev_before)
+        signal.signal(signal.SIGTERM, old)
+
+
+def test_preemption_handler_refused_off_main_thread():
+    """Signal handlers can only be installed from the main thread; a
+    worker-thread install must refuse (False) without touching the
+    process disposition."""
+    import signal
+
+    before = signal.getsignal(signal.SIGTERM)
+    results = []
+    m = manager()
+    t = threading.Thread(target=lambda: results.append(
+        m.install_preemption_handler(lambda: None)))
+    t.start()
+    t.join()
+    assert results == [False]
+    assert signal.getsignal(signal.SIGTERM) is before
 
 
 def test_preemption_callbacks_drop_dead_engines(tmp_path):

@@ -643,4 +643,118 @@ def test_cli_validates_config_files(tmp_path):
 def test_every_rule_id_is_documented():
     for rule in RULES.values():
         assert rule.summary and rule.rationale, rule.id
-        assert rule.id[:3] in ("DSH", "DSR", "DSC")
+        assert rule.id[:3] in ("DSH", "DSR", "DSC", "DSE")
+
+
+# ---------------------------------------------------------------------------
+# robustness rules (DSE5xx: swallowed failures)
+# ---------------------------------------------------------------------------
+
+def test_dse501_bare_except(tmp_path):
+    ids = lint_source(tmp_path, """
+def load(path):
+    try:
+        return open(path).read()
+    except:
+        return None
+""")
+    assert ids == ["DSE501"]
+
+
+def test_dse501_clean_twin_named_type(tmp_path):
+    ids = lint_source(tmp_path, """
+def load(path):
+    try:
+        return open(path).read()
+    except OSError:
+        return None
+""")
+    assert ids == []
+
+
+def test_dse502_except_exception_pass(tmp_path):
+    ids = lint_source(tmp_path, """
+def probe():
+    try:
+        risky()
+    except Exception:
+        pass
+""")
+    assert ids == ["DSE502"]
+
+
+def test_dse502_bare_except_pass_flags_both(tmp_path):
+    ids = lint_source(tmp_path, """
+def probe():
+    try:
+        risky()
+    except:
+        ...
+""")
+    assert ids == ["DSE501", "DSE502"]
+
+
+def test_dse502_tuple_type_and_baseexception(tmp_path):
+    ids = lint_source(tmp_path, """
+def probe():
+    try:
+        risky()
+    except (ValueError, Exception):
+        pass
+
+def probe2():
+    try:
+        risky()
+    except BaseException:
+        pass
+""")
+    assert ids == ["DSE502"]
+
+
+def test_dse502_clean_twins(tmp_path):
+    # logging, re-raising, returning a sentinel, or narrowing the type
+    # are all legitimate handler bodies
+    ids = lint_source(tmp_path, """
+import logging
+
+def handled():
+    try:
+        risky()
+    except Exception as e:
+        logging.warning("probe failed: %s", e)
+
+def reraised():
+    try:
+        risky()
+    except Exception:
+        raise RuntimeError("context")
+
+def sentinel():
+    try:
+        return risky()
+    except Exception:
+        return None
+
+def narrow():
+    try:
+        risky()
+    except KeyError:
+        pass
+""")
+    assert ids == []
+
+
+def test_dse502_pragma_suppression(tmp_path):
+    from deepspeed_tpu.tools.dslint import lint_paths as lp
+
+    path = tmp_path / "snippet.py"
+    path.write_text("""
+def probe():
+    try:
+        risky()
+    except Exception:  # dslint: disable=DSE502 -- optional backend probe
+        pass
+""")
+    diags = lp([str(path)])
+    assert not failing(diags)
+    assert any(d.suppressed and d.rule_id == "DSE502" for d in diags)

@@ -16,8 +16,11 @@ PKG_DIR = os.path.dirname(os.path.abspath(deepspeed_tpu.__file__))
 # Every suppression in the tree is an explicit, reasoned pragma; this
 # budget keeps "add a pragma" from becoming the path of least resistance.
 # Raise it only with a `-- reason` on the new pragma line.
-MAX_SUPPRESSIONS = 8
-ALLOWED_SUPPRESSED_RULES = {"DSC401", "DSH102", "DSH202", "DSH203"}
+# (raised 8 -> 14 with the DSE5xx swallowed-failure rules: 7 pre-existing
+# optional-probe `except Exception: pass` sites got reasoned pragmas)
+MAX_SUPPRESSIONS = 14
+ALLOWED_SUPPRESSED_RULES = {"DSC401", "DSH102", "DSH202", "DSH203",
+                            "DSE502"}
 
 
 def _diags():

@@ -286,6 +286,153 @@ def test_env_reaches_spawned_process(tmp_path):
     assert out.read_text() == "--marker=42|from_file"
 
 
+def _order_guard_loader():
+    from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+
+    data = [np.zeros((2,), np.float32)] * 8
+    return DeepSpeedDataLoader(data, batch_size=4,
+                               data_parallel_world_size=2,
+                               data_parallel_rank=0)
+
+
+def test_verify_shared_order_raises_on_divergence(monkeypatch):
+    """Mismatched cross-host sample order must raise the RuntimeError
+    (silent shard duplication otherwise); matching order must not."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    loader = _order_guard_loader()
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    # two processes reporting DIFFERENT fingerprints
+    monkeypatch.setattr(
+        multihost_utils, "process_allgather",
+        lambda fp: np.stack([np.asarray(fp), np.asarray(fp) + 1]))
+    with pytest.raises(RuntimeError, match="order drift"):
+        loader._verify_shared_order(np.arange(8))
+    # identical fingerprints: no raise
+    monkeypatch.setattr(
+        multihost_utils, "process_allgather",
+        lambda fp: np.stack([np.asarray(fp), np.asarray(fp)]))
+    loader._verify_shared_order(np.arange(8))
+
+
+def test_verify_shared_order_env_and_epoch_gating(monkeypatch):
+    import jax
+    from jax.experimental import multihost_utils
+
+    loader = _order_guard_loader()
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(
+        multihost_utils, "process_allgather",
+        lambda fp: np.stack([np.asarray(fp), np.asarray(fp) + 1]))
+    # DS_VERIFY_DATA_ORDER=never skips the collective entirely
+    monkeypatch.setenv("DS_VERIFY_DATA_ORDER", "never")
+    loader._verify_shared_order(np.arange(8))
+    # default epoch0 mode skips past the first epoch (no sync point a
+    # dead process could strand the others in)
+    monkeypatch.delenv("DS_VERIFY_DATA_ORDER", raising=False)
+    loader.epoch = 3
+    loader._verify_shared_order(np.arange(8))
+    loader.epoch = 1
+    with pytest.raises(RuntimeError, match="order drift"):
+        loader._verify_shared_order(np.arange(8))
+    # world-1 loaders never dial the collective, whatever the env says
+    from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+
+    solo = DeepSpeedDataLoader([np.zeros((2,), np.float32)] * 8,
+                               batch_size=4)
+    monkeypatch.setenv("DS_VERIFY_DATA_ORDER", "always")
+    solo._verify_shared_order(np.arange(8))
+
+
+# ---------------------------------------------------------------------------
+# resilience exit-code contract (launch.py)
+# ---------------------------------------------------------------------------
+
+def _launch_main(tmp_path, script_body, script_args=(), max_restarts=0):
+    """Drive launch.main() inline with one local child slot; returns the
+    SystemExit code."""
+    from deepspeed_tpu.launcher import launch
+    from deepspeed_tpu.launcher.runner import encode_world_info
+    import signal
+
+    script = tmp_path / "child.py"
+    script.write_text(script_body)
+    wi = encode_world_info({socket.gethostname(): [0]})
+    argv = ["--world_info", wi, "--node_rank", "0",
+            "--master_addr", "127.0.0.1", "--master_port", "29999",
+            "--max-restarts", str(max_restarts),
+            str(script), *script_args]
+    old_int = signal.getsignal(signal.SIGINT)
+    old_term = signal.getsignal(signal.SIGTERM)
+    try:
+        with pytest.raises(SystemExit) as exc:
+            launch.main(argv)
+        return exc.value.code
+    finally:
+        signal.signal(signal.SIGINT, old_int)
+        signal.signal(signal.SIGTERM, old_term)
+
+
+def test_map_exit_code_signal_names():
+    import signal
+
+    from deepspeed_tpu.launcher.launch import map_exit_code
+
+    assert map_exit_code(0) == (0, None)
+    assert map_exit_code(7) == (7, None)
+    assert map_exit_code(-signal.SIGKILL) == (137, "SIGKILL")
+    assert map_exit_code(-signal.SIGSEGV) == (139, "SIGSEGV")
+
+
+def test_launch_maps_child_signal_death(tmp_path, monkeypatch):
+    """A child killed by a signal must exit the launcher with 128+signum
+    (launch.py used to sys.exit the raw negative poll() value)."""
+    monkeypatch.setenv("DS_MONITOR_POLL_SECS", "0.1")
+    code = _launch_main(
+        tmp_path,
+        "import os, signal\nos.kill(os.getpid(), signal.SIGKILL)\n")
+    assert code == 137
+
+
+def test_launch_max_restarts_recovers_flaky_child(tmp_path, monkeypatch):
+    """--max-restarts respawns a failed child with backoff; a child that
+    succeeds on its second life exits the node cleanly."""
+    monkeypatch.setenv("DS_MONITOR_POLL_SECS", "0.1")
+    monkeypatch.setenv("DS_RESTART_BACKOFF_SECS", "0.05")
+    marker = tmp_path / "ran_once"
+    code = _launch_main(
+        tmp_path,
+        "import os, sys\n"
+        "marker = sys.argv[1]\n"
+        "if os.path.exists(marker):\n"
+        "    sys.exit(0)\n"
+        "open(marker, 'w').write('x')\n"
+        "sys.exit(1)\n",
+        script_args=(str(marker),), max_restarts=1)
+    assert code == 0
+    assert marker.exists()
+
+
+def test_launch_poison_exit_code_never_respawns(tmp_path, monkeypatch):
+    """A divergence abort must tear the node down immediately even with
+    restart budget left — respawning replays the same divergence."""
+    from deepspeed_tpu.resilience import EXIT_DIVERGENCE_ABORT
+
+    monkeypatch.setenv("DS_MONITOR_POLL_SECS", "0.1")
+    monkeypatch.setenv("DS_RESTART_BACKOFF_SECS", "0.05")
+    counter = tmp_path / "runs"
+    code = _launch_main(
+        tmp_path,
+        "import sys\n"
+        "with open(sys.argv[1], 'a') as f:\n"
+        "    f.write('x')\n"
+        f"sys.exit({EXIT_DIVERGENCE_ABORT})\n",
+        script_args=(str(counter),), max_restarts=3)
+    assert code == EXIT_DIVERGENCE_ABORT
+    assert counter.read_text() == "x"   # ran exactly once
+
+
 def test_dataloader_order_fingerprint():
     """The multi-host order-drift guard's fingerprint: deterministic,
     order-sensitive, and cheap (weak spot: silent shard duplication when
