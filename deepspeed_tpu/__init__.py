@@ -14,6 +14,7 @@ __git_branch__ = None
 from . import comm  # noqa: F401
 from . import elasticity  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import telemetry  # noqa: F401
 from .runtime.activation_checkpointing import checkpointing  # noqa: F401
 from .parallel import (CANONICAL_AXES, DATA_AXIS, MODEL_AXIS, PIPE_AXIS,  # noqa: F401
                        SEQ_AXIS, MeshGrid, PipeDataParallelTopology,

@@ -123,6 +123,15 @@ class CheckpointManager:
         self.config = config or DeepSpeedCheckpointConfig({})
         self.last_error = None            # last failed commit's exception
         self._errors = {}                 # dir key -> last failed commit
+        # optional TelemetryManager (engine-injected; this module never
+        # imports telemetry): checkpoint lifecycle events — queue depth,
+        # commit latency/bytes/retries, failures — emitted from the save
+        # path and the background writer threads (sinks are thread-safe)
+        self.telemetry = None
+
+    def _emit(self, event_type, step=None, **data):
+        if self.telemetry is not None:
+            self.telemetry.emit(event_type, step=step, **data)
 
     # ------------------------------------------------------------- save
     def save(self, snapshot, save_dir, async_save=None):
@@ -150,11 +159,16 @@ class CheckpointManager:
         # snapshot (and try to join) a not-yet-started thread
         with _REGISTRY_LOCK:
             _INFLIGHT.setdefault(key, []).append(thread)
+            depth = len(_INFLIGHT[key])
             try:
                 thread.start()
             except Exception:
                 _INFLIGHT[key].remove(thread)
                 raise
+        self._emit("ckpt_queued", step=snapshot.global_steps,
+                   tag=str(snapshot.tag), queue_depth=depth)
+        if self.telemetry is not None:
+            self.telemetry.gauge("ckpt/queue_depth").set(depth)
         return True
 
     def wait(self, save_dir=None, timeout=None):
@@ -181,6 +195,12 @@ class CheckpointManager:
                 threads = _INFLIGHT.get(_dir_key(save_dir), [])
                 threads[:] = [t for t in threads
                               if t is not threading.current_thread()]
+                depth = len(threads)
+            if self.telemetry is not None:
+                # drain side of the queue-depth gauge: without this the
+                # last enqueue's depth sticks in every later snapshot and
+                # reads as a permanently stuck writer
+                self.telemetry.gauge("ckpt/queue_depth").set(depth)
 
     def _commit(self, snapshot, save_dir):
         lock = _dir_lock(save_dir)
@@ -208,11 +228,14 @@ class CheckpointManager:
     def _commit_locked(self, snapshot, save_dir):
         attempts = self.config.save_retries + 1
         final_dir = None
+        t_commit0 = time.monotonic()
+        retries_used = 0
         for attempt in range(attempts):
             try:
                 final_dir = writer.write_checkpoint(
                     save_dir, snapshot.tag, snapshot.file_writers(),
                     extra_manifest=snapshot.manifest_extra())
+                retries_used = attempt
                 break
             except Exception as e:  # noqa: BLE001 — retry any I/O error
                 if attempt + 1 >= attempts:
@@ -221,6 +244,7 @@ class CheckpointManager:
                     logger.error(
                         f"checkpoint {snapshot.tag} failed after "
                         f"{attempts} attempt(s): {e}")
+                    self._commit_failed_telemetry(snapshot, e)
                     return False
                 backoff = self.config.retry_backoff_secs * (2 ** attempt)
                 logger.warning(
@@ -246,6 +270,7 @@ class CheckpointManager:
             self._errors[key] = e
             logger.error(f"checkpoint {snapshot.tag} committed but "
                          f"'latest' pointer update failed: {e}")
+            self._commit_failed_telemetry(snapshot, e)
             return False
         if snapshot.save_latest:
             # save_latest=False commits (archival tags) must not pin the
@@ -259,8 +284,43 @@ class CheckpointManager:
         except Exception as e:  # noqa: BLE001 — the save itself landed
             logger.warning(f"retention sweep after {snapshot.tag} "
                            f"failed (checkpoint is committed): {e}")
+        self._commit_ok_telemetry(snapshot, final_dir,
+                                  time.monotonic() - t_commit0,
+                                  retries_used)
         log_dist(f"saved checkpoint {final_dir}", ranks=[0])
         return True
+
+    # --------------------------------------------------------- telemetry
+    def _commit_ok_telemetry(self, snapshot, final_dir, latency_secs,
+                             retries):
+        if self.telemetry is None:
+            return
+        total_bytes = 0
+        try:
+            manifest = writer.read_manifest(final_dir)
+            if manifest:
+                total_bytes = sum(
+                    int(e.get("bytes", 0))
+                    for e in manifest.get("files", {}).values())
+        except (OSError, ValueError) as e:
+            logger.warning("telemetry: unreadable manifest under "
+                           f"{final_dir}: {e}")
+        self._emit("ckpt_commit", step=snapshot.global_steps,
+                   tag=str(snapshot.tag), latency_secs=float(latency_secs),
+                   bytes=total_bytes, retries=int(retries))
+        self.telemetry.counter("ckpt/commits").inc()
+        self.telemetry.counter("ckpt/bytes_written").inc(total_bytes)
+        if retries:
+            self.telemetry.counter("ckpt/retries").inc(retries)
+        self.telemetry.histogram("ckpt/commit_latency_secs").observe(
+            latency_secs)
+
+    def _commit_failed_telemetry(self, snapshot, error):
+        if self.telemetry is None:
+            return
+        self._emit("ckpt_failed", step=snapshot.global_steps,
+                   tag=str(snapshot.tag), error=str(error))
+        self.telemetry.counter("ckpt/failures").inc()
 
     # -------------------------------------------------------- retention
     def _list_committed(self, save_dir):

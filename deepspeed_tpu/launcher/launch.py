@@ -30,6 +30,9 @@ import sys
 import time
 
 from ..resilience.constants import POISON_EXIT_CODES
+# stdlib-only import chain on purpose: the launcher must not need jax
+from ..telemetry.events import (EVENT_PROC_EXIT, EVENT_PROC_RESPAWN,
+                                EVENT_PROC_SPAWN, EVENT_RUN_END, EventLog)
 from ..utils.logging import logger
 from .constants import (ENV_COORDINATOR, ENV_LOCAL_RANK, ENV_NUM_PROCESSES,
                         ENV_PROCESS_ID)
@@ -47,6 +50,13 @@ def parse_args(args=None):
                         default=0, dest="max_restarts",
                         help="respawn a failed child up to N times with "
                              "backoff (poison exit codes never respawn)")
+    parser.add_argument("--telemetry-dir", "--telemetry_dir", type=str,
+                        default=os.environ.get("DS_TELEMETRY_DIR", ""),
+                        dest="telemetry_dir",
+                        help="telemetry run dir: spawn/exit/respawn events "
+                             "land in events-launcher.jsonl there (point "
+                             "it at the engines' telemetry.run_dir so the "
+                             "report CLI merges one timeline)")
     parser.add_argument("training_script", type=str)
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     ns = parser.parse_args(args)
@@ -95,6 +105,17 @@ def main(argv=None):
     local_slots = world[hosts[node_rank]]
     total = sum(len(v) for v in world.values())
 
+    # structured telemetry: restarts and exit codes become queryable
+    # events instead of log lines (report CLI merges this stream with the
+    # training ranks' events when they share a run dir)
+    tel = (EventLog(args.telemetry_dir, rank="launcher",
+                    filename="events-launcher.jsonl")
+           if args.telemetry_dir else None)
+
+    def tel_emit(event_type, **data):
+        if tel is not None:
+            tel.emit(event_type, **data)
+
     children = []   # [{proc, cmd, env, rank, restarts}]
     for local_rank, slot in enumerate(local_slots):
         env = os.environ.copy()
@@ -112,6 +133,8 @@ def main(argv=None):
                          "cmd": cmd, "env": env,
                          "rank": first_id + local_rank, "restarts": 0,
                          "respawn_at": None})
+        tel_emit(EVENT_PROC_SPAWN, proc_rank=first_id + local_rank,
+                 pid=children[-1]["proc"].pid)
 
     # Children may install a preemption checkpoint hook (checkpoint
     # subsystem, "save_on_preemption") that drains one final synchronous
@@ -139,6 +162,15 @@ def main(argv=None):
                                "grace after signal; killing")
                 p.kill()
 
+    def tel_end(reason):
+        # terminal marker for the launcher stream; reached from BOTH the
+        # normal monitor-loop exit and the signal path (sys.exit there
+        # would otherwise skip the end-of-main emit and the merged report
+        # would read a clean preemption as a crashed launcher)
+        if tel is not None:
+            tel.emit(EVENT_RUN_END, reason=reason)
+            tel.close()
+
     def forward_signal(signum, _frame):
         # the long grace exists for the SIGTERM preemption-save path; a
         # Ctrl-C should not pin the launcher for 30s (and a second Ctrl-C
@@ -149,6 +181,7 @@ def main(argv=None):
             terminate_all(signum, grace=min(grace_secs, 2.0))
         else:
             terminate_all(signum)
+        tel_end(f"launcher signal {signum}")
         sys.exit(128 + signum)
 
     signal.signal(signal.SIGINT, forward_signal)
@@ -174,11 +207,16 @@ def main(argv=None):
                     child["respawn_at"] = None
                     child["proc"] = subprocess.Popen(child["cmd"],
                                                      env=child["env"])
+                    tel_emit(EVENT_PROC_SPAWN, proc_rank=child["rank"],
+                             pid=child["proc"].pid,
+                             restart=child["restarts"])
                 continue
             ret = child["proc"].poll()
             if ret is None:
                 continue
             code, signame = map_exit_code(ret)
+            tel_emit(EVENT_PROC_EXIT, proc_rank=child["rank"], code=code,
+                     signal=signame)
             if code == 0:
                 alive.remove(child)
                 continue
@@ -199,6 +237,9 @@ def main(argv=None):
                     f"{where} exited with code {code}; respawning "
                     f"(restart {child['restarts']}/{args.max_restarts}) "
                     f"after {delay:.1f}s backoff")
+                tel_emit(EVENT_PROC_RESPAWN, proc_rank=child["rank"],
+                         restart=child["restarts"], backoff_secs=delay,
+                         exit_code=code)
                 child["proc"] = None
                 child["respawn_at"] = time.time() + delay
                 continue
@@ -210,6 +251,7 @@ def main(argv=None):
             terminate_all()
             if rc == 0:  # keep the FIRST failure, not siblings' SIGTERM
                 rc = code
+    tel_end(f"launcher exit rc={rc}")
     sys.exit(rc)
 
 

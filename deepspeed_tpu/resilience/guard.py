@@ -66,9 +66,13 @@ class AnomalyGuard:
     def __init__(self, policy=POLICY_SKIP, spike_window=64,
                  spike_zscore=6.0, divergence_patience=3,
                  floor_scale_patience=8, min_scale=1.0, fp16=False,
-                 max_events=256):
+                 max_events=256, event_sink=None):
         assert policy in GUARD_POLICIES, policy
         self.policy = policy
+        # optional (step, kind, detail) callback — the telemetry bridge:
+        # every recorded anomaly also lands in the structured event
+        # stream.  Host-side only, called with already-fetched scalars.
+        self.event_sink = event_sink
         self.spike_zscore = float(spike_zscore)
         self.divergence_patience = int(divergence_patience)
         self.floor_scale_patience = int(floor_scale_patience)
@@ -104,6 +108,12 @@ class AnomalyGuard:
     def _record(self, step, kind, detail):
         self.events.append((step, kind, detail))
         self.total_anomalies += 1
+        if self.event_sink is not None:
+            try:
+                self.event_sink(step, kind, detail)
+            except Exception as e:  # noqa: BLE001 — observability must
+                # never escalate an anomaly into a training crash
+                logger.error("anomaly event sink failed: %s", e)
 
     def observe(self, loss, overflow, scale=None, step=None):
         """Classify one completed step; returns one of ``ACTION_*``.

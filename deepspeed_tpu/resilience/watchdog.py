@@ -35,9 +35,14 @@ class StepWatchdog:
     """Heartbeat monitor for one engine's step loop."""
 
     def __init__(self, timeout_secs, poll_interval=None, exit_fn=None,
-                 dump_file=None, latency_ring=None, describe=None):
+                 dump_file=None, latency_ring=None, describe=None,
+                 on_fire=None):
         assert timeout_secs > 0, "watchdog timeout must be > 0"
         self.timeout_secs = float(timeout_secs)
+        # optional (stalled_secs) callback run after the dump, before the
+        # exit — the telemetry flush hook (os._exit skips atexit, so the
+        # tail events must land here or be lost with the process)
+        self._on_fire = on_fire
         self.poll_interval = float(poll_interval
                                    if poll_interval is not None
                                    else min(1.0, self.timeout_secs / 4))
@@ -90,6 +95,11 @@ class StepWatchdog:
                 continue
             self.fired = True
             self.dump(stalled)
+            if self._on_fire is not None:
+                try:
+                    self._on_fire(stalled)
+                except Exception as e:  # noqa: BLE001 — dying anyway
+                    logger.error("watchdog on_fire hook failed: %s", e)
             self._exit_fn(EXIT_STEP_HANG)
             return
 

@@ -26,6 +26,7 @@ from .activation_checkpointing.config import DeepSpeedActivationCheckpointingCon
 from ..profiling.config import DeepSpeedFlopsProfilerConfig
 from ..checkpoint.config import DeepSpeedCheckpointConfig
 from ..resilience.config import DeepSpeedResilienceConfig
+from ..telemetry.config import DeepSpeedTelemetryConfig
 
 TENSOR_CORE_ALIGN_SIZE = 8
 ADAM_OPTIMIZER = C.ADAM_OPTIMIZER
@@ -352,6 +353,7 @@ class DeepSpeedConfig:
         self.flops_profiler_config = DeepSpeedFlopsProfilerConfig(param_dict)
         self.checkpoint_config = DeepSpeedCheckpointConfig(param_dict)
         self.resilience_config = DeepSpeedResilienceConfig(param_dict)
+        self.telemetry_config = DeepSpeedTelemetryConfig(param_dict)
 
         self.fp16_enabled = get_fp16_enabled(param_dict)
         self.bf16_enabled = get_bf16_enabled(param_dict)

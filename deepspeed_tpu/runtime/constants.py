@@ -304,6 +304,34 @@ RESILIENCE_CHECKPOINT_DIR = "checkpoint_dir"
 RESILIENCE_CHECKPOINT_DIR_DEFAULT = None
 
 #############################################
+# Telemetry subsystem (deepspeed_tpu/telemetry; new — the reference's
+# observability is inline tensorboard scalars + throughput log lines)
+#############################################
+TELEMETRY = "telemetry"
+TELEMETRY_ENABLED = "enabled"
+TELEMETRY_ENABLED_DEFAULT = False
+# where event streams / trace files / metric snapshots land; the report
+# CLI reads this directory.  Empty -> "runs/telemetry"
+TELEMETRY_RUN_DIR = "run_dir"
+TELEMETRY_RUN_DIR_DEFAULT = ""
+# structured JSONL event stream (events-rank<k>.jsonl)
+TELEMETRY_EVENTS = "events"
+TELEMETRY_EVENTS_DEFAULT = True
+# Chrome-trace host-phase spans (trace-rank<k>.json, Perfetto-loadable)
+TELEMETRY_TRACE = "trace"
+TELEMETRY_TRACE_DEFAULT = False
+# span cap per trace file: past it new spans are dropped (loudly)
+TELEMETRY_TRACE_MAX_EVENTS = "trace_max_events"
+TELEMETRY_TRACE_MAX_EVENTS_DEFAULT = 200000
+# on-demand jax.profiler device traces: touching <run_dir>/
+# device_trace.trigger starts one, auto-stopped after this many seconds
+TELEMETRY_DEVICE_TRACE_SECS = "device_trace_secs"
+TELEMETRY_DEVICE_TRACE_SECS_DEFAULT = 10.0
+# override the trigger-file path (empty -> <run_dir>/device_trace.trigger)
+TELEMETRY_DEVICE_TRACE_TRIGGER = "device_trace_trigger"
+TELEMETRY_DEVICE_TRACE_TRIGGER_DEFAULT = ""
+
+#############################################
 # Ring / context parallel attention (TPU addition, SURVEY §5.7)
 #############################################
 RING_ATTENTION = "ring_attention"

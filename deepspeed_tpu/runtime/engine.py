@@ -35,6 +35,7 @@ PartitionSpec`` enables tensor parallelism over the ``model`` axis.
 import json
 import os
 import pickle
+import time
 
 import jax
 import jax.numpy as jnp
@@ -49,6 +50,7 @@ from ..ops.adam.fused_adam import FusedAdam
 from ..ops.lamb.fused_lamb import FusedLamb
 from ..ops.op_common import LANES
 from ..parallel.mesh import DATA_AXIS, MeshGrid, make_mesh, set_current_mesh
+from ..telemetry import events as TEL
 from ..utils.distributed import init_distributed
 from ..utils.logging import log_dist, logger
 from ..utils.timer import SynchronizedWallClockTimer, ThroughputTimer
@@ -507,6 +509,24 @@ class DeepSpeedEngine:
             batch_size=self.train_micro_batch_size_per_gpu() * self.dp_world_size,
             num_workers=1, steps_per_output=self.steps_per_print())
 
+        # -- telemetry (deepspeed_tpu/telemetry): the monitor becomes a
+        # consumer of the event stream — scalars flow through
+        # telemetry.step_metrics, which feeds TB/JSONL unchanged.  Every
+        # telemetry call below is host-only Python on scalars fetched by
+        # the EXISTING batched steps_per_print transfer: zero new syncs.
+        from ..telemetry.manager import TelemetryManager
+
+        self.telemetry_config = self._config.telemetry_config
+        self.telemetry = TelemetryManager(self.telemetry_config,
+                                          rank=jax.process_index(),
+                                          monitor=self.monitor)
+        self.telemetry.emit(
+            TEL.EVENT_RUN_START, step=0, world_size=self.world_size,
+            dp=self.dp_world_size,
+            precision=("fp16" if self._config.fp16_enabled else
+                       "bf16" if self._config.bf16_enabled else "fp32"),
+            zero_stage=self.zero_stage)
+
         self.global_steps = 0
         self.micro_steps = 0
         self.global_samples = 0
@@ -529,6 +549,10 @@ class DeepSpeedEngine:
         # -- checkpoint subsystem (deepspeed_tpu/checkpoint) --
         self.checkpoint_config = self._config.checkpoint_config
         self._ckpt_manager = CheckpointManager(self.checkpoint_config)
+        # lifecycle events (queue depth, commit latency/bytes/retries)
+        # ride the manager's own save/commit paths, including the
+        # background writer threads (EventLog/registry are thread-safe)
+        self._ckpt_manager.telemetry = self.telemetry
         self._last_ckpt_dir = None
         if self.checkpoint_config.save_on_preemption:
             self._ckpt_manager.install_preemption_handler(
@@ -551,7 +575,8 @@ class DeepSpeedEngine:
                 divergence_patience=rcfg.divergence_patience,
                 floor_scale_patience=rcfg.floor_scale_patience,
                 min_scale=float(scale_args.get("min_scale", 1.0)),
-                fp16=self._config.fp16_enabled)
+                fp16=self._config.fp16_enabled,
+                event_sink=self._telemetry_anomaly)
             self._rollback_mgr = RollbackManager(
                 self, max_rollbacks=rcfg.max_rollbacks,
                 cooldown_steps=rcfg.rollback_cooldown_steps,
@@ -566,7 +591,8 @@ class DeepSpeedEngine:
                     latency_ring=self._step_latencies,
                     describe=lambda: (
                         f"global_step={self.global_steps} "
-                        f"micro_steps={self.micro_steps}")).start()
+                        f"micro_steps={self.micro_steps}"),
+                    on_fire=self._telemetry_watchdog_fire).start()
             log_dist(f"resilience enabled: {rcfg}", ranks=[0])
 
         if self._config.dump_state:
@@ -643,6 +669,34 @@ class DeepSpeedEngine:
 
     def get_master_params(self):
         return self.state["master"]
+
+    # ------------------------------------------------------------------
+    # telemetry plumbing (deepspeed_tpu/telemetry)
+    # ------------------------------------------------------------------
+    def _telemetry_anomaly(self, step, kind, detail):
+        """AnomalyGuard event sink: every classified anomaly lands in the
+        structured event stream (host scalars only — the guard already
+        runs on the one batched per-step fetch)."""
+        self.telemetry.emit(
+            TEL.EVENT_ANOMALY, step=step, kind=kind, detail=detail,
+            consecutive=(self._guard.consecutive_anomalies
+                         if self._guard is not None else 0))
+        self.telemetry.counter("resilience/anomalies").inc()
+
+    def _telemetry_watchdog_fire(self, stalled_secs):
+        """Watchdog fire hook: the process dies via ``os._exit`` next, so
+        the tail events must be flushed HERE — atexit never runs."""
+        self.telemetry.emit(
+            TEL.EVENT_WATCHDOG_HANG, step=self.global_steps,
+            stalled_secs=float(stalled_secs),
+            timeout_secs=float(self.resilience_config.hang_timeout_secs))
+        self.telemetry.flush(reason="watchdog_hang")
+
+    def close(self):
+        """Flush + close every telemetry sink (events, trace, metrics
+        snapshot, monitor).  Idempotent; also registered via atexit, so a
+        normally-exiting run keeps its tail events without calling this."""
+        self.telemetry.close()
 
     # ------------------------------------------------------------------
     # construction helpers
@@ -1732,9 +1786,12 @@ class DeepSpeedEngine:
             if self._guard is not None:
                 fetch["losses"] = list(self._losses)
                 fetch["scale"] = self.state["scale"].cur_scale
-            stats = jax.device_get(fetch)
+            with self.telemetry.span("device_get", step=self.global_steps):
+                stats = jax.device_get(fetch)
             self._overflow = bool(stats["overflow"])
             if self._guard is not None:
+                self.telemetry.note_scale(stats["scale"],
+                                          step=self.global_steps)
                 mean_loss = (float(np.mean(stats["losses"]))
                              if stats["losses"] else float("nan"))
                 guard_action = self._guard.observe(
@@ -1769,15 +1826,18 @@ class DeepSpeedEngine:
             lr = self.get_lr()[0] if self.optimizer.param_groups else 0.0
             scale = (float(stats["scale"]) if self._config.fp16_enabled
                      else 1.0)
+            if self._config.fp16_enabled:
+                self.telemetry.note_scale(scale, step=self.global_steps)
             log_dist(
                 f"step={self.global_steps}, skipped={int(stats['skipped'])}, "
                 f"lr={lr:.6g}, loss={mean_loss:.5f}, loss_scale={scale}",
                 ranks=[0])
-            self.monitor.write_scalars(self.global_samples, {
+            self.telemetry.step_metrics(self.global_steps,
+                                        self.global_samples, {
                 "Train/Samples/train_loss": mean_loss,
                 "Train/Samples/lr": lr,
                 "Train/Samples/loss_scale": scale,
-            })
+            }, skipped=int(stats["skipped"]))
         self._losses = []
         if self._config.memory_breakdown:
             from .utils import see_memory_usage
@@ -1804,14 +1864,25 @@ class DeepSpeedEngine:
                 # full state) can legitimately outlast the hang timeout;
                 # disarm until the caller's post-rollback beat re-arms
                 self._watchdog.pause()
+            reason = (f"{self._guard.consecutive_anomalies} consecutive "
+                      f"anomalous step(s)")
+            diverged_at = self.global_steps
             try:
-                self._rollback_mgr.rollback(
-                    reason=f"{self._guard.consecutive_anomalies} consecutive "
-                           f"anomalous step(s)")
-            except TrainingDivergedError:
+                with self.telemetry.span("rollback_restore"):
+                    path = self._rollback_mgr.rollback(reason=reason)
+            except TrainingDivergedError as e:
                 if self._watchdog is not None:
                     self._watchdog.stop()
+                self.telemetry.emit(TEL.EVENT_ABORT, step=self.global_steps,
+                                    reason=str(e))
+                self.telemetry.flush(reason="abort")
                 raise
+            # global_steps is now the RESTORED step (load_checkpoint
+            # rewound it); from_step names the abandoned timeline's head
+            self.telemetry.emit(TEL.EVENT_ROLLBACK, step=self.global_steps,
+                                from_step=diverged_at, restored_path=path,
+                                reason=reason)
+            self.telemetry.counter("resilience/rollbacks").inc()
             self._guard.notify_rollback()
             return True
         if action == ACTION_ABORT:
@@ -1820,11 +1891,14 @@ class DeepSpeedEngine:
                 # the POISON code) must never be preempted by the
                 # watchdog's RESPAWNABLE os._exit
                 self._watchdog.stop()
-            raise TrainingDivergedError(
-                f"training diverged at step {self.global_steps}: "
-                f"{self._guard.consecutive_anomalies} consecutive anomalous "
-                f"step(s) under policy={self._guard.policy}; recent "
-                f"anomalies: {self._guard.recent_events()[-5:]}")
+            msg = (f"training diverged at step {self.global_steps}: "
+                   f"{self._guard.consecutive_anomalies} consecutive "
+                   f"anomalous step(s) under policy={self._guard.policy}; "
+                   f"recent anomalies: {self._guard.recent_events()[-5:]}")
+            self.telemetry.emit(TEL.EVENT_ABORT, step=self.global_steps,
+                                reason=msg)
+            self.telemetry.flush(reason="abort")
+            raise TrainingDivergedError(msg)
         return False
 
     def train_batch(self, data_iter=None):
@@ -1847,10 +1921,12 @@ class DeepSpeedEngine:
                 "train_batch() cannot run with un-stepped forward()/backward() "
                 "micro-batches pending")
         self.tput_timer.start()
+        t_host0 = time.perf_counter()
         if self.wall_clock_breakdown():
             self.timers("train_batch").start(sync=False)
         acc = self.gradient_accumulation_steps()
-        micro_batches = [next(data_iter) for _ in range(acc)]
+        with self.telemetry.span("batch_fetch", step=self.global_steps + 1):
+            micro_batches = [next(data_iter) for _ in range(acc)]
         try:
             packed_host, spec = _pack_batches(micro_batches)
         except (ValueError, AssertionError):
@@ -1875,7 +1951,9 @@ class DeepSpeedEngine:
             step_fn = self._train_step_compressed_fn
         if self._offload_eager:
             self._state_memory("device")
-        with self.mesh:
+        dispatch_span = self.telemetry.span("dispatch",
+                                            step=self.global_steps + 1)
+        with dispatch_span, self.mesh:
             if step_fn is self._train_step_fn:
                 out = step_fn(self.state["master"], self.state["opt"],
                               self.state["scale"], self.state["skipped"],
@@ -1921,11 +1999,14 @@ class DeepSpeedEngine:
             if self._guard is not None:
                 fetch["loss"] = loss
                 fetch["scale"] = self.state["scale"].cur_scale
-            stats = jax.device_get(fetch)
+            with self.telemetry.span("device_get", step=self.global_steps):
+                stats = jax.device_get(fetch)
             # with the guard on, a skipped (non-finite) update must not
             # advance the scheduler in ANY precision, same as fp16
             self._overflow = bool(stats["overflow"])
             if self._guard is not None:
+                self.telemetry.note_scale(stats["scale"],
+                                          step=self.global_steps)
                 guard_action = self._guard.observe(
                     float(stats["loss"]), self._overflow,
                     scale=float(stats["scale"]), step=self.global_steps)
@@ -1968,22 +2049,39 @@ class DeepSpeedEngine:
             loss_val = float(stats["loss"])
             scale = (float(stats["scale"]) if self._config.fp16_enabled
                      else 1.0)
+            if self._config.fp16_enabled:
+                self.telemetry.note_scale(scale, step=self.global_steps)
             log_dist(
                 f"step={self.global_steps}, skipped={int(stats['skipped'])}, "
                 f"lr={lr:.6g}, loss={loss_val:.5f}, loss_scale={scale}",
                 ranks=[0])
-            # reference tensorboard tags (engine.py:1014-1067)
-            self.monitor.write_scalars(self.global_samples, {
+            # reference tensorboard tags (engine.py:1014-1067); the event
+            # stream + registry ride the same already-fetched scalars
+            self.telemetry.step_metrics(self.global_steps,
+                                        self.global_samples, {
                 "Train/Samples/train_loss": loss_val,
                 "Train/Samples/lr": lr,
                 "Train/Samples/loss_scale": scale,
-            })
+            }, skipped=int(stats["skipped"]))
         if self.wall_clock_breakdown():
             # the fused program has no forward/step boundary to time
             # separately; report the whole fused step
             self.timers("train_batch").stop(sync=True)
             self.timers.log(["train_batch"])
         self.tput_timer.stop()
+        if self.telemetry.enabled:
+            # O(1) host bookkeeping; host_step_secs measures the HOST side
+            # of the step (dispatch is async — device time shows up here
+            # only when the dispatch queue backpressures)
+            self.telemetry.counter("train/steps").inc()
+            self.telemetry.counter("train/samples").inc(
+                acc * self.train_micro_batch_size_per_gpu()
+                * self.dp_world_size)
+            if self._overflow:
+                self.telemetry.counter("train/overflow_steps").inc()
+            self.telemetry.histogram("train/host_step_secs").observe(
+                time.perf_counter() - t_host0)
+            self.telemetry.poll_device_trace(self.global_steps)
         if self._watchdog is not None:
             self._watchdog.beat()
         return loss
@@ -2094,8 +2192,9 @@ class DeepSpeedEngine:
         """
         self._check_sparse_overflow()
         tag = tag or f"global_step{self.global_steps}"
-        snapshot = capture_engine_snapshot(self, tag, client_state,
-                                           save_latest)
+        with self.telemetry.span("ckpt_snapshot", tag=str(tag)):
+            snapshot = capture_engine_snapshot(self, tag, client_state,
+                                               save_latest)
         self._last_ckpt_dir = save_dir
         async_save = (self.checkpoint_config.async_save if sync is None
                       else not sync)
@@ -2118,14 +2217,25 @@ class DeepSpeedEngine:
         return self._ckpt_manager.wait(save_dir, timeout)
 
     def _preemption_save(self):
-        """Final synchronous save on SIGTERM, into the last save dir."""
-        if self._last_ckpt_dir is None:
-            logger.warning("preemption save skipped: no checkpoint dir "
-                           "seen yet (call save_checkpoint once to set it)")
-            return
-        self.save_checkpoint(self._last_ckpt_dir,
-                             tag=f"global_step{self.global_steps}",
-                             sync=True)
+        """Final synchronous save on SIGTERM, into the last save dir.
+        Telemetry sinks are flushed (not closed: the previous signal
+        disposition may let the process continue) so a preempted run
+        keeps its tail events."""
+        import signal as _signal
+
+        self.telemetry.emit(TEL.EVENT_PREEMPTION, step=self.global_steps,
+                            signum=int(_signal.SIGTERM))
+        try:
+            if self._last_ckpt_dir is None:
+                logger.warning(
+                    "preemption save skipped: no checkpoint dir seen yet "
+                    "(call save_checkpoint once to set it)")
+                return
+            self.save_checkpoint(self._last_ckpt_dir,
+                                 tag=f"global_step{self.global_steps}",
+                                 sync=True)
+        finally:
+            self.telemetry.flush(reason="preemption")
 
     def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
                         load_optimizer_states=True, load_lr_scheduler_states=True,
@@ -2213,6 +2323,8 @@ class DeepSpeedEngine:
         # a resumed job can now take its preemption save before the first
         # periodic save_checkpoint sets a directory
         self._last_ckpt_dir = load_dir
+        self.telemetry.emit(TEL.EVENT_RUN_RESUME, step=self.global_steps,
+                            checkpoint=ckpt_dir)
         log_dist(f"loaded checkpoint {ckpt_dir}", ranks=[0])
         return ckpt_dir, client_state
 

@@ -1,0 +1,38 @@
+"""deepspeed_tpu.telemetry — unified observability subsystem.
+
+One coherent answer to "what happened in this run?", queryable from
+artifacts instead of grep'd from stdout:
+
+- :mod:`.registry` — process-local, thread-safe MetricsRegistry
+  (counters, gauges, bounded-reservoir histograms) with an O(1)
+  Python-only hot path, safe for the engine step loop and the
+  checkpoint-writer/watchdog threads;
+- :mod:`.events` — schema-versioned, rank- and seq-tagged structured
+  JSONL event stream unifying monitor scalars, resilience
+  anomaly/rollback/watchdog events, checkpoint lifecycle, loss-scale
+  changes, and launcher restarts;
+- :mod:`.trace` — Chrome-trace (Perfetto-loadable) spans for host-side
+  step phases, plus on-demand duration-bounded ``jax.profiler`` device
+  traces via a trigger file;
+- :mod:`.report` — ``python -m deepspeed_tpu.telemetry report
+  <run_dir>``: merged per-rank timeline + metric summaries + a
+  Prometheus text dump.
+
+Gated by the DSC4xx-validated ``"telemetry"`` config block; adds zero
+per-step host syncs (all scalar sourcing rides the engine's existing
+batched ``steps_per_print`` fetch).  See ``docs/observability.md``.
+"""
+
+from .events import (EVENT_TYPES, SCHEMA_VERSION, EventLog,  # noqa: F401
+                     read_events, validate_event)
+from .manager import TelemetryManager  # noqa: F401
+from .registry import (Counter, Gauge, Histogram,  # noqa: F401
+                       MetricsRegistry, get_registry, prometheus_text)
+from .trace import DeviceTraceTrigger, StepTracer  # noqa: F401
+
+__all__ = [
+    "SCHEMA_VERSION", "EVENT_TYPES", "EventLog", "read_events",
+    "validate_event", "TelemetryManager", "Counter", "Gauge", "Histogram",
+    "MetricsRegistry", "get_registry", "prometheus_text", "StepTracer",
+    "DeviceTraceTrigger",
+]

@@ -1,0 +1,225 @@
+"""Schema-versioned, rank- and seq-tagged structured JSONL event stream.
+
+One line per event, one file per writer (``events-rank<k>.jsonl`` for
+training processes, ``events-launcher.jsonl`` for the node spawner), all
+under ``<run_dir>/``.  This unifies what used to exist only as scattered
+log lines: monitor scalars, resilience anomaly/rollback/watchdog events,
+checkpoint lifecycle, loss-scale changes, and launcher restarts — every
+record queryable from artifacts (the report CLI,
+``python -m deepspeed_tpu.telemetry report``), not grep'd from stdout.
+
+Record envelope (stable across schema versions)::
+
+    {"schema_version": 1, "seq": 17, "rank": 0, "ts": 1712.3,
+     "type": "anomaly", "step": 42, "data": {...}}
+
+``seq`` is per-writer monotonic, so a merged multi-rank timeline has a
+total order within each rank even when wall clocks disagree.  ``step``
+is the engine's ``global_steps`` at emit time (None for events outside
+the step loop, e.g. launcher respawns).
+
+Stdlib-only on purpose: the launcher emits events without importing jax.
+"""
+
+import json
+import os
+import threading
+import time
+
+SCHEMA_VERSION = 1
+
+EVENTS_FILE_PREFIX = "events-"
+EVENTS_FILE_SUFFIX = ".jsonl"
+
+# -- event types + their required data keys (the golden schema) -------------
+EVENT_RUN_START = "run_start"
+EVENT_RUN_RESUME = "run_resume"
+EVENT_RUN_END = "run_end"
+EVENT_STEP_METRICS = "step_metrics"
+EVENT_ANOMALY = "anomaly"
+EVENT_ROLLBACK = "rollback"
+EVENT_ABORT = "abort"
+EVENT_WATCHDOG_HANG = "watchdog_hang"
+EVENT_LOSS_SCALE = "loss_scale"
+EVENT_CKPT_QUEUED = "ckpt_queued"
+EVENT_CKPT_COMMIT = "ckpt_commit"
+EVENT_CKPT_FAILED = "ckpt_failed"
+EVENT_PREEMPTION = "preemption"
+EVENT_PROC_SPAWN = "proc_spawn"
+EVENT_PROC_EXIT = "proc_exit"
+EVENT_PROC_RESPAWN = "proc_respawn"
+
+# type -> required data keys.  The report CLI and the golden-schema test
+# validate against this table; emitting an unknown type or dropping a
+# required key is a programming error caught in tests, not silently
+# shipped into run artifacts.
+EVENT_TYPES = {
+    EVENT_RUN_START: ("world_size",),
+    EVENT_RUN_RESUME: ("checkpoint",),
+    EVENT_RUN_END: ("reason",),
+    EVENT_STEP_METRICS: ("scalars",),
+    EVENT_ANOMALY: ("kind", "detail", "consecutive"),
+    EVENT_ROLLBACK: ("reason", "from_step", "restored_path"),
+    EVENT_ABORT: ("reason",),
+    EVENT_WATCHDOG_HANG: ("stalled_secs", "timeout_secs"),
+    EVENT_LOSS_SCALE: ("scale", "prev_scale"),
+    EVENT_CKPT_QUEUED: ("tag", "queue_depth"),
+    EVENT_CKPT_COMMIT: ("tag", "latency_secs", "bytes", "retries"),
+    EVENT_CKPT_FAILED: ("tag", "error"),
+    EVENT_PREEMPTION: ("signum",),
+    EVENT_PROC_SPAWN: ("proc_rank", "pid"),
+    EVENT_PROC_EXIT: ("proc_rank", "code"),
+    EVENT_PROC_RESPAWN: ("proc_rank", "restart", "backoff_secs"),
+}
+
+
+def events_filename(rank):
+    return f"{EVENTS_FILE_PREFIX}rank{rank}{EVENTS_FILE_SUFFIX}"
+
+
+class EventLog:
+    """Append-only JSONL writer for one rank's event stream.
+
+    Thread-safe: the step loop, checkpoint-writer threads, and the
+    watchdog all emit through one instance.  Every record is flushed on
+    write — events are rare (print cadence, lifecycle transitions), and
+    an unflushed tail is exactly what a post-mortem needs most.  A
+    failing sink disables itself LOUDLY (one logged error) instead of
+    taking training down or silently eating events.
+    """
+
+    def __init__(self, run_dir, rank=0, filename=None):
+        self.run_dir = str(run_dir)
+        self.rank = rank
+        # RLock: the SIGTERM preemption handler runs ON the main thread
+        # and emits events — it may interrupt a frame that already holds
+        # this lock (same rationale as checkpoint/manager.py's RLocks)
+        self._lock = threading.RLock()
+        self._seq = 0
+        self._f = None
+        self._dead = False
+        os.makedirs(self.run_dir, exist_ok=True)
+        self.path = os.path.join(
+            self.run_dir, filename or events_filename(rank))
+        self._f = open(self.path, "a", encoding="utf-8")
+
+    def emit(self, event_type, step=None, **data):
+        """Write one event; returns the record dict (None if the sink is
+        closed/dead).  Unknown ``event_type`` values are allowed (forward
+        compatibility) but the known types are schema-checked in tests."""
+        record = {
+            "schema_version": SCHEMA_VERSION,
+            "seq": None,            # assigned under the lock below
+            "rank": self.rank,
+            "ts": time.time(),
+            "type": str(event_type),
+            "step": int(step) if step is not None else None,
+            "data": data,
+        }
+        with self._lock:
+            if self._f is None or self._dead:
+                return None
+            record["seq"] = self._seq
+            self._seq += 1
+            try:
+                self._f.write(json.dumps(record) + "\n")
+                self._f.flush()
+            except OSError as e:
+                self._dead = True
+                # deferred import: utils.logging is jax-free but keep the
+                # module import graph stdlib-only for the launcher
+                from ..utils.logging import logger
+
+                logger.error("telemetry event sink %s failed (%s); "
+                             "disabling further event writes", self.path, e)
+                return None
+        return record
+
+    def flush(self):
+        with self._lock:
+            if self._f is not None and not self._dead:
+                try:
+                    self._f.flush()
+                    os.fsync(self._f.fileno())
+                except OSError:
+                    self._dead = True
+        return not self._dead
+
+    def close(self):
+        with self._lock:
+            if self._f is not None:
+                try:
+                    self._f.flush()
+                    self._f.close()
+                except (OSError, ValueError) as e:
+                    from ..utils.logging import logger
+
+                    logger.warning("telemetry event sink %s close failed: "
+                                   "%s", self.path, e)
+                self._f = None
+
+    @property
+    def closed(self):
+        return self._f is None
+
+
+def validate_event(record):
+    """Return a list of schema problems with one decoded record (empty =
+    valid).  Unknown types only require the envelope."""
+    problems = []
+    for field in ("schema_version", "seq", "rank", "ts", "type", "data"):
+        if field not in record:
+            problems.append(f"missing envelope field {field!r}")
+    if problems:
+        return problems
+    if record["schema_version"] > SCHEMA_VERSION:
+        problems.append(
+            f"schema_version {record['schema_version']} is newer than "
+            f"this reader ({SCHEMA_VERSION})")
+    required = EVENT_TYPES.get(record["type"], ())
+    for key in required:
+        if key not in record["data"]:
+            problems.append(
+                f"event type {record['type']!r} missing data key {key!r}")
+    return problems
+
+
+def iter_rank_files(run_dir):
+    """Yield (stream_name, path) for every event stream under run_dir."""
+    run_dir = str(run_dir)
+    try:
+        names = sorted(os.listdir(run_dir))
+    except OSError:
+        return
+    for name in names:
+        if (name.startswith(EVENTS_FILE_PREFIX)
+                and name.endswith(EVENTS_FILE_SUFFIX)):
+            stream = name[len(EVENTS_FILE_PREFIX):-len(EVENTS_FILE_SUFFIX)]
+            yield stream, os.path.join(run_dir, name)
+
+
+def read_events(run_dir, strict=False):
+    """Merge every per-rank stream under ``run_dir`` into one list sorted
+    by (ts, rank-stream, seq).  Undecodable lines are skipped (or raise,
+    with ``strict=True``) — a crashed writer may leave a torn last line,
+    and the rest of the stream is still evidence."""
+    merged = []
+    for stream, path in iter_rank_files(run_dir):
+        with open(path, "r", encoding="utf-8") as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError as e:
+                    if strict:
+                        raise ValueError(
+                            f"{path}:{lineno}: undecodable event line: "
+                            f"{e}") from e
+                    continue
+                rec["_stream"] = stream
+                merged.append(rec)
+    merged.sort(key=lambda r: (r.get("ts", 0.0), str(r.get("_stream")),
+                               r.get("seq", 0)))
+    return merged

@@ -1,0 +1,192 @@
+"""Run-report CLI: reconstruct "what happened in this run?" from
+artifacts alone.
+
+``python -m deepspeed_tpu.telemetry report <run_dir>`` merges the
+per-rank event streams (``events-rank*.jsonl``) and metric snapshots
+(``metrics-rank*.json``) under ``run_dir`` and prints:
+
+- a **timeline**: every lifecycle event (run start/resume/end, anomalies,
+  rollbacks, watchdog trips, checkpoint queue/commit/failure, loss-scale
+  moves, launcher spawns/respawns/exits) with its step and rank;
+- **metric summaries**: counters, gauges, and histogram percentiles per
+  rank;
+- with ``--prometheus``, a Prometheus text-exposition dump of the merged
+  metric snapshots (for scraping a finished or running job's artifacts);
+- with ``--json``, the merged event list as JSON (for tooling).
+
+Stdlib-only: runs anywhere the artifacts are mounted, no jax required.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+from . import events as ev
+from .registry import prometheus_text
+
+# event types that belong on the timeline; step_metrics is summarized
+# instead (a 100k-step run would drown the lifecycle in scalar lines)
+_TIMELINE_SKIP = {ev.EVENT_STEP_METRICS}
+
+METRICS_GLOB_PREFIX = "metrics-"
+METRICS_GLOB_SUFFIX = ".json"
+
+
+def load_metrics(run_dir):
+    """{stream_name: snapshot_dict} for every metrics-*.json in run_dir."""
+    out = {}
+    try:
+        names = sorted(os.listdir(str(run_dir)))
+    except OSError:
+        return out
+    for name in names:
+        if not (name.startswith(METRICS_GLOB_PREFIX)
+                and name.endswith(METRICS_GLOB_SUFFIX)):
+            continue
+        stream = name[len(METRICS_GLOB_PREFIX):-len(METRICS_GLOB_SUFFIX)]
+        try:
+            with open(os.path.join(str(run_dir), name),
+                      encoding="utf-8") as f:
+                out[stream] = json.load(f)
+        except (OSError, ValueError):
+            out[stream] = {"_error": f"unreadable {name}"}
+    return out
+
+
+def _fmt_value(v):
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+def _fmt_data(data):
+    return " ".join(f"{k}={_fmt_value(v)}" for k, v in sorted(data.items())
+                    if k != "scalars")
+
+
+def format_event(record, t0):
+    step = record.get("step")
+    step_s = f"step={step}" if step is not None else "step=-"
+    ts = record.get("ts", 0.0) - t0
+    return (f"  t=+{ts:9.3f}s {step_s:<12} rank={record.get('rank')} "
+            f"{record.get('type'):<16} {_fmt_data(record.get('data', {}))}")
+
+
+def format_timeline(records):
+    """Lifecycle timeline lines (one per event, rank- and step-tagged)."""
+    if not records:
+        return ["  (no events)"]
+    t0 = records[0].get("ts", 0.0)
+    lines = []
+    for rec in records:
+        if rec.get("type") in _TIMELINE_SKIP:
+            continue
+        lines.append(format_event(rec, t0))
+    return lines or ["  (no lifecycle events)"]
+
+
+def summarize_step_metrics(records):
+    """Compact summary of the step_metrics stream: count, step range, and
+    first/last value of each scalar tag."""
+    metrics = [r for r in records if r.get("type") == ev.EVENT_STEP_METRICS]
+    if not metrics:
+        return ["  (no step_metrics events)"]
+    steps = [r.get("step") for r in metrics if r.get("step") is not None]
+    lines = [f"  {len(metrics)} step_metrics event(s)"
+             + (f", steps {min(steps)}..{max(steps)}" if steps else "")]
+    tags = {}
+    for rec in metrics:
+        for tag, val in rec.get("data", {}).get("scalars", {}).items():
+            tags.setdefault(tag, []).append(val)
+    for tag in sorted(tags):
+        vals = tags[tag]
+        lines.append(f"    {tag}: first={_fmt_value(vals[0])} "
+                     f"last={_fmt_value(vals[-1])}")
+    return lines
+
+
+def format_metrics(metrics_by_stream):
+    lines = []
+    for stream in sorted(metrics_by_stream):
+        snap = metrics_by_stream[stream]
+        lines.append(f"  [{stream}]")
+        for name in sorted(snap):
+            m = snap[name]
+            if not isinstance(m, dict) or "kind" not in m:
+                lines.append(f"    {name}: {m}")
+            elif m["kind"] == "histogram":
+                lines.append(
+                    f"    {name}: count={m['count']} "
+                    f"mean={_fmt_value(m['mean'])} "
+                    f"p50={_fmt_value(m['p50'])} "
+                    f"p99={_fmt_value(m['p99'])} "
+                    f"max={_fmt_value(m['max'])}")
+            else:
+                lines.append(f"    {name}: {_fmt_value(m['value'])}")
+    return lines or ["  (no metric snapshots)"]
+
+
+def generate_report(run_dir, strict=False):
+    """Full text report for ``run_dir``; returns (text, events)."""
+    records = ev.read_events(run_dir, strict=strict)
+    problems = []
+    for rec in records:
+        problems.extend(f"{rec.get('_stream')}#{rec.get('seq')}: {p}"
+                        for p in ev.validate_event(rec))
+    out = [f"telemetry report: {run_dir}",
+           f"  events: {len(records)} across "
+           f"{len(set(r.get('_stream') for r in records))} stream(s)"]
+    out.append("")
+    out.append("timeline:")
+    out.extend(format_timeline(records))
+    out.append("")
+    out.append("step metrics:")
+    out.extend(summarize_step_metrics(records))
+    out.append("")
+    out.append("metrics:")
+    out.extend(format_metrics(load_metrics(run_dir)))
+    if problems:
+        out.append("")
+        out.append("schema problems:")
+        out.extend(f"  {p}" for p in problems)
+    return "\n".join(out) + "\n", records
+
+
+def prometheus_dump(run_dir):
+    """Prometheus text for every metrics snapshot under run_dir."""
+    return prometheus_text(load_metrics(run_dir))
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.telemetry",
+        description="DeepSpeed-TPU telemetry tools")
+    sub = parser.add_subparsers(dest="command", required=True)
+    rep = sub.add_parser("report",
+                         help="timeline + metric summary for one run dir")
+    rep.add_argument("run_dir", help="telemetry run directory "
+                                     "(holds events-rank*.jsonl)")
+    rep.add_argument("--prometheus", action="store_true",
+                     help="emit a Prometheus text dump instead of the "
+                          "human report")
+    rep.add_argument("--json", action="store_true", dest="as_json",
+                     help="emit the merged event list as JSON")
+    rep.add_argument("--strict", action="store_true",
+                     help="fail on undecodable event lines")
+    args = parser.parse_args(argv)
+
+    if not os.path.isdir(args.run_dir):
+        print(f"error: {args.run_dir} is not a directory", file=sys.stderr)
+        return 2
+    if args.prometheus:
+        sys.stdout.write(prometheus_dump(args.run_dir))
+        return 0
+    if args.as_json:
+        records = ev.read_events(args.run_dir, strict=args.strict)
+        json.dump(records, sys.stdout, indent=1)
+        sys.stdout.write("\n")
+        return 0
+    text, records = generate_report(args.run_dir, strict=args.strict)
+    sys.stdout.write(text)
+    return 0 if records else 1

@@ -113,7 +113,7 @@ _SECTION_PREFIXES = (
     ("PIPELINE_", "pipeline"), ("PLD_", "progressive_layer_drop"),
     ("MESH_", "mesh"), ("SPARSE_", "sparse_attention"),
     ("CHECKPOINT_", "checkpoint"), ("RING_ATTENTION_", "ring_attention"),
-    ("RESILIENCE_", "resilience"),
+    ("RESILIENCE_", "resilience"), ("TELEMETRY_", "telemetry"),
     ("ACT_CHKPT_", "activation_checkpointing"),
     ("FLOPS_PROFILER_", "flops_profiler"),
 )

@@ -6,6 +6,14 @@ loss / learning rate / loss scale / throughput per optimizer step, gated on
 the ``tensorboard`` config section.  A JSONL event log is always written
 alongside (cheap, grep-able, no reader dependency); the TensorBoard writer
 is used when ``torch.utils.tensorboard`` is importable.
+
+Since the telemetry subsystem (``deepspeed_tpu/telemetry``) landed, this
+monitor is a thin *consumer* of the per-step scalar flow: the engine
+routes print-cadence scalars through
+:meth:`~deepspeed_tpu.telemetry.manager.TelemetryManager.step_metrics`,
+which feeds the structured event stream / metrics registry AND this
+writer — the TB/JSONL output and its config gating are unchanged, and
+the canonical queryable record is the telemetry event stream.
 """
 
 import json

@@ -12,7 +12,7 @@ TPU analog of the reference's ``deepspeed/utils/timer.py``:
 
 import time
 
-from .logging import logger
+from .logging import log_dist, logger
 
 
 def device_fence():
@@ -85,24 +85,42 @@ class SynchronizedWallClockTimer:
 
     @staticmethod
     def memory_usage():
+        """Aggregate allocation stats over ALL local devices (summing —
+        on a multi-chip host, device 0 alone understates the footprint by
+        the local device count)."""
         try:
             import jax
 
-            stats = jax.local_devices()[0].memory_stats() or {}
-            alloc = stats.get("bytes_in_use", 0) / (1024.0 * 1024.0 * 1024.0)
-            peak = stats.get("peak_bytes_in_use", 0) / (1024.0 * 1024.0 * 1024.0)
-            return f"mem allocated {alloc:.4f} GB peak {peak:.4f} GB"
+            devices = jax.local_devices()
+            alloc = peak = 0
+            reporting = 0
+            for dev in devices:
+                stats = dev.memory_stats() or {}
+                if stats:
+                    reporting += 1
+                alloc += stats.get("bytes_in_use", 0)
+                peak += stats.get("peak_bytes_in_use", 0)
+            gib = 1024.0 * 1024.0 * 1024.0
+            return (f"mem allocated {alloc / gib:.4f} GB peak "
+                    f"{peak / gib:.4f} GB across {reporting}/{len(devices)} "
+                    f"local device(s)")
         except Exception:
             return "mem stats unavailable"
 
     def log(self, names, normalizer=1.0, reset=True, memory_breakdown=False, ranks=None):
+        """Log named timers; ``ranks`` filters to those process indices
+        (None = all, matching ``log_dist``) and ``memory_breakdown``
+        appends the cross-device memory summary — both kwargs existed in
+        the reference signature and were silently ignored here."""
         assert normalizer > 0.0
         string = "time (ms)"
         for name in names:
             if name in self.timers:
                 elapsed_time = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
                 string += f" | {name}: {elapsed_time:.2f}"
-        logger.info(string)
+        if memory_breakdown:
+            string += " | " + self.memory_usage()
+        log_dist(string, ranks=ranks)
 
 
 class ThroughputTimer:
@@ -168,11 +186,17 @@ class ThroughputTimer:
                 self._window_anchor = now
                 self._window_anchor_step = self.global_step_count
                 if report_speed and window_steps > 0 and window_time > 0:
+                    avg = self.avg_samples_per_sec()
+                    # before any counted window the running average is 0.0
+                    # (not -inf); printing "RunningAvgSamplesPerSec=0.00"
+                    # would be as misleading, so the field is omitted
+                    avg_part = (f"RunningAvgSamplesPerSec={avg:.2f}, "
+                                if avg > 0 else "")
                     self.logging(
                         f"{self.__class__.__name__}: epoch={self.epoch_count}/"
                         f"micro_step={self.micro_step_count}/"
                         f"global_step={self.global_step_count}, "
-                        f"RunningAvgSamplesPerSec={self.avg_samples_per_sec():.2f}, "
+                        f"{avg_part}"
                         f"CurrSamplesPerSec={self.batch_size * self.num_workers * window_steps / window_time:.2f}"
                     )
 
@@ -181,4 +205,6 @@ class ThroughputTimer:
             samples_per_step = self.batch_size * self.num_workers
             avg_time_per_step = self.total_elapsed_time / self.counted_steps
             return samples_per_step / avg_time_per_step
-        return float("-inf")
+        # no counted window yet: 0.0, not the reference's float("-inf") —
+        # callers format this into logs and "-inf samples/sec" is noise
+        return 0.0

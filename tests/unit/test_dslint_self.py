@@ -53,3 +53,23 @@ def test_cli_exit_zero_on_shipped_tree():
     from deepspeed_tpu.tools.dslint.cli import main
 
     assert main([PKG_DIR]) == 0
+
+
+def test_telemetry_package_is_hotpath_clean():
+    """The telemetry subsystem's zero-added-host-syncs contract, pinned
+    statically: no DSH1xx/DSH2xx diagnostics over deepspeed_tpu/telemetry/
+    or the instrumented engine driver paths — not even suppressed ones.
+    (test_engine_zero_added_host_syncs asserts the same thing dynamically
+    by counting device_get calls per step.)"""
+    diags = lint_paths([os.path.join(PKG_DIR, "telemetry"),
+                        os.path.join(PKG_DIR, "runtime", "engine.py"),
+                        os.path.join(PKG_DIR, "checkpoint", "manager.py")])
+    hot = [d for d in diags if d.rule_id.startswith(("DSH1", "DSH2"))
+           and not d.suppressed]
+    listing = "\n".join(d.format() for d in hot)
+    assert not hot, f"telemetry hot-path violations:\n{listing}"
+    # the only suppressed hot-path syncs in these files are the two
+    # documented print-cadence DSH203 pragmas that predate telemetry
+    sup = sorted(d.rule_id for d in diags if d.suppressed
+                 and d.rule_id.startswith("DSH"))
+    assert sup == ["DSH203", "DSH203"], sup

@@ -1,0 +1,578 @@
+"""Telemetry subsystem tests (``deepspeed_tpu/telemetry``): metrics
+registry, structured event stream (golden schema), Chrome-trace spans,
+config block validation, engine wiring (zero added host syncs, flush on
+shutdown/preemption), launcher events, and the chaos acceptance test —
+the report CLI reconstructing the anomaly→rollback→resume timeline from
+run-dir artifacts alone."""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu as deepspeed
+from deepspeed_tpu.parallel import make_mesh
+from deepspeed_tpu.telemetry import (EVENT_TYPES, SCHEMA_VERSION, EventLog,
+                                     MetricsRegistry, StepTracer,
+                                     read_events, validate_event)
+from deepspeed_tpu.telemetry import events as ev
+from deepspeed_tpu.telemetry import report as report_mod
+from deepspeed_tpu.telemetry.config import DeepSpeedTelemetryConfig
+from deepspeed_tpu.telemetry.manager import TelemetryManager
+
+from .simple_model import SimpleModel, base_config, random_batches
+
+HIDDEN = 16
+
+
+def tel_config(run_dir, trace=False, **overrides):
+    cfg = base_config(steps_per_print=1,
+                      telemetry={"enabled": True, "run_dir": str(run_dir),
+                                 "trace": trace})
+    cfg.update(overrides)
+    return cfg
+
+
+def make_engine(config, cpu_devices, dp=4):
+    mesh = make_mesh({"data": dp}, devices=cpu_devices[:dp])
+    engine, *_ = deepspeed.initialize(model=SimpleModel(HIDDEN, nlayers=2),
+                                      config=config, mesh=mesh)
+    return engine
+
+
+def run_steps(engine, batches):
+    return [float(np.asarray(engine.train_batch(iter([b]))))
+            for b in batches]
+
+
+# ------------------------------------------------------------- registry
+def test_registry_instruments():
+    reg = MetricsRegistry()
+    reg.counter("a").inc()
+    reg.counter("a").inc(2)
+    reg.gauge("b").set(7.5)
+    h = reg.histogram("c")
+    for v in range(100):
+        h.observe(float(v))
+    snap = reg.snapshot()
+    assert snap["a"] == {"kind": "counter", "value": 3.0}
+    assert snap["b"]["value"] == 7.5
+    assert snap["c"]["count"] == 100 and snap["c"]["max"] == 99.0
+    assert 40.0 <= snap["c"]["p50"] <= 60.0
+    # same name, different kind = programming error, loud
+    with pytest.raises(TypeError):
+        reg.gauge("a")
+
+
+def test_registry_thread_safety():
+    """Writer threads (step loop + checkpoint writers) and a reader
+    thread (watchdog) run concurrently; final counts are exact."""
+    reg = MetricsRegistry()
+    n_threads, n_iters = 8, 2000
+    stop = threading.Event()
+    snaps = []
+
+    def writer():
+        c = reg.counter("steps")
+        h = reg.histogram("lat")
+        g = reg.gauge("depth")
+        for i in range(n_iters):
+            c.inc()
+            h.observe(i * 0.001)
+            g.set(i)
+
+    def watchdog():
+        while not stop.is_set():
+            snaps.append(reg.snapshot())
+
+    threads = [threading.Thread(target=writer) for _ in range(n_threads)]
+    wd = threading.Thread(target=watchdog)
+    wd.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    wd.join()
+    snap = reg.snapshot()
+    assert snap["steps"]["value"] == n_threads * n_iters
+    assert snap["lat"]["count"] == n_threads * n_iters
+    assert snaps, "watchdog reader never snapshotted"
+
+
+def test_registry_prometheus_text(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("train/steps").inc(5)
+    reg.histogram("step/secs").observe(0.25)
+    text = reg.to_prometheus_text()
+    assert "# TYPE deepspeed_tpu_train_steps_total counter" in text
+    assert "deepspeed_tpu_train_steps_total 5.0" in text
+    assert "deepspeed_tpu_step_secs_count 1" in text
+    # dump/reload round-trip feeds the report CLI
+    snap = reg.dump(tmp_path / "m.json")
+    assert json.load(open(tmp_path / "m.json")) == snap
+
+
+# --------------------------------------------------------------- events
+def _sample_data(event_type):
+    """Minimal valid data payload for each known event type."""
+    samples = {
+        "world_size": 4, "checkpoint": "/ckpt/global_step2",
+        "reason": "close", "scalars": {"loss": 1.0}, "kind": "loss_spike",
+        "detail": "z=9.1", "consecutive": 2, "from_step": 7,
+        "restored_path": "/ckpt/global_step2", "stalled_secs": 12.5,
+        "timeout_secs": 10.0, "scale": 1024.0, "prev_scale": 2048.0,
+        "tag": "global_step7", "queue_depth": 1, "latency_secs": 0.2,
+        "bytes": 4096, "retries": 1, "error": "disk full", "signum": 15,
+        "proc_rank": 0, "pid": 4242, "code": 85, "restart": 1,
+        "backoff_secs": 2.0,
+    }
+    return {k: samples[k] for k in EVENT_TYPES[event_type]}
+
+
+def test_event_stream_golden_schema(tmp_path):
+    """EVERY known event type round-trips through the JSONL stream and
+    carries schema_version / rank / seq / ts / step."""
+    log = EventLog(tmp_path, rank=3)
+    for i, event_type in enumerate(sorted(EVENT_TYPES)):
+        rec = log.emit(event_type, step=i, **_sample_data(event_type))
+        assert rec is not None
+    log.close()
+    records = read_events(tmp_path, strict=True)
+    assert len(records) == len(EVENT_TYPES)
+    assert [r["seq"] for r in records] == list(range(len(EVENT_TYPES)))
+    for rec in records:
+        assert validate_event(rec) == [], rec
+        assert rec["schema_version"] == SCHEMA_VERSION
+        assert rec["rank"] == 3
+        assert isinstance(rec["ts"], float) and rec["step"] is not None
+    assert sorted(r["type"] for r in records) == sorted(EVENT_TYPES)
+
+
+def test_event_schema_catches_missing_keys():
+    assert validate_event({"schema_version": 1, "seq": 0, "rank": 0,
+                           "ts": 0.0, "type": "rollback", "step": 1,
+                           "data": {"reason": "x"}})  # missing keys
+    assert validate_event({"type": "rollback"})       # missing envelope
+
+
+def test_event_merge_across_ranks(tmp_path):
+    for rank in (0, 1):
+        log = EventLog(tmp_path, rank=rank)
+        log.emit(ev.EVENT_RUN_START, step=0, world_size=2)
+        log.emit(ev.EVENT_RUN_END, reason="close")
+        log.close()
+    merged = read_events(tmp_path)
+    assert len(merged) == 4
+    assert {r["rank"] for r in merged} == {0, 1}
+    # per-rank seq order survives the merge
+    for rank in (0, 1):
+        seqs = [r["seq"] for r in merged if r["rank"] == rank]
+        assert seqs == sorted(seqs)
+
+
+def test_event_reader_skips_torn_tail_line(tmp_path):
+    log = EventLog(tmp_path, rank=0)
+    log.emit(ev.EVENT_RUN_START, step=0, world_size=1)
+    log.close()
+    with open(log.path, "a") as f:
+        f.write('{"schema_version": 1, "seq": 1, "tru')  # torn write
+    assert len(read_events(tmp_path)) == 1
+    with pytest.raises(ValueError):
+        read_events(tmp_path, strict=True)
+
+
+# ---------------------------------------------------------------- trace
+def test_step_tracer_writes_chrome_trace(tmp_path):
+    tracer = StepTracer(tmp_path, rank=0, max_events=100)
+    with tracer.span("dispatch", step=1):
+        pass
+    tracer.instant("anomaly", step=2)
+    tracer.close()
+    events = json.load(open(tracer.path))       # strict JSON after close
+    complete = [e for e in events if e.get("ph") == "X"]
+    assert {e["name"] for e in complete} == {"dispatch", "anomaly"}
+    for e in complete:
+        assert {"ts", "dur", "pid", "tid"} <= set(e)
+    assert any(e.get("ph") == "M" for e in events)  # process_name meta
+
+
+def test_prometheus_dump_survives_corrupt_metrics_file(tmp_path):
+    """A torn metrics-*.json (rank killed mid-dump) must not crash the
+    --prometheus export for the surviving ranks."""
+    MetricsRegistry().dump(tmp_path / "metrics-rank1.json")
+    reg = MetricsRegistry()
+    reg.counter("ok").inc()
+    reg.dump(tmp_path / "metrics-rank0.json")
+    (tmp_path / "metrics-rank2.json").write_text("not json{")
+    prom = report_mod.prometheus_dump(tmp_path)
+    assert "deepspeed_tpu_ok_total" in prom
+
+
+def test_device_trace_trigger_stat_is_throttled(tmp_path, monkeypatch):
+    """The trigger-file stat runs only every check_every-th poll (run
+    dirs live on network filesystems; no per-step I/O), but a pending
+    trigger is still picked up on the throttle boundary."""
+    from deepspeed_tpu.telemetry.trace import DeviceTraceTrigger
+
+    trig = DeviceTraceTrigger(tmp_path, max_secs=1.0, check_every=5)
+    stats = {"n": 0}
+    real_exists = os.path.exists
+
+    def counting_exists(p):
+        stats["n"] += 1
+        return real_exists(p)
+
+    monkeypatch.setattr(os.path, "exists", counting_exists)
+    for step in range(20):
+        trig.poll(step)
+    assert stats["n"] == 4                       # 20 polls / 5
+    monkeypatch.undo()
+    started = []
+    monkeypatch.setattr(trig, "_start", lambda step: started.append(step))
+    open(trig.trigger_path, "w").close()
+    for step in range(5):
+        trig.poll(step)
+    assert started, "trigger file never picked up within check_every"
+    assert not os.path.exists(trig.trigger_path)  # consumed
+
+
+def test_ckpt_queue_depth_gauge_drains(cpu_devices, tmp_path):
+    """The queue-depth gauge must return to 0 after writers drain, not
+    stick at the last enqueue's depth."""
+    run_dir = tmp_path / "tel"
+    engine = make_engine(tel_config(run_dir), cpu_devices)
+    run_steps(engine, random_batches(1, 16, HIDDEN, seed=9))
+    engine.save_checkpoint(str(tmp_path / "ckpt"))          # async
+    engine.wait_checkpoint()
+    assert engine.telemetry.registry.gauge("ckpt/queue_depth").value == 0
+    engine.close()
+
+
+def test_step_tracer_bounds_events(tmp_path):
+    tracer = StepTracer(tmp_path, rank=0, max_events=3)
+    for i in range(10):
+        tracer.instant("e", i=i)
+    tracer.close()
+    events = [e for e in json.load(open(tracer.path)) if e.get("ph") == "X"]
+    assert len(events) == 3                      # capped, not unbounded
+
+
+# --------------------------------------------------------------- config
+def test_telemetry_config_defaults_and_parse():
+    cfg = DeepSpeedTelemetryConfig({})
+    assert not cfg.enabled and cfg.events and not cfg.trace
+    assert cfg.run_dir == os.path.join("runs", "telemetry")
+    cfg = DeepSpeedTelemetryConfig({"telemetry": {
+        "enabled": True, "run_dir": "/tmp/t", "trace": True,
+        "trace_max_events": 10, "device_trace_secs": 3.5,
+        "device_trace_trigger": "/tmp/go"}})
+    assert cfg.enabled and cfg.trace and cfg.run_dir == "/tmp/t"
+    assert cfg.trace_max_events == 10 and cfg.device_trace_secs == 3.5
+    assert cfg.device_trace_trigger == "/tmp/go"
+    with pytest.raises(AssertionError, match="device_trace_secs"):
+        DeepSpeedTelemetryConfig({"telemetry": {"device_trace_secs": 0}})
+
+
+def test_telemetry_block_in_config_schema():
+    """The block rides the DSC4xx schema: misspelled sub-keys get a
+    'did you mean' instead of being silently ignored."""
+    from deepspeed_tpu.tools.dslint import validate_config_dict
+
+    issues = validate_config_dict({"telemetry": {"evnts": True}})
+    assert len(issues) == 1 and issues[0].suggestion == "events"
+    assert not validate_config_dict(
+        {"telemetry": {"enabled": True, "run_dir": "/x", "trace": True,
+                       "trace_max_events": 1000, "device_trace_secs": 5,
+                       "device_trace_trigger": ""}})
+
+
+def test_disabled_manager_is_cheap_noop(tmp_path):
+    tel = TelemetryManager(DeepSpeedTelemetryConfig({}), rank=0)
+    assert not tel.enabled
+    tel.emit("anything", step=1, x=1)
+    tel.counter("c").inc()
+    tel.gauge("g").set(1)
+    tel.histogram("h").observe(1)
+    with tel.span("s"):
+        pass
+    tel.step_metrics(1, 16, {"loss": 1.0})
+    tel.flush()
+    tel.close()
+    assert not os.listdir(tmp_path)   # nothing written anywhere
+
+
+# -------------------------------------------------------- engine wiring
+def test_engine_zero_added_host_syncs(cpu_devices, tmp_path, monkeypatch):
+    """The acceptance guarantee: telemetry adds ZERO host syncs — the
+    jax.device_get call count per step is identical with telemetry
+    enabled (trace + events on) and disabled."""
+    import jax
+
+    batches = random_batches(4, 16, HIDDEN, seed=0)
+
+    def count_gets(config):
+        engine = make_engine(config, cpu_devices)
+        counts = {"n": 0}
+        real_get = jax.device_get
+
+        def counting_get(x):
+            counts["n"] += 1
+            return real_get(x)
+
+        monkeypatch.setattr(jax, "device_get", counting_get)
+        try:
+            run_steps(engine, batches)
+        finally:
+            monkeypatch.setattr(jax, "device_get", real_get)
+        engine.close()
+        return counts["n"]
+
+    resilience = {"enabled": True, "policy": "skip"}
+    base = count_gets(base_config(steps_per_print=1,
+                                  resilience=resilience))
+    tel = count_gets(tel_config(tmp_path / "t", trace=True,
+                                resilience=resilience))
+    assert tel == base, (f"telemetry added host syncs: {tel} device_get "
+                         f"calls vs {base} baseline")
+    assert base > 0
+
+
+def test_engine_step_metrics_and_monitor_preserved(cpu_devices, tmp_path):
+    """Scalars flow through the event stream AND the TrainingMonitor's
+    JSONL/TB output (thin-consumer contract: TB behavior unchanged)."""
+    run_dir = tmp_path / "tel"
+    cfg = tel_config(run_dir,
+                     tensorboard={"enabled": True,
+                                  "output_path": str(tmp_path / "tb"),
+                                  "job_name": "unit"})
+    engine = make_engine(cfg, cpu_devices)
+    run_steps(engine, random_batches(3, 16, HIDDEN, seed=1))
+    engine.close()
+    # monitor output (pre-telemetry format) intact
+    lines = [json.loads(l) for l in
+             open(tmp_path / "tb" / "unit" / "events.jsonl")]
+    assert len(lines) == 3
+    assert all("Train/Samples/train_loss" in l for l in lines)
+    # event stream carries the same scalars, schema-tagged
+    records = read_events(run_dir)
+    metrics = [r for r in records if r["type"] == "step_metrics"]
+    assert [m["step"] for m in metrics] == [1, 2, 3]
+    for m in metrics:
+        assert validate_event(m) == []
+        assert "Train/Samples/train_loss" in m["data"]["scalars"]
+        assert m["data"]["skipped"] == 0
+    assert records[0]["type"] == "run_start"
+    assert records[-1]["type"] == "run_end"
+    # metrics snapshot dumped on close
+    snap = json.load(open(run_dir / "metrics-rank0.json"))
+    assert snap["train/steps"]["value"] == 3
+
+
+def test_engine_close_is_idempotent_and_flushes(cpu_devices, tmp_path):
+    run_dir = tmp_path / "tel"
+    engine = make_engine(tel_config(run_dir), cpu_devices)
+    run_steps(engine, random_batches(1, 16, HIDDEN, seed=2))
+    engine.close()
+    engine.close()   # second close: no error, no duplicate run_end
+    records = read_events(run_dir)
+    assert [r["type"] for r in records].count("run_end") == 1
+
+
+def test_preemption_path_flushes_tail_events(cpu_devices, tmp_path):
+    """The SIGTERM-drain path must leave the tail events on disk even
+    though the process would die without atexit."""
+    run_dir = tmp_path / "tel"
+    engine = make_engine(tel_config(run_dir), cpu_devices)
+    run_steps(engine, random_batches(1, 16, HIDDEN, seed=3))
+    engine._preemption_save()        # no ckpt dir yet: save skipped,
+    records = read_events(run_dir)   # telemetry still flushed
+    types = [r["type"] for r in records]
+    assert "preemption" in types
+    assert os.path.isfile(run_dir / "metrics-rank0.json")
+    engine.close()
+
+
+def test_loss_scale_change_event_rides_batched_fetch(cpu_devices,
+                                                     tmp_path):
+    """fp16 + NaN batch: the scale halving shows up as a loss_scale event
+    sourced from the scalars the engine already fetched."""
+    from deepspeed_tpu.resilience import ChaosMonkey
+
+    run_dir = tmp_path / "tel"
+    cfg = tel_config(run_dir,
+                     fp16={"enabled": True, "initial_scale_power": 4,
+                           "loss_scale_window": 1000, "hysteresis": 1},
+                     resilience={"enabled": True, "policy": "skip"})
+    engine = make_engine(cfg, cpu_devices)
+    batches = random_batches(3, 16, HIDDEN, seed=4)
+    run_steps(engine, batches[:1])
+    chaos = ChaosMonkey()
+    run_steps(engine, [chaos.nan_batch(batches[1])])   # overflow: halve
+    run_steps(engine, batches[2:])
+    engine.close()
+    scale_events = [r for r in read_events(run_dir)
+                    if r["type"] == "loss_scale"]
+    assert scale_events, "no loss_scale event for the overflow halving"
+    assert scale_events[0]["data"]["scale"] \
+        < scale_events[0]["data"]["prev_scale"]
+
+
+# ------------------------------------------------- chaos report (accept)
+def test_chaos_run_report_reconstructs_timeline(cpu_devices, tmp_path):
+    """THE acceptance test: a chaos run (NaN burst → rollback → resume,
+    plus a checkpoint commit) is fully reconstructable by the report CLI
+    from run-dir artifacts alone — each event named with step and rank."""
+    from deepspeed_tpu.resilience import ChaosMonkey
+
+    run_dir = tmp_path / "tel"
+    cfg = tel_config(run_dir, trace=True,
+                     resilience={"enabled": True, "policy": "rollback",
+                                 "divergence_patience": 2,
+                                 "max_rollbacks": 1})
+    engine = make_engine(cfg, cpu_devices)
+    clean = random_batches(6, 16, HIDDEN, seed=5)
+    run_steps(engine, clean[:2])
+    engine.save_checkpoint(str(tmp_path / "ckpt"), sync=True)
+    chaos = ChaosMonkey(seed=0)
+    it = chaos.wrap_iter(iter([clean[2], clean[3]] + clean[2:]),
+                         nan_steps=(0, 1))
+    for _ in range(2):
+        engine.train_batch(it)       # NaN x2 -> rollback to step 2
+    assert engine._rollback_mgr.rollbacks_used == 1
+    for _ in range(4):
+        engine.train_batch(it)       # resumed run to completion
+    assert engine.global_steps == 6
+    engine.close()
+
+    # ---- artifacts only from here: fresh read of run_dir ----
+    text, records = report_mod.generate_report(str(run_dir))
+    by_type = {}
+    for r in records:
+        by_type.setdefault(r["type"], []).append(r)
+    # checkpoint commit, with step + latency + bytes
+    commit = by_type["ckpt_commit"][0]
+    assert commit["step"] == 2 and commit["data"]["bytes"] > 0
+    # two anomalies at the diverging steps
+    anomalies = by_type["anomaly"]
+    assert [a["step"] for a in anomalies] == [3, 4]
+    assert all(a["data"]["kind"] == "nonfinite_grads" for a in anomalies)
+    # rollback names both timelines' steps
+    rb = by_type["rollback"][0]
+    assert rb["data"]["from_step"] == 4 and rb["step"] == 2
+    # the resume (load_checkpoint inside the rollback)
+    assert by_type["run_resume"][0]["step"] == 2
+    # every timeline event is step- and rank-tagged in the text report
+    for needle in ("anomaly", "rollback", "run_resume", "ckpt_commit",
+                   "rank=0", "step=2", "step=4"):
+        assert needle in text, f"report missing {needle}:\n{text}"
+    # schema-clean artifacts
+    for r in records:
+        assert validate_event(r) == [], r
+    # CLI entry point agrees (exit 0) and the prometheus dump exposes the
+    # rollback counter from the metrics snapshot
+    assert report_mod.main(["report", str(run_dir)]) == 0
+    prom = report_mod.prometheus_dump(str(run_dir))
+    assert "deepspeed_tpu_resilience_rollbacks_total" in prom
+
+
+# ------------------------------------------------------------- launcher
+def test_launcher_emits_lifecycle_events(tmp_path, monkeypatch):
+    """Launcher restarts/exit codes land in events-launcher.jsonl (merged
+    by the report CLI with the ranks' streams)."""
+    import socket
+
+    from deepspeed_tpu.launcher import launch
+    from deepspeed_tpu.launcher.runner import encode_world_info
+
+    monkeypatch.setenv("DS_MONITOR_POLL_SECS", "0.1")
+    monkeypatch.setenv("DS_RESTART_BACKOFF_SECS", "0.05")
+    tel_dir = tmp_path / "tel"
+    script = tmp_path / "child.py"
+    marker = tmp_path / "ran_once"
+    script.write_text(
+        "import os, sys\n"
+        f"marker = {str(marker)!r}\n"
+        "if os.path.exists(marker):\n"
+        "    sys.exit(0)\n"
+        "open(marker, 'w').write('x')\n"
+        "sys.exit(1)\n")
+    wi = encode_world_info({socket.gethostname(): [0]})
+    argv = ["--world_info", wi, "--node_rank", "0",
+            "--master_addr", "127.0.0.1", "--master_port", "29999",
+            "--max-restarts", "1", "--telemetry-dir", str(tel_dir),
+            str(script)]
+    import signal
+    old = (signal.getsignal(signal.SIGINT), signal.getsignal(signal.SIGTERM))
+    try:
+        with pytest.raises(SystemExit) as exc:
+            launch.main(argv)
+    finally:
+        signal.signal(signal.SIGINT, old[0])
+        signal.signal(signal.SIGTERM, old[1])
+    assert exc.value.code == 0
+    records = read_events(tel_dir)
+    types = [r["type"] for r in records]
+    assert types.count("proc_spawn") == 2        # initial + respawn
+    assert "proc_respawn" in types
+    assert types.count("proc_exit") == 2         # exit 1, then exit 0
+    exits = [r["data"]["code"] for r in records
+             if r["type"] == "proc_exit"]
+    assert exits == [1, 0]
+    assert all(r["rank"] == "launcher" for r in records)
+    for r in records:
+        assert validate_event(r) == [], r
+
+
+# ----------------------------------------------------- timer satellites
+def test_throughput_timer_avg_before_any_window_is_zero():
+    from deepspeed_tpu.utils.timer import ThroughputTimer
+
+    t = ThroughputTimer(batch_size=4, num_workers=1)
+    assert t.avg_samples_per_sec() == 0.0        # was float("-inf")
+    lines = []
+    t2 = ThroughputTimer(batch_size=4, num_workers=1, start_step=0,
+                         steps_per_output=1, logging_fn=lines.append)
+    t2.start()
+    t2.stop()
+    assert lines and "-inf" not in lines[0]
+
+
+def test_wallclock_timer_log_honors_kwargs():
+    """log() used to silently ignore ranks= and memory_breakdown=.
+    (The framework logger is propagate=False with a stream handler bound
+    at import time, so the assertion taps a handler, not caplog/capfd.)"""
+    import logging
+
+    from deepspeed_tpu.utils.logging import logger
+    from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer
+
+    messages = []
+    handler = logging.Handler()
+    handler.emit = lambda rec: messages.append(rec.getMessage())
+    logger.addHandler(handler)
+    try:
+        timers = SynchronizedWallClockTimer()
+        timers("phase").start(sync=False)
+        timers("phase").stop(sync=False)
+        timers.log(["phase"], memory_breakdown=True)
+        assert any("phase" in m and "mem" in m for m in messages)
+        messages.clear()
+        # this process is rank 0; ranks=[99] must suppress the line
+        timers("phase").start(sync=False)
+        timers("phase").stop(sync=False)
+        timers.log(["phase"], ranks=[99])
+        assert not any("time (ms)" in m for m in messages)
+    finally:
+        logger.removeHandler(handler)
+
+
+def test_memory_usage_aggregates_all_local_devices():
+    from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer
+
+    out = SynchronizedWallClockTimer.memory_usage()
+    assert "mem" in out
+    if "across" in out:                 # stats-capable backend
+        assert "local device(s)" in out
