@@ -57,6 +57,16 @@ def parse_args(args=None):
                              "land in events-launcher.jsonl there (point "
                              "it at the engines' telemetry.run_dir so the "
                              "report CLI merges one timeline)")
+    parser.add_argument("--compile-cache-dir", "--compile_cache_dir",
+                        type=str,
+                        default=os.environ.get("DS_COMPILE_CACHE_DIR", ""),
+                        dest="compile_cache_dir",
+                        help="persistent XLA compile cache for the children "
+                             "(exported as JAX_COMPILATION_CACHE_DIR): a "
+                             "--max-restarts respawn then warm-starts its "
+                             "programs from here instead of recompiling — "
+                             "stdlib-only on this side, jax reads the env "
+                             "var natively in the child")
     parser.add_argument("training_script", type=str)
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     ns = parser.parse_args(args)
@@ -119,6 +129,11 @@ def main(argv=None):
     children = []   # [{proc, cmd, env, rank, restarts}]
     for local_rank, slot in enumerate(local_slots):
         env = os.environ.copy()
+        if args.compile_cache_dir:
+            # warm-start contract for respawns: the child (and every
+            # respawn of it) compiles into / loads from one shared cache
+            env["JAX_COMPILATION_CACHE_DIR"] = os.path.abspath(
+                args.compile_cache_dir)
         env[ENV_COORDINATOR] = f"{args.master_addr}:{args.master_port}"
         env[ENV_NUM_PROCESSES] = str(total)
         env[ENV_PROCESS_ID] = str(first_id + local_rank)

@@ -1,0 +1,29 @@
+"""Compile-time subsystem: persistent XLA cache + compile telemetry.
+
+Two legs, one goal — compile wall time must not gate capacity or
+restart latency (PERF.md "Compile time"):
+
+- :func:`configure_persistent_cache` wires JAX's persistent compile
+  cache from the DSC4xx-validated ``"compilation"`` config block, so
+  every fresh process (bench rerun, launcher respawn, auto-resume
+  restart) warm-starts byte-identical programs instead of recompiling;
+- :func:`install_compile_telemetry` bridges jax.monitoring compile
+  events into the telemetry subsystem (``compile`` events/spans,
+  cache hit/miss counters) with zero new device syncs.
+
+The O(1)-compile *program shape* half of the story lives with the
+offload machinery it restructures (``runtime/zero/stream.py``).
+"""
+
+from .cache import CompileStats, configure_persistent_cache
+from .config import DeepSpeedCompilationConfig
+from .telemetry_bridge import (install_compile_telemetry,
+                               uninstall_compile_telemetry)
+
+__all__ = [
+    "CompileStats",
+    "DeepSpeedCompilationConfig",
+    "configure_persistent_cache",
+    "install_compile_telemetry",
+    "uninstall_compile_telemetry",
+]

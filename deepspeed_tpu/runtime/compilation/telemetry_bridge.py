@@ -1,0 +1,90 @@
+"""Compile telemetry: jax.monitoring -> TelemetryManager.
+
+Every backend compile becomes a ``compile`` event (+ Chrome-trace host
+span when tracing is on) and a ``compile/seconds`` histogram sample;
+persistent-cache hits/misses become ``compile/cache_hit`` /
+``compile/cache_miss`` counters.  All of it is host-only Python driven
+by listeners jax already calls around its own compile path — the
+subsystem adds ZERO device syncs and nothing at all on the per-step
+path (compiles happen at trace time, not step time).
+
+jax's listener registry is process-global with no unregister across
+the supported range, so ONE pair of listeners is installed lazily and
+fans out to the currently-subscribed TelemetryManagers; managers
+unsubscribe on engine close.  Span timestamps are reconstructed as
+``now - duration`` (the listener fires at compile end), which is exact
+for the span's extent and only approximate in absolute placement by
+the listener dispatch overhead (~us).
+"""
+
+import threading
+import time
+
+from .cache import (DURATION_BACKEND_COMPILE, DURATION_CACHE_RETRIEVAL,
+                    EVENT_CACHE_HIT, EVENT_CACHE_MISS)
+
+COUNTER_CACHE_HIT = "compile/cache_hit"
+COUNTER_CACHE_MISS = "compile/cache_miss"
+COUNTER_PROGRAMS = "compile/programs"
+HISTOGRAM_SECS = "compile/seconds"
+
+_lock = threading.Lock()
+_sinks = []
+_installed = False
+
+
+def _on_event(event, **kw):
+    if event == EVENT_CACHE_HIT:
+        counter = COUNTER_CACHE_HIT
+    elif event == EVENT_CACHE_MISS:
+        counter = COUNTER_CACHE_MISS
+    else:
+        return
+    with _lock:
+        sinks = list(_sinks)
+    for manager in sinks:
+        manager.counter(counter).inc()
+
+
+def _on_duration(event, duration, **kw):
+    if event == DURATION_CACHE_RETRIEVAL:
+        with _lock:
+            sinks = list(_sinks)
+        for manager in sinks:
+            manager.histogram("compile/cache_retrieval_seconds").observe(
+                float(duration))
+        return
+    if event != DURATION_BACKEND_COMPILE:
+        return
+    now = time.perf_counter()
+    with _lock:
+        sinks = list(_sinks)
+    for manager in sinks:
+        manager.counter(COUNTER_PROGRAMS).inc()
+        manager.histogram(HISTOGRAM_SECS).observe(float(duration))
+        manager.emit("compile", duration_secs=float(duration))
+        if manager.tracer is not None:
+            manager.tracer.complete("compile", now - float(duration), now,
+                                    duration_secs=float(duration))
+
+
+def install_compile_telemetry(manager):
+    """Subscribe a TelemetryManager to compile events (idempotent)."""
+    global _installed
+    import jax.monitoring as monitoring
+
+    with _lock:
+        if manager not in _sinks:
+            _sinks.append(manager)
+        if _installed:
+            return
+        _installed = True
+    monitoring.register_event_listener(_on_event)
+    monitoring.register_event_duration_secs_listener(_on_duration)
+
+
+def uninstall_compile_telemetry(manager):
+    """Unsubscribe (the global listeners stay, muted when no sinks)."""
+    with _lock:
+        if manager in _sinks:
+            _sinks.remove(manager)

@@ -27,6 +27,7 @@ from ..profiling.config import DeepSpeedFlopsProfilerConfig
 from ..checkpoint.config import DeepSpeedCheckpointConfig
 from ..resilience.config import DeepSpeedResilienceConfig
 from ..telemetry.config import DeepSpeedTelemetryConfig
+from .compilation.config import DeepSpeedCompilationConfig
 
 TENSOR_CORE_ALIGN_SIZE = 8
 ADAM_OPTIMIZER = C.ADAM_OPTIMIZER
@@ -354,6 +355,7 @@ class DeepSpeedConfig:
         self.checkpoint_config = DeepSpeedCheckpointConfig(param_dict)
         self.resilience_config = DeepSpeedResilienceConfig(param_dict)
         self.telemetry_config = DeepSpeedTelemetryConfig(param_dict)
+        self.compilation_config = DeepSpeedCompilationConfig(param_dict)
 
         self.fp16_enabled = get_fp16_enabled(param_dict)
         self.bf16_enabled = get_bf16_enabled(param_dict)

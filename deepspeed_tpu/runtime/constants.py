@@ -156,6 +156,15 @@ ZERO_OFFLOAD_CHUNK_MB_DEFAULT = 512
 # gradient buffer — the last per-param device cost beyond the bf16 params.
 ZERO_OFFLOAD_GRADIENTS = "offload_gradients"
 ZERO_OFFLOAD_GRADIENTS_DEFAULT = False
+# Uniform-chunk (O(1)-compile) streamed update: pad the offloaded row
+# layout so every chunk has one shape and drive the chunk sequence with
+# lax.scan — compile cost stops scaling with chunk count (the round-5
+# capacity ceiling was >30-min compiles past ~1.5B params, not memory).
+# "auto" engages past UNIFORM_MIN_CHUNKS (zero/stream.py) chunks of
+# state; true forces it at any size; false keeps the unrolled
+# round-robin form everywhere.
+ZERO_OFFLOAD_UNIFORM_CHUNKS = "offload_uniform_chunks"
+ZERO_OFFLOAD_UNIFORM_CHUNKS_DEFAULT = "auto"
 # Max megabytes per pinned-host row-group buffer.  Default 1792 MB gives
 # mid-size states >= 2 groups for the round-robin transfer/compute
 # overlap (measured -5% step time at gpt2-large); very large states can
@@ -330,6 +339,36 @@ TELEMETRY_DEVICE_TRACE_SECS_DEFAULT = 10.0
 # override the trigger-file path (empty -> <run_dir>/device_trace.trigger)
 TELEMETRY_DEVICE_TRACE_TRIGGER = "device_trace_trigger"
 TELEMETRY_DEVICE_TRACE_TRIGGER_DEFAULT = ""
+
+#############################################
+# Compilation subsystem (deepspeed_tpu/runtime/compilation; new — the
+# reference has no compile-time story: CUDA kernels JIT per-op.  Under
+# XLA whole-program compiles are minutes-to-tens-of-minutes at offload
+# scale, so warm-starting them is a first-class subsystem.)
+#############################################
+COMPILATION = "compilation"
+# persistent XLA compile cache: "auto" enables it unless the process
+# already configured one (e.g. a test harness or an explicit
+# JAX_COMPILATION_CACHE_DIR env), true forces this config's cache over
+# any ambient one, false leaves compilation uncached
+COMPILATION_CACHE = "cache"
+COMPILATION_CACHE_DEFAULT = "auto"
+# where compiled executables persist; empty -> <telemetry run dir>/
+# xla_cache, so warm-start artifacts ride the run directory like every
+# other run artifact.  Fresh processes (bench reruns, --max-restarts
+# respawns, auto-resume restarts) pointing at the same dir skip
+# recompilation entirely.
+COMPILATION_CACHE_DIR = "cache_dir"
+COMPILATION_CACHE_DIR_DEFAULT = ""
+# skip caching executables smaller than this (bytes): tiny programs
+# cost more in cache I/O than they save
+COMPILATION_MIN_ENTRY_SIZE_BYTES = "min_entry_size_bytes"
+COMPILATION_MIN_ENTRY_SIZE_BYTES_DEFAULT = 0
+# skip caching programs that compiled faster than this (seconds); 0
+# caches everything — warm-start init wants even the small engine
+# programs back
+COMPILATION_MIN_COMPILE_SECS = "min_compile_secs"
+COMPILATION_MIN_COMPILE_SECS_DEFAULT = 0.0
 
 #############################################
 # Ring / context parallel attention (TPU addition, SURVEY §5.7)

@@ -220,6 +220,18 @@ class DeepSpeedEngine:
         self.grid = MeshGrid(self.mesh)
         self.world_size = self.grid.world_size
 
+        # -- compilation subsystem (runtime/compilation): persistent XLA
+        # compile cache, BEFORE the first jit of this engine (model.init,
+        # the flatten, the fused step) so warm-start processes — bench
+        # reruns, --max-restarts respawns, auto_resume restarts — load
+        # every one of those programs instead of recompiling them --
+        from .compilation import configure_persistent_cache
+
+        self.compilation_config = self._config.compilation_config
+        self._compile_cache_dir = configure_persistent_cache(
+            self.compilation_config,
+            run_dir=self._config.telemetry_config.run_dir)
+
         # -- precision --
         if self._config.fp16_enabled:
             self.compute_dtype = jnp.float16
@@ -340,13 +352,28 @@ class DeepSpeedEngine:
 
         self.zero_stage = self._config.zero_optimization_stage
         zc = self._config.zero_config
+        # uniform-chunk (O(1)-compile) streamed offload: the coordinator
+        # aligns the row layout so every chunk of every host group has
+        # ONE shape (zero/stream.py).  "auto" engages past
+        # UNIFORM_MIN_CHUNKS chunks of state; an explicit true forces
+        # alignment at any size; false keeps the round-5 layout.
+        from .zero.stream import UNIFORM_MIN_CHUNKS
+
+        uniform_cfg = getattr(zc, "offload_uniform_chunks", "auto")
+        chunk_rows_cfg = (max(1, (zc.offload_chunk_mb << 20) // (LANES * 4))
+                          if zc.offload_chunk_mb else None)
         self.flat = FlatParamCoordinator(
             mesh=self.mesh, params_template=params0, stage=self.zero_stage,
             dp_size=self.dp_world_size,
             cpu_offload=zc.cpu_offload,
             group_bytes=(zc.offload_group_mb << 20
                          if getattr(zc, "offload_group_mb_explicit", False)
-                         else None))
+                         else None),
+            uniform_chunk_rows=(chunk_rows_cfg
+                                if zc.cpu_offload and uniform_cfg is not False
+                                else None),
+            uniform_min_chunks=(1 if uniform_cfg is True
+                                else UNIFORM_MIN_CHUNKS))
         self.segments = self.flat.segments
 
         # master weights (flat fp32, sharded per stage)
@@ -371,12 +398,13 @@ class DeepSpeedEngine:
         # themselves) or 'eager' (state parked in pinned host between steps)
         self._offload = self.flat.cpu_offload
         self._offload_eager = self._offload and not self.flat.injit_placement
-        if self._offload and self.flat.injit_placement:
+        if self._offload and self.flat.memory_spaces:
             self._opt_shardings_device = jax.tree_util.tree_map(
                 lambda s: s.with_memory_kind("device"), self._opt_shardings)
         elif self._offload:
-            # eager backends (CPU) have a single memory space: the
-            # "device" copy of the shardings is the default-space variant
+            # single-memory-space backends (CPU — eager offload, or the
+            # forced in-jit test mode): the "device" copy of the
+            # shardings is the default-space variant
             self._opt_shardings_device = jax.tree_util.tree_map(
                 lambda s: NamedSharding(s.mesh, s.spec), self._opt_shardings)
         else:
@@ -520,6 +548,13 @@ class DeepSpeedEngine:
         self.telemetry = TelemetryManager(self.telemetry_config,
                                           rank=jax.process_index(),
                                           monitor=self.monitor)
+        if self.telemetry.enabled:
+            # compile events/spans + cache hit/miss counters off
+            # jax.monitoring listeners: host-only, nothing on the step
+            # path (compiles happen at trace time), zero new syncs
+            from .compilation import install_compile_telemetry
+
+            install_compile_telemetry(self.telemetry)
         self.telemetry.emit(
             TEL.EVENT_RUN_START, step=0, world_size=self.world_size,
             dp=self.dp_world_size,
@@ -696,6 +731,9 @@ class DeepSpeedEngine:
         """Flush + close every telemetry sink (events, trace, metrics
         snapshot, monitor).  Idempotent; also registered via atexit, so a
         normally-exiting run keeps its tail events without calling this."""
+        from .compilation import uninstall_compile_telemetry
+
+        uninstall_compile_telemetry(self.telemetry)
         self.telemetry.close()
 
     # ------------------------------------------------------------------
@@ -917,6 +955,52 @@ class DeepSpeedEngine:
                 f"{len(groups) if groups else 1} host group(s) in chunks "
                 f"of ≤{chunk_mb} MB", ranks=[0])
 
+        # O(1)-compile uniform-chunk form (zero/stream.py): past
+        # UNIFORM_MIN_CHUNKS the unrolled form's compile time — not
+        # memory — caps capacity (~35 min at gpt2-xl's 37 chunks,
+        # >30 min un-finished at 2.7B; PERF.md "Compile time"), so the
+        # chunk loop becomes a lax.scan whose body is traced once.
+        from .zero.stream import (uniform_chunk_jobs, uniform_geometry_ok,
+                                  uniform_scan_update)
+
+        offload_uniform = False
+        if offload_stream:
+            gb_all = groups or ((0, segments.rows),)
+            n_chunks_total = sum(len(_chunks(grc)) for _, grc in gb_all)
+            uniform_cfg = getattr(self._config.zero_config,
+                                  "offload_uniform_chunks", "auto")
+            # ONE decision point: the coordinator already decided (it
+            # set uniform_chunk_rows iff the config allowed it AND the
+            # chunk-count threshold was met at layout time) — the engine
+            # follows that decision rather than re-deriving the
+            # threshold from post-padding geometry, which near the
+            # boundary could disagree with the layout actually built.
+            want_uniform = (uniform_cfg is True
+                            or (uniform_cfg == "auto"
+                                and self.flat.uniform_chunk_rows
+                                is not None))
+            geom_ok = (rows_per_chunk is not None
+                       and self.flat.uniform_chunk_rows == rows_per_chunk
+                       and uniform_geometry_ok(gb_all, rows_per_chunk))
+            offload_uniform = want_uniform and geom_ok
+            if want_uniform and not geom_ok:
+                # loud fallback — only reachable when uniform was FORCED
+                # (true) but the layout could not be chunk-aligned, e.g.
+                # offload_chunk_mb: 0 (one ragged chunk per group)
+                logger.warning(
+                    "offload_uniform_chunks: chunk geometry is not "
+                    "uniform (chunk_rows=%s over groups %s); falling "
+                    "back to the unrolled streamed update — compile "
+                    "time will scale with chunk count",
+                    rows_per_chunk, gb_all)
+            if offload_uniform:
+                log_dist(
+                    f"ZeRO-Offload: uniform-chunk scan update "
+                    f"({n_chunks_total} chunks x {chunk_mb} MB, "
+                    f"{len(gb_all)} group(s)) — compile cost is "
+                    f"O(groups), not O(chunks)", ranks=[0])
+        self._offload_uniform = offload_uniform
+
         host_big = self.flat.master_sharding
 
         def _after(token, tree):
@@ -933,6 +1017,34 @@ class DeepSpeedEngine:
             # plain tuple only: NamedTuple optimizer states are pytree
             # NODES, not row-group containers
             return type(x) is tuple
+
+        def _split_group_states(opt_state, n_g):
+            """Per-group flattened optimizer-state views of a (possibly
+            row-grouped) state tree: flat row-buffer leaves differ per
+            group, scalar leaves are shared.  Returns (group_leaves,
+            is_flat mask, treedef) — the common prologue of both
+            streamed update forms."""
+            opt_defs = None
+            group_leaves, is_flat = [], None
+            for gi in range(n_g):
+                st_g = jax.tree_util.tree_map(
+                    lambda l: l[gi] if type(l) is tuple else l,
+                    opt_state, is_leaf=_is_grp)
+                leaves, opt_defs = jax.tree_util.tree_flatten(st_g)
+                group_leaves.append(leaves)
+                if is_flat is None:
+                    is_flat = [getattr(l, "ndim", 0) == 2 for l in leaves]
+            return group_leaves, is_flat, opt_defs
+
+        def _recombine_group_states(opt_state, new_sts):
+            """Inverse of :func:`_split_group_states`: per-group state
+            trees back into the original (grouped or single) layout."""
+            if groups is None:
+                return new_sts[0]
+            return jax.tree_util.tree_map(
+                lambda orig, *gs: tuple(gs) if type(orig) is tuple
+                else gs[0],
+                opt_state, *new_sts, is_leaf=_is_grp)
 
         def carve_leaves(chunk_list):
             """In-order device chunks tiling the flat rows → params pytree
@@ -993,17 +1105,8 @@ class DeepSpeedEngine:
             masters = list(master) if type(master) is tuple else [master]
             gb = groups or ((0, segments.rows),)
             n_g = len(gb)
-
-            opt_defs = None
-            group_leaves, is_flat = [], None
-            for gi in range(n_g):
-                st_g = jax.tree_util.tree_map(
-                    lambda l: l[gi] if type(l) is tuple else l,
-                    opt_state, is_leaf=_is_grp)
-                leaves, opt_defs = jax.tree_util.tree_flatten(st_g)
-                group_leaves.append(leaves)
-                if is_flat is None:
-                    is_flat = [getattr(l, "ndim", 0) == 2 for l in leaves]
+            group_leaves, is_flat, opt_defs = _split_group_states(
+                opt_state, n_g)
             scalar_out = [None] * len(is_flat)
 
             per_group = [_chunks(grc) for _, grc in gb]
@@ -1076,13 +1179,47 @@ class DeepSpeedEngine:
                               for li in range(len(is_flat))]
                 new_sts.append(jax.tree_util.tree_unflatten(opt_defs,
                                                             out_leaves))
+            new_opt = _recombine_group_states(opt_state, new_sts)
             if groups is None:
-                return masters[0], new_sts[0], cast_list
-            new_opt = jax.tree_util.tree_map(
-                lambda orig, *gs: tuple(gs) if type(orig) is tuple
-                else gs[0],
-                opt_state, *new_sts, is_leaf=_is_grp)
+                return masters[0], new_opt, cast_list
             return tuple(masters), new_opt, cast_list
+
+        def uniform_offload_update(master, opt_state, g, hp, overflow,
+                                   coef=None, g_on_host=False):
+            """The O(1)-compile streamed update: same per-chunk math and
+            group structure as :func:`chunked_offload_update`, but the
+            chunk loop is a ``lax.scan`` over (group, row) index data
+            (zero/stream.py) instead of an unrolled trace.  No folded
+            cast (``want_cast``): a scan can only stack per-chunk
+            outputs into a full flat compute-dtype array — the exact
+            ~2 bytes/param capacity ceiling the round-4 post-mortem
+            documented — so callers re-read params via the leaf-direct
+            streamed ``cast_params`` (2 HLO ops per chunk) instead."""
+            masters = list(master) if type(master) is tuple else [master]
+            gb = groups or ((0, segments.rows),)
+            group_leaves, is_flat, opt_defs = _split_group_states(
+                opt_state, len(gb))
+            g_groups = gg = None
+            if g_on_host:
+                g_groups = list(g) if type(g) is tuple else [g]
+            else:
+                gg = g
+            new_masters, new_group_leaves, _ = uniform_scan_update(
+                masters=masters, group_leaves=group_leaves,
+                is_flat=is_flat, opt_treedef=opt_defs,
+                update_fn=optimizer.update, hp=hp, overflow=overflow,
+                skip_bad=skip_bad,
+                jobs=uniform_chunk_jobs(gb, rows_per_chunk),
+                chunk_rows=rows_per_chunk, lanes=LANES,
+                g=gg, g_groups=g_groups, coef=coef,
+                to_dev=lambda x: jax.device_put(x, dev_sharding),
+                to_host=lambda x: jax.device_put(x, host_big))
+            new_sts = [jax.tree_util.tree_unflatten(opt_defs, gl)
+                       for gl in new_group_leaves]
+            new_opt = _recombine_group_states(opt_state, new_sts)
+            if groups is None:
+                return new_masters[0], new_opt, None
+            return tuple(new_masters), new_opt, None
 
         host_grad_big = self.flat.grad_host_sharding
         offload_grads_mode = self._offload_grads and offload_stream
@@ -1164,9 +1301,14 @@ class DeepSpeedEngine:
             else:
                 gnorm = jnp.asarray(0.0, jnp.float32)
                 coef = jnp.asarray(inv, jnp.float32)
-            new_master, new_opt, cast_list = chunked_offload_update(
-                master, opt_state, hostg, hp, overflow, coef=coef,
-                g_on_host=True, want_cast=True)
+            if offload_uniform:
+                new_master, new_opt, cast_list = uniform_offload_update(
+                    master, opt_state, hostg, hp, overflow, coef=coef,
+                    g_on_host=True)
+            else:
+                new_master, new_opt, cast_list = chunked_offload_update(
+                    master, opt_state, hostg, hp, overflow, coef=coef,
+                    g_on_host=True, want_cast=True)
             if fp16 and dynamic:
                 scale_state = update_scale_state(
                     scale_state, overflow,
@@ -1382,8 +1524,13 @@ class DeepSpeedEngine:
 
             if offload_stream:
                 # streamed offload: per-chunk fp16 pick happens inside
-                new_master, new_opt, cast_list = chunked_offload_update(
-                    master, opt_state, g, hp, overflow, want_cast=want_cast)
+                if offload_uniform:
+                    new_master, new_opt, cast_list = uniform_offload_update(
+                        master, opt_state, g, hp, overflow)
+                else:
+                    new_master, new_opt, cast_list = chunked_offload_update(
+                        master, opt_state, g, hp, overflow,
+                        want_cast=want_cast)
                 if fp16 and dynamic:
                     scale_state = update_scale_state(
                         scale_state, overflow,

@@ -60,6 +60,19 @@ class DeepSpeedZeroConfig:
                 f"offload_group_mb must be an integer in (0, 3584] (the "
                 f"~5 GB/host-buffer toolchain bound with margin), got "
                 f"{self.offload_group_mb!r}")
+        self.offload_uniform_chunks = get_scalar_param(
+            d, C.ZERO_OFFLOAD_UNIFORM_CHUNKS,
+            C.ZERO_OFFLOAD_UNIFORM_CHUNKS_DEFAULT)
+        # identity checks on purpose: 0/1 would pass an `in (True, False)`
+        # equality test yet match neither the engine's `is True` engage
+        # nor its `is not False` layout gate — 0 would chunk-pad the
+        # layout without ever enabling the scan
+        if not (self.offload_uniform_chunks is True
+                or self.offload_uniform_chunks is False
+                or self.offload_uniform_chunks == "auto"):
+            raise ValueError(
+                f"offload_uniform_chunks must be true, false, or \"auto\", "
+                f"got {self.offload_uniform_chunks!r}")
         self.offload_gradients = get_scalar_param(
             d, C.ZERO_OFFLOAD_GRADIENTS, C.ZERO_OFFLOAD_GRADIENTS_DEFAULT)
         if not isinstance(self.offload_gradients, bool):
@@ -92,6 +105,7 @@ class DeepSpeedZeroConfig:
                     cpu_offload=self.cpu_offload,
                     offload_chunk_mb=self.offload_chunk_mb,
                     offload_gradients=self.offload_gradients,
+                    offload_uniform_chunks=self.offload_uniform_chunks,
                     elastic_checkpoint=self.elastic_checkpoint)
 
     def __repr__(self):

@@ -1,0 +1,238 @@
+"""O(1)-compile streamed-offload update: one chunk program, scanned.
+
+The round-5 streamed ZeRO-Offload update (``engine.py``,
+``chunked_offload_update``) unrolls one full update pipeline — host
+load, optimizer math, overflow select, host write-back — per chunk into
+the fused step.  XLA program size therefore grows linearly with chunk
+count (= state bytes / ``offload_chunk_mb``) and compile time grows
+super-linearly with program size: gpt2-xl (37 chunks) compiled ~35 min
+on the tunneled toolchain and gpt2-2.7B (>60 chunks) never finished
+inside 30 min — the capacity ceiling had moved from memory to COMPILE
+WALL TIME (PERF.md "ZeRO-Offload capacity", VERDICT r5).
+
+This module is the fix: with every chunk padded to ONE uniform
+``(chunk_rows, LANES)`` shape, the whole chunk sequence becomes a
+``lax.scan`` whose body is traced ONCE — the chunk index and row offset
+are *data* (scan xs), not trace-time Python state.  Group membership
+(offloaded state over the ~5 GB per-host-buffer toolchain bound is a
+tuple of row-group buffers) is handled by ``lax.switch``: the heavy
+per-chunk work — the host→device loads, the optimizer math, the
+device→host write-back values — is traced once outside the branches,
+and each branch contributes only its group's ``dynamic_slice`` /
+``dynamic_update_slice`` (a few HLO ops per group).  Lowered program
+size is O(groups) with a tiny constant instead of O(chunks) x the full
+update body; the program-count test in
+``tests/unit/test_offload_stream.py`` pins jaxpr size constant as chunk
+count grows.
+
+What the scan form trades away, deliberately:
+
+- **Round-robin DMA/compute overlap.**  A ``while`` loop executes one
+  iteration at a time; the unrolled form's depth-2 token chain let
+  group A's loads stream during group B's update.  At the sizes where
+  the scan engages (``UNIFORM_MIN_CHUNKS``, default 24 chunks ≈ >12 GB
+  of state at the default chunk size) the round-robin build was itself
+  pathological (19.5 s/step at gpt2-xl vs 5.16 sequential — PERF.md),
+  so the measured status quo there is sequential anyway.  Smaller
+  states keep the round-5 unrolled round-robin path and its measured
+  1.30 s/step at 0.77B.
+- **The folded param cast** (``want_cast``).  ``lax.scan`` can only
+  return per-chunk outputs as one stacked array — a full flat
+  compute-dtype copy on device, exactly the ~2 bytes/param the round-4
+  post-mortem showed re-imposes a capacity ceiling.  The scan path
+  instead re-reads the master through the (cheap, 2-ops-per-chunk)
+  leaf-direct streamed cast, or composes with ZeRO-3 where no resident
+  param copy exists at all.
+
+The three round-4/5 load-bearing invariants survive structurally:
+chunks stay CHAINED (the scan carry serializes iterations — XLA cannot
+hoist every chunk's loads to once), host buffers stay a tuple of
+≤5 GB row-group buffers (the switch addresses them; they are never
+concatenated), and the write-back stays in-place
+``dynamic_update_slice`` on loop-carried buffers (the classic aliasing
+pattern XLA's while-loop buffer forwarding handles in place).
+
+Everything here is placement-agnostic: device/host movement is injected
+as ``to_dev`` / ``to_host`` callables (the engine passes
+``jax.device_put`` into its device/pinned-host shardings; CPU tests
+pass identity), so the numerics are testable on the CPU backend where
+``pinned_host`` does not exist.
+"""
+
+import jax
+import jax.numpy as jnp
+
+# Chunk count at which "auto" switches the streamed update from the
+# unrolled round-robin form to the uniform scan form.  Calibration: the
+# round-robin build was measured FASTER at gpt2-large (18 chunks,
+# 1.30 s/step) and pathological at gpt2-xl (37 chunks: 19.5 s/step
+# round-robin, ~35 min compile) — the crossover sits between, and past
+# it compile time is the binding constraint, not step time.
+UNIFORM_MIN_CHUNKS = 24
+
+
+def uniform_chunk_jobs(group_bounds, chunk_rows):
+    """Round-robin (group, rel_row, abs_row) job list over uniform chunks.
+
+    Requires every group's row count to be a multiple of ``chunk_rows``
+    (the coordinator's uniform alignment); raises otherwise — callers
+    fall back to the unrolled path on a False return from
+    :func:`uniform_geometry_ok`, never on an exception here.
+    """
+    per_group = []
+    for gr0, grc in group_bounds:
+        assert grc % chunk_rows == 0, (grc, chunk_rows)
+        per_group.append([(gr0, r0) for r0 in range(0, grc, chunk_rows)])
+    jobs, idx = [], [0] * len(per_group)
+    while any(idx[gi] < len(per_group[gi]) for gi in range(len(per_group))):
+        for gi in range(len(per_group)):
+            if idx[gi] < len(per_group[gi]):
+                gr0, r0 = per_group[gi][idx[gi]]
+                jobs.append((gi, r0, gr0 + r0))
+                idx[gi] += 1
+    return jobs
+
+
+def uniform_geometry_ok(group_bounds, chunk_rows):
+    """True when every group tiles exactly into ``chunk_rows`` chunks."""
+    if not chunk_rows:
+        return False
+    return all(grc % chunk_rows == 0 and grc > 0
+               for _, grc in group_bounds)
+
+
+def uniform_scan_update(*, masters, group_leaves, is_flat, opt_treedef,
+                        update_fn, hp, overflow, skip_bad, jobs, chunk_rows,
+                        lanes, g=None, g_groups=None, coef=None,
+                        to_dev=None, to_host=None):
+    """Scan the uniform-chunk offload update over ``jobs``.
+
+    Args:
+      masters: list of per-group ``(rows_g, lanes)`` fp32 host buffers.
+      group_leaves: per-group flattened optimizer-state leaves (flat
+        ``(rows_g, lanes)`` leaves differ per group; scalar leaves are
+        identical across groups — the engine's zeros-init contract).
+      is_flat: per-leaf bool mask (flat row buffer vs scalar state).
+      opt_treedef: treedef to rebuild the per-chunk optimizer state.
+      update_fn: ``(state, p_chunk, g_chunk, hp) -> (new_p, new_state)``
+        — an elementwise flat optimizer (Adam family).
+      overflow / skip_bad: the fp16/guard skip contract — on overflow
+        every chunk keeps its old values (same per-chunk select as the
+        unrolled path).
+      jobs: ``[(group, rel_row, abs_row)]`` from :func:`uniform_chunk_jobs`.
+      g: flat device gradient ``(rows, lanes)`` (pre-unscaled/clipped by
+        the caller), or None when ``g_groups`` is given.
+      g_groups: per-group HOST gradient buffers (``offload_gradients``);
+        ``coef`` then folds unscale+clip into one per-chunk multiply.
+      to_dev / to_host: placement callables (device_put into the
+        engine's shardings; identity under test).
+
+    Returns ``(new_masters, new_group_leaves, new_scalars)`` with the
+    same group structure as the inputs.
+    """
+    if to_dev is None:
+        to_dev = lambda x: x
+    if to_host is None:
+        to_host = lambda x: x
+    n_g = len(masters)
+    assert n_g == len(group_leaves) and n_g >= 1
+    g_on_host = g_groups is not None
+    assert g_on_host != (g is not None), \
+        "exactly one of g / g_groups must be given"
+
+    flat_pos = [li for li, f in enumerate(is_flat) if f]
+    scalars0 = [l for l, f in zip(group_leaves[0], is_flat) if not f]
+
+    gi_arr = jnp.asarray([j[0] for j in jobs], jnp.int32)
+    r0_arr = jnp.asarray([j[1] for j in jobs], jnp.int32)
+    abs_arr = jnp.asarray([j[2] for j in jobs], jnp.int32)
+
+    def body(carry, xs):
+        masters_c, flats_c, _ = carry
+        gi, r0, r0a = xs
+
+        def read(i):
+            def branch(r):
+                pm = jax.lax.dynamic_slice(
+                    masters_c[i], (r, 0), (chunk_rows, lanes))
+                fl = tuple(jax.lax.dynamic_slice(
+                    flats_c[i][k], (r, 0), (chunk_rows, lanes))
+                    for k in range(len(flat_pos)))
+                if g_on_host:
+                    gg = jax.lax.dynamic_slice(
+                        g_groups[i], (r, 0), (chunk_rows, lanes))
+                    return pm, fl, gg
+                return pm, fl
+            return branch
+
+        got = jax.lax.switch(gi, [read(i) for i in range(n_g)], r0)
+        pm = to_dev(got[0])
+        chunk_flat = [to_dev(x) for x in got[1]]
+        if g_on_host:
+            gc = to_dev(got[2]) * coef
+        else:
+            gc = jax.lax.dynamic_slice(g, (r0a, 0), (chunk_rows, lanes))
+
+        leaves, it_f, it_s = [], iter(chunk_flat), iter(scalars0)
+        for f in is_flat:
+            leaves.append(next(it_f) if f else next(it_s))
+        st = jax.tree_util.tree_unflatten(opt_treedef, leaves)
+        new_p, new_st = update_fn(st, pm, gc, hp)
+        new_leaves = jax.tree_util.tree_leaves(new_st)
+        if skip_bad:
+            new_p = jnp.where(overflow, pm, new_p)
+        new_p_h = to_host(new_p)
+        new_flat_h, new_scalars, fi = [], [], 0
+        for li, f in enumerate(is_flat):
+            if f:
+                nl = new_leaves[li]
+                if skip_bad:
+                    nl = jnp.where(overflow, chunk_flat[fi], nl)
+                new_flat_h.append(to_host(nl))
+                fi += 1
+            else:
+                ns = new_leaves[li]
+                if skip_bad:
+                    ns = jnp.where(overflow, scalars0[len(new_scalars)], ns)
+                new_scalars.append(ns)
+
+        def write(i):
+            def branch(args):
+                r, pm_h, fl_h = args
+                ms = tuple(
+                    jax.lax.dynamic_update_slice(m, pm_h, (r, 0))
+                    if j == i else m for j, m in enumerate(masters_c))
+                fls = tuple(
+                    tuple(jax.lax.dynamic_update_slice(
+                        flats_c[j][k], fl_h[k], (r, 0))
+                        if j == i else flats_c[j][k]
+                        for k in range(len(flat_pos)))
+                    for j in range(n_g))
+                return ms, fls
+            return branch
+
+        masters_n, flats_n = jax.lax.switch(
+            gi, [write(i) for i in range(n_g)],
+            (r0, new_p_h, tuple(new_flat_h)))
+        return (masters_n, flats_n, tuple(new_scalars)), None
+
+    flats0 = tuple(tuple(group_leaves[gi][li] for li in flat_pos)
+                   for gi in range(n_g))
+    # scalar carry slot: pre-seeded with the originals so an (impossible)
+    # empty job list degrades to "no update" rather than garbage
+    carry0 = (tuple(masters), flats0, tuple(scalars0))
+    (masters_n, flats_n, scalars_n), _ = jax.lax.scan(
+        body, carry0, (gi_arr, r0_arr, abs_arr))
+
+    new_group_leaves = []
+    for gi in range(n_g):
+        out, fi, si = [], 0, 0
+        for f in is_flat:
+            if f:
+                out.append(flats_n[gi][fi])
+                fi += 1
+            else:
+                out.append(scalars_n[si])
+                si += 1
+        new_group_leaves.append(out)
+    return list(masters_n), new_group_leaves, list(scalars_n)

@@ -48,6 +48,10 @@ EVENT_PREEMPTION = "preemption"
 EVENT_PROC_SPAWN = "proc_spawn"
 EVENT_PROC_EXIT = "proc_exit"
 EVENT_PROC_RESPAWN = "proc_respawn"
+# one per backend compile (runtime/compilation telemetry bridge); cache
+# hits/misses ride the metrics registry as compile/cache_hit|miss
+# counters — they are high-frequency bookkeeping, not timeline moments
+EVENT_COMPILE = "compile"
 
 # type -> required data keys.  The report CLI and the golden-schema test
 # validate against this table; emitting an unknown type or dropping a
@@ -70,6 +74,7 @@ EVENT_TYPES = {
     EVENT_PROC_SPAWN: ("proc_rank", "pid"),
     EVENT_PROC_EXIT: ("proc_rank", "code"),
     EVENT_PROC_RESPAWN: ("proc_rank", "restart", "backoff_secs"),
+    EVENT_COMPILE: ("duration_secs",),
 }
 
 

@@ -127,6 +127,13 @@ class StepTracer:
         now = time.perf_counter()
         self._record(name, now, now, args)
 
+    def complete(self, name, t0, t1, **args):
+        """Record an already-finished span (``perf_counter`` endpoints).
+        For spans observed post-hoc — e.g. compile durations reported by
+        jax.monitoring listeners after the compile returned — where a
+        ``with span():`` block never existed."""
+        self._record(name, t0, t1, args)
+
     def flush(self):
         with self._lock:
             if self._f is not None:

@@ -10,7 +10,16 @@ that trains and the offload step-time tax.
 Each trial runs in a FRESH SUBPROCESS: compiled executables and buffers
 from a previous trial linger in-process (observed: a config that OOMs
 after prior same-process trials trains fine alone), so isolation is the
-only way to get truthful capacity numbers.
+only way to get truthful capacity numbers.  All trials share one
+persistent XLA compile cache (exported via JAX_COMPILATION_CACHE_DIR),
+so a re-run — or a retry of a flaked trial — warm-starts its programs;
+each trial prints its cold/warm compile-wall split.
+
+Rows past gpt2-xl ride the round-6 O(1)-compile configuration: the
+uniform-chunk scan update ("offload_uniform_chunks": auto engages past
+24 chunks) keeps program size constant in chunk count — the round-5
+blocker at 2.7B was >30 min of REMOTE-COMPILE wall for the unrolled
+chunk programs, not memory.
 
 Usage: python examples/bench_offload_capacity.py [quick]
 """
@@ -22,6 +31,7 @@ import sys
 SEQ = 1024
 BATCH = int(os.environ.get("CAP_BATCH", "4"))
 STEPS = int(os.environ.get("CAP_STEPS", "6"))
+TIMEOUT = int(os.environ.get("CAP_TIMEOUT", "3600"))
 
 # (name, hidden, layers, heads) — params ≈ 12·L·h² + vocab·h
 LADDER = [
@@ -36,10 +46,12 @@ LADDER = [
 
 _TRIAL = r"""
 import time, numpy as np, jax
+from deepspeed_tpu.runtime.compilation import CompileStats
 import deepspeed_tpu as deepspeed
 from deepspeed_tpu.models import GPT2Config, GPT2LMHeadTPU
 from deepspeed_tpu.parallel import make_mesh
 import os
+stats = CompileStats()
 h = int(os.environ["T_H"]); L = int(os.environ["T_L"])
 heads = int(os.environ["T_HEADS"]); off = os.environ["T_OFF"] == "1"
 batch = int(os.environ["T_B"]); steps = int(os.environ["T_S"])
@@ -50,11 +62,16 @@ cfg = GPT2Config(hidden_size=h, num_layers=L, num_heads=heads,
 mesh = make_mesh({"data": 1}, devices=jax.devices()[:1])
 model = GPT2LMHeadTPU(cfg)
 og = os.environ.get("T_OG") == "1"
+zero = {"stage": 2, "cpu_offload": off, "offload_gradients": og and off}
+gmb = int(os.environ.get("T_GMB", "0"))
+if gmb:
+    # fewer, bigger host buffers: the remote AOT compile helper crashes
+    # on many-buffer programs (round-5 receipt: gpt2-xl needed 3584)
+    zero["offload_group_mb"] = gmb
 engine, *_ = deepspeed.initialize(model=model, mesh=mesh,
     config={"train_batch_size": batch, "steps_per_print": 10 ** 9,
             "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
-            "zero_optimization": {"stage": 2, "cpu_offload": off,
-                                  "offload_gradients": og and off},
+            "zero_optimization": zero,
             "bf16": {"enabled": True}})
 rng = np.random.default_rng(0)
 b = {"input_ids": rng.integers(0, cfg.vocab_size,
@@ -69,6 +86,10 @@ for _ in range(steps):
 v = float(np.asarray(jax.device_get(loss)))
 dt = (time.perf_counter() - t0) / steps
 assert np.isfinite(v)
+s = stats.as_dict()
+print(f"CAP_COMPILE cold={s['compile_seconds_cold']} "
+      f"warm={s['compile_seconds_warm']} hits={s['compile_cache_hits']} "
+      f"misses={s['compile_cache_misses']}")
 print(f"CAP_RESULT {dt * 1e3:.0f}")
 """
 
@@ -77,23 +98,35 @@ def param_count(h, L, vocab=50257, pos=SEQ):
     return 12 * L * h * h + (vocab + pos) * h + 2 * h
 
 
-def try_step(offload, hidden, layers, heads, offload_grads=False):
+def try_step(offload, hidden, layers, heads, offload_grads=False,
+             params=0):
     env = dict(os.environ, T_H=str(hidden), T_L=str(layers),
                T_HEADS=str(heads), T_OFF="1" if offload else "0",
                T_B=str(BATCH), T_S=str(STEPS),
                T_OG="1" if offload_grads else "0")
+    if params >= 1.4e9:
+        env.setdefault("T_GMB", "3584")
+    # one shared warm cache across every fresh-subprocess trial
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".jax_cache"))
     try:
         proc = subprocess.run([sys.executable, "-u", "-c", _TRIAL], env=env,
-                              capture_output=True, text=True, timeout=1800)
+                              capture_output=True, text=True,
+                              timeout=TIMEOUT)
     except subprocess.TimeoutExpired:
-        return False, "TIMEOUT (30 min)"
+        return False, f"TIMEOUT ({TIMEOUT // 60} min)", ""
+    compile_line = ""
     for line in proc.stdout.splitlines():
+        if line.startswith("CAP_COMPILE "):
+            compile_line = line[len("CAP_COMPILE "):]
         if line.startswith("CAP_RESULT "):
-            return True, float(line.split()[1]) / 1e3
+            return True, float(line.split()[1]) / 1e3, compile_line
     err = proc.stdout[-300:] + proc.stderr[-300:]
     oom = ("RESOURCE_EXHAUSTED" in err or "memory space hbm" in err
            or "Out of memory" in err or "ResourceExhausted" in err)
-    return False, ("OOM" if oom else err.replace("\n", " ")[-200:])
+    return False, ("OOM" if oom else err.replace("\n", " ")[-200:]), \
+        compile_line
 
 
 def main():
@@ -107,16 +140,18 @@ def main():
     results = {}
     for mode, offload, og in modes:
         for name, h, L, heads in ladder:
-            ok, info = try_step(offload, h, L, heads, offload_grads=og)
             n = param_count(h, L)
+            ok, info, compile_line = try_step(offload, h, L, heads,
+                                              offload_grads=og, params=n)
+            suffix = f"  [{compile_line}]" if compile_line else ""
             if ok:
                 print(f"[{mode}] {name}: OK  {info * 1e3:.0f} ms/step "
-                      f"({BATCH * SEQ / info:.0f} tok/s, {n / 1e9:.2f}B)",
-                      flush=True)
+                      f"({BATCH * SEQ / info:.0f} tok/s, {n / 1e9:.2f}B)"
+                      f"{suffix}", flush=True)
                 results[(mode, name)] = info
             else:
-                print(f"[{mode}] {name}: FAIL {info} ({n / 1e9:.2f}B)",
-                      flush=True)
+                print(f"[{mode}] {name}: FAIL {info} ({n / 1e9:.2f}B)"
+                      f"{suffix}", flush=True)
                 break  # ladder is monotone in memory need
 
     order = [name for name, *_ in LADDER]

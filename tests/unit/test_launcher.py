@@ -349,7 +349,8 @@ def test_verify_shared_order_env_and_epoch_gating(monkeypatch):
 # resilience exit-code contract (launch.py)
 # ---------------------------------------------------------------------------
 
-def _launch_main(tmp_path, script_body, script_args=(), max_restarts=0):
+def _launch_main(tmp_path, script_body, script_args=(), max_restarts=0,
+                 extra_argv=()):
     """Drive launch.main() inline with one local child slot; returns the
     SystemExit code."""
     from deepspeed_tpu.launcher import launch
@@ -361,7 +362,7 @@ def _launch_main(tmp_path, script_body, script_args=(), max_restarts=0):
     wi = encode_world_info({socket.gethostname(): [0]})
     argv = ["--world_info", wi, "--node_rank", "0",
             "--master_addr", "127.0.0.1", "--master_port", "29999",
-            "--max-restarts", str(max_restarts),
+            "--max-restarts", str(max_restarts), *extra_argv,
             str(script), *script_args]
     old_int = signal.getsignal(signal.SIGINT)
     old_term = signal.getsignal(signal.SIGTERM)
@@ -372,6 +373,25 @@ def _launch_main(tmp_path, script_body, script_args=(), max_restarts=0):
     finally:
         signal.signal(signal.SIGINT, old_int)
         signal.signal(signal.SIGTERM, old_term)
+
+
+def test_launch_exports_compile_cache_dir(tmp_path, monkeypatch):
+    """--compile-cache-dir reaches children as JAX_COMPILATION_CACHE_DIR
+    (absolute), so respawned processes warm-start their compiles — and
+    the launcher side stays jax-free (the child env var is jax's native
+    knob; nothing is imported to set it)."""
+    monkeypatch.setenv("DS_MONITOR_POLL_SECS", "0.1")
+    monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
+    out = tmp_path / "env.out"
+    code = _launch_main(
+        tmp_path,
+        "import os, sys\n"
+        "open(sys.argv[1], 'w').write(\n"
+        "    os.environ.get('JAX_COMPILATION_CACHE_DIR', '?'))\n",
+        script_args=(str(out),),
+        extra_argv=("--compile-cache-dir", str(tmp_path / "xla_cache")))
+    assert code == 0
+    assert out.read_text() == os.path.abspath(str(tmp_path / "xla_cache"))
 
 
 def test_map_exit_code_signal_names():

@@ -1,0 +1,252 @@
+"""Uniform-chunk (O(1)-compile) streamed offload update.
+
+These tests run the REAL in-jit chunk-streamed paths on the CPU backend
+via ``DS_OFFLOAD_FORCE_INJIT=1`` (zero/coordinator.py): the program
+structure — chunk slicing, group switch, scan carry, DUS write-back —
+is identical to the TPU form; only the memory-space placements compile
+as no-ops.  Numerics parity of the scan rewrite against both the
+round-5 unrolled form and device-resident training is therefore CI-
+checked, not TPU-only; ``tests/unit/test_tpu_offload.py`` remains the
+real-chip gate for the pinned-host placement itself.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as deepspeed
+import deepspeed_tpu.runtime.zero.coordinator as coord
+from deepspeed_tpu.ops.adam.fused_adam import FusedAdam
+from deepspeed_tpu.ops.op_common import LANES
+from deepspeed_tpu.parallel import make_mesh
+from deepspeed_tpu.runtime.zero import stream
+
+from .simple_model import SimpleModel, base_config, random_batches
+
+HIDDEN = 256
+NLAYERS = 8
+
+
+@pytest.fixture
+def force_injit(monkeypatch):
+    """CPU backend executes the in-jit streamed program structure, with
+    row-grouping forced at toy scale (2 MB per host group)."""
+    monkeypatch.setenv("DS_OFFLOAD_FORCE_INJIT", "1")
+    monkeypatch.setattr(coord, "HOST_GROUP_BYTES", 2 << 20)
+
+
+def _engine(cpu_devices, uniform, cpu_offload=True, offload_gradients=False,
+            clip=0.0, chunk_mb=1):
+    mesh = make_mesh({"data": 1}, devices=cpu_devices[:1])
+    cfg = base_config(
+        gradient_clipping=clip,
+        zero_optimization={"stage": 2, "cpu_offload": cpu_offload,
+                           "offload_chunk_mb": chunk_mb,
+                           "offload_gradients": (offload_gradients
+                                                 and cpu_offload),
+                           "offload_uniform_chunks": uniform})
+    engine, *_ = deepspeed.initialize(
+        model=SimpleModel(HIDDEN, nlayers=NLAYERS), config=cfg, mesh=mesh)
+    return engine
+
+
+def _losses(engine, steps=4):
+    batch = random_batches(1, engine.train_micro_batch_size_per_gpu(),
+                           HIDDEN, seed=0)[0]
+    return [float(np.asarray(engine.train_batch(iter([batch]))))
+            for _ in range(steps)]
+
+
+def test_uniform_matches_unrolled(force_injit, cpu_devices):
+    """The scan rewrite is a compile-cost change, not a numerics change:
+    same chunk bounds, same per-chunk math, same loss trajectory as the
+    round-5 unrolled round-robin form."""
+    eng_u = _engine(cpu_devices, uniform=True)
+    eng_r = _engine(cpu_devices, uniform=False)
+    assert eng_u._offload_uniform and not eng_r._offload_uniform
+    # real multi-group, multi-chunk geometry, or the test proves nothing
+    assert eng_u.flat.host_group_bounds is not None
+    assert len(eng_u.flat.host_group_bounds) >= 2
+    np.testing.assert_allclose(_losses(eng_u), _losses(eng_r), rtol=1e-6)
+
+
+def test_uniform_matches_device_resident(force_injit, cpu_devices):
+    """...and the same trajectory as plain device-resident training."""
+    streamed = _losses(_engine(cpu_devices, uniform=True))
+    base = _losses(_engine(cpu_devices, uniform=False, cpu_offload=False))
+    np.testing.assert_allclose(streamed, base, rtol=2e-4, atol=2e-4)
+    assert streamed[-1] < streamed[0]
+
+
+def test_uniform_offload_gradients_parity(force_injit, cpu_devices):
+    """The host-gradient leg (reverse-order spill + per-chunk coef fold)
+    composes with the scan update: parity vs the unrolled form at the
+    same clip setting."""
+    eng_u = _engine(cpu_devices, uniform=True, offload_gradients=True,
+                    clip=1.0)
+    eng_r = _engine(cpu_devices, uniform=False, offload_gradients=True,
+                    clip=1.0)
+    assert eng_u._offload_grads and eng_u._offload_uniform
+    np.testing.assert_allclose(_losses(eng_u), _losses(eng_r), rtol=1e-6)
+
+
+def test_uniform_layout_alignment(force_injit, cpu_devices):
+    """The coordinator pads total rows AND every group bound to whole
+    chunks, so each chunk of each group has the one scanned shape."""
+    engine = _engine(cpu_devices, uniform=True)
+    chunk_rows = engine.flat.uniform_chunk_rows
+    assert chunk_rows == (1 << 20) // (LANES * 4)
+    assert engine.segments.rows % chunk_rows == 0
+    for _, grc in engine.flat.host_group_bounds:
+        assert grc % chunk_rows == 0
+    jobs = stream.uniform_chunk_jobs(engine.flat.host_group_bounds,
+                                     chunk_rows)
+    assert len(jobs) == engine.segments.rows // chunk_rows
+    assert len({gi for gi, _, _ in jobs}) == len(
+        engine.flat.host_group_bounds)
+
+
+def test_uniform_falls_back_on_ragged_geometry(force_injit, cpu_devices):
+    """offload_chunk_mb: 0 (one ragged chunk per group) cannot scan;
+    the engine must warn and keep the unrolled path, still training."""
+    engine = _engine(cpu_devices, uniform=True, chunk_mb=0)
+    assert not engine._offload_uniform
+    losses = _losses(engine)
+    assert losses[-1] < losses[0], losses
+
+
+def test_uniform_auto_threshold(force_injit, cpu_devices):
+    """"auto" keeps the measured-faster unrolled round-robin form below
+    UNIFORM_MIN_CHUNKS and switches to the scan past it."""
+    few = _engine(cpu_devices, uniform="auto")
+    assert not few._offload_uniform  # toy model: far under the threshold
+    assert stream.UNIFORM_MIN_CHUNKS > 1
+    forced = _engine(cpu_devices, uniform=True)
+    assert forced._offload_uniform
+
+
+def test_checkpoint_roundtrip_across_forms(force_injit, cpu_devices,
+                                           tmp_path):
+    """Uniform-chunk padding changes the padded row layout, not the
+    portable checkpoint format: a checkpoint written by the scan form
+    restores into the unrolled form (and vice versa) with loss
+    continuity — layout elasticity, like DP-degree elasticity."""
+    eng_u = _engine(cpu_devices, uniform=True)
+    losses = _losses(eng_u, steps=2)
+    eng_u.save_checkpoint(str(tmp_path))
+    eng_r = _engine(cpu_devices, uniform=False)
+    eng_r.load_checkpoint(str(tmp_path))
+    batch = random_batches(1, eng_r.train_micro_batch_size_per_gpu(),
+                           HIDDEN, seed=0)[0]
+    l_resumed = float(np.asarray(eng_r.train_batch(iter([batch]))))
+    l_ref = float(np.asarray(eng_u.train_batch(iter([batch]))))
+    np.testing.assert_allclose(l_resumed, l_ref, rtol=2e-4, atol=2e-4)
+    assert losses[-1] < losses[0]
+
+
+# ----------------------------------------------------------------- core
+def _core_jaxpr(n_chunks, n_groups=2, chunk_rows=8):
+    """jaxpr of the scan core at a given chunk count (state size grows,
+    geometry otherwise fixed)."""
+    opt = FusedAdam()
+    rows_total = n_chunks * chunk_rows
+    per = rows_total // n_groups
+    assert per % chunk_rows == 0
+    bounds = tuple((g * per, per) for g in range(n_groups))
+    hp = opt.hyperparams()
+
+    masters = [jnp.zeros((per, LANES), jnp.float32) for _ in range(n_groups)]
+    st = opt.init_state(jnp.zeros((per, LANES), jnp.float32))
+    leaves, treedef = jax.tree_util.tree_flatten(st)
+    is_flat = [getattr(l, "ndim", 0) == 2 for l in leaves]
+    group_leaves = [list(leaves) for _ in range(n_groups)]
+    g = jnp.zeros((rows_total, LANES), jnp.float32)
+
+    def run(ms, gls, gg):
+        new_m, new_gl, _ = stream.uniform_scan_update(
+            masters=ms, group_leaves=gls, is_flat=is_flat,
+            opt_treedef=treedef, update_fn=opt.update, hp=hp,
+            overflow=jnp.asarray(False), skip_bad=True,
+            jobs=stream.uniform_chunk_jobs(bounds, chunk_rows),
+            chunk_rows=chunk_rows, lanes=LANES, g=gg)
+        return new_m, new_gl
+
+    return jax.make_jaxpr(run)(masters, group_leaves, g)
+
+
+def test_program_size_constant_in_chunk_count():
+    """THE tentpole property: the scanned update's program size does not
+    grow with chunk count (the unrolled form grew linearly — 361 ->
+    5641 HLO lines from 8 -> 128 chunks, examples/
+    bench_compile_scaling.py), so compile wall time stops scaling with
+    model size and the >30-min remote compiles that blocked gpt2-2.7B
+    cannot return."""
+    small = _core_jaxpr(n_chunks=4)
+    big = _core_jaxpr(n_chunks=64)
+    count = lambda jx: sum(1 for _ in jx.jaxpr.eqns)
+    assert count(big) == count(small), (
+        f"scan update grew with chunk count: {count(small)} eqns at 4 "
+        f"chunks vs {count(big)} at 64")
+
+
+def test_core_update_matches_whole_buffer_adam():
+    """The scan core applied chunk-by-chunk equals one whole-buffer Adam
+    update (same master, same moments, same step counter)."""
+    opt = FusedAdam()
+    chunk_rows, n_groups = 8, 2
+    rows = 4 * chunk_rows * n_groups
+    per = rows // n_groups
+    rng = np.random.default_rng(0)
+    master = rng.normal(size=(rows, LANES)).astype(np.float32)
+    g = rng.normal(size=(rows, LANES)).astype(np.float32)
+    hp = opt.hyperparams()
+
+    ref_p, ref_st = opt.update(
+        opt.init_state(jnp.asarray(master)), jnp.asarray(master),
+        jnp.asarray(g), hp)
+
+    bounds = tuple((gi * per, per) for gi in range(n_groups))
+    masters = [jnp.asarray(master[r0:r0 + rc]) for r0, rc in bounds]
+    st0 = opt.init_state(jnp.zeros((per, LANES), jnp.float32))
+    leaves, treedef = jax.tree_util.tree_flatten(st0)
+    is_flat = [getattr(l, "ndim", 0) == 2 for l in leaves]
+    group_leaves = [list(jax.tree_util.tree_leaves(st0))
+                    for _ in range(n_groups)]
+    new_m, new_gl, new_scalars = stream.uniform_scan_update(
+        masters=masters, group_leaves=group_leaves, is_flat=is_flat,
+        opt_treedef=treedef, update_fn=opt.update, hp=hp,
+        overflow=jnp.asarray(False), skip_bad=False,
+        jobs=stream.uniform_chunk_jobs(bounds, chunk_rows),
+        chunk_rows=chunk_rows, lanes=LANES, g=jnp.asarray(g))
+    got_p = np.concatenate([np.asarray(m) for m in new_m])
+    np.testing.assert_allclose(got_p, np.asarray(ref_p), rtol=5e-6)
+    got_m = np.concatenate([np.asarray(gl[0]) for gl in new_gl])
+    np.testing.assert_allclose(got_m, np.asarray(ref_st.exp_avg),
+                               rtol=1e-6)
+    assert int(np.asarray(new_scalars[0])) == int(np.asarray(ref_st.step))
+
+
+def test_core_overflow_skips_every_chunk():
+    """skip_bad + overflow keeps master and moments bit-identical and
+    the step counter un-advanced, chunk-for-chunk (the fp16/guard
+    contract the unrolled path implements per chunk)."""
+    opt = FusedAdam()
+    chunk_rows = 8
+    rows = 4 * chunk_rows
+    rng = np.random.default_rng(1)
+    master = jnp.asarray(rng.normal(size=(rows, LANES)).astype(np.float32))
+    g = jnp.asarray(rng.normal(size=(rows, LANES)).astype(np.float32))
+    st0 = opt.init_state(master)
+    leaves, treedef = jax.tree_util.tree_flatten(st0)
+    is_flat = [getattr(l, "ndim", 0) == 2 for l in leaves]
+    new_m, new_gl, new_scalars = stream.uniform_scan_update(
+        masters=[master], group_leaves=[list(leaves)], is_flat=is_flat,
+        opt_treedef=treedef, update_fn=opt.update, hp=opt.hyperparams(),
+        overflow=jnp.asarray(True), skip_bad=True,
+        jobs=stream.uniform_chunk_jobs(((0, rows),), chunk_rows),
+        chunk_rows=chunk_rows, lanes=LANES, g=g)
+    np.testing.assert_array_equal(np.asarray(new_m[0]), np.asarray(master))
+    np.testing.assert_array_equal(np.asarray(new_gl[0][0]),
+                                  np.asarray(st0.exp_avg))
+    assert int(np.asarray(new_scalars[0])) == 0

@@ -20,7 +20,7 @@ LAYERS = 2
 
 
 def _losses(cpu_offload, steps=4, chunk_mb=1, offload_gradients=False,
-            clip=0.0):
+            clip=0.0, uniform="auto"):
     import deepspeed_tpu as deepspeed
     from deepspeed_tpu.models import GPT2Config, GPT2LMHeadTPU
     from deepspeed_tpu.parallel import make_mesh
@@ -37,6 +37,7 @@ def _losses(cpu_offload, steps=4, chunk_mb=1, offload_gradients=False,
                 "gradient_clipping": clip,
                 "zero_optimization": {"stage": 2, "cpu_offload": cpu_offload,
                                       "offload_chunk_mb": chunk_mb,
+                                      "offload_uniform_chunks": uniform,
                                       "offload_gradients": (
                                           offload_gradients and cpu_offload)},
                 "bf16": {"enabled": True}})
@@ -105,6 +106,25 @@ def test_offload_gradients_matches_device_training(monkeypatch):
     for g in (hg if type(hg) is tuple else (hg,)):
         assert g.sharding.memory_kind == "pinned_host"
     np.testing.assert_allclose(streamed, base, rtol=2e-4, atol=2e-4)
+
+
+def test_uniform_scan_offload_matches_device_training(monkeypatch):
+    """The O(1)-compile uniform-chunk scan update ON THE REAL CHIP: the
+    pinned_host<->device placements live INSIDE a lax.scan body here
+    (the one thing the CPU-forced suite cannot exercise), with grouping
+    and the host-gradient leg both on.  Parity vs device-resident
+    training, and state stays host-resident."""
+    import deepspeed_tpu.runtime.zero.coordinator as coord
+
+    base, _ = _losses(cpu_offload=False, clip=1.0)
+    monkeypatch.setattr(coord, "HOST_GROUP_BYTES", 1 << 20)
+    streamed, engine = _losses(cpu_offload=True, chunk_mb=1, clip=1.0,
+                               offload_gradients=True, uniform=True)
+    assert engine._offload_uniform, "scan path did not engage"
+    assert engine.flat.host_group_bounds is not None
+    np.testing.assert_allclose(streamed, base, rtol=2e-4, atol=2e-4)
+    for g in engine.state["master"]:
+        assert g.sharding.memory_kind == "pinned_host"
 
 
 def test_streamed_offload_grouped_with_chunking_disabled(monkeypatch):
