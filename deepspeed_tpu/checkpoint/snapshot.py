@@ -152,6 +152,21 @@ def capture_engine_snapshot(engine, tag, client_state=None, save_latest=True):
         optim_states.update({k: ensure_owned(v)
                              for k, v in jax.device_get(small).items()})
 
+    # reduced-precision offload state: error-feedback residual buffers
+    # are training state — carried under qres/<name> in the same
+    # unpadded fp32 checkpoint format (upcast is exact), so a same-
+    # layout resume is bit-identical and a cross-dtype load can fold
+    # them back into the values (engine.load_checkpoint)
+    qres = engine.state.get("qres") if hasattr(engine, "state") else None
+    state_dtype_meta = None
+    if qres:
+        for name, buf in qres.items():
+            optim_states[f"qres/{name}"] = engine.flat.gather_master_unpadded(
+                buf)
+    if getattr(engine, "_state_reduced", False):
+        state_dtype_meta = dict(
+            engine._config.zero_config.offload_state_dtype)
+
     scale = engine.state["scale"]
     # ONE transfer for every device scalar in the meta block: each
     # separate device_get is its own blocking wire round-trip, and this
@@ -181,6 +196,11 @@ def capture_engine_snapshot(engine, tag, client_state=None, save_latest=True):
         "param_count": int(sum(engine.segments.sizes)),
         "model_dtypes": model_dtypes,
     }
+    if state_dtype_meta is not None:
+        # which storage layout wrote this checkpoint: loads into the
+        # SAME layout restore raw buffers bit-exactly; any other layout
+        # folds residuals and re-rounds once
+        meta["offload_state_dtype"] = state_dtype_meta
 
     client_state_pkl = (pickle.dumps(client_state)
                         if client_state else None)

@@ -40,6 +40,18 @@ _CACHE_PATH = os.environ.get(
 _memory_cache = {}
 _disk_loaded = False
 
+# Tuner algorithm revision, part of every cache key: winners persist to
+# disk indefinitely, so a ranking fixed by a later tuner (candidate set,
+# timing discipline, screening) must INVALIDATE cached pre-fix winners —
+# keying by shape+device alone let mis-ranked geometries outlive the
+# tuner that produced them (VERDICT r5).  Bump this when the search
+# changes in any way that can alter a winner; stale-version entries are
+# simply ignored (and rewritten on the next tune of that shape).
+#
+# v2: version-carrying keys; retires v1 entries ranked before the
+# interleaved-repeat/min-aggregation discipline carried its own version.
+TUNER_VERSION = 2
+
 
 def _mode():
     return os.environ.get("DS_FLASH_AUTOTUNE", "auto")
@@ -59,9 +71,11 @@ def anchored(s, kv_len, d, causal):
 
 def _key(s, kv_len, d, causal, dropout, device_kind=""):
     # device_kind in the key: a geometry tuned on a v5e must not be
-    # silently reused on a v4/v5p (different VMEM/MXU/bandwidth)
+    # silently reused on a v4/v5p (different VMEM/MXU/bandwidth).
+    # TUNER_VERSION in the key: a geometry ranked by an older tuner
+    # must not be silently reused by a newer one.
     dk = device_kind.replace("|", "_").replace(" ", "_")
-    return (f"v1|{dk}|s{s}|kv{kv_len}|d{d}|c{int(causal)}"
+    return (f"v{TUNER_VERSION}|{dk}|s{s}|kv{kv_len}|d{d}|c{int(causal)}"
             f"|p{int(dropout > 0)}")
 
 

@@ -175,6 +175,39 @@ ZERO_OFFLOAD_GROUP_MB = "offload_group_mb"
 ZERO_OFFLOAD_GROUP_MB_DEFAULT = 1792
 ZERO_ELASTIC_CHECKPOINT = "elastic_checkpoint"
 ZERO_ELASTIC_CHECKPOINT_DEFAULT = True
+# Reduced-precision host optimizer state (zero/qstate.py): store the
+# pinned-host (p, m, v) buffers in bf16/fp16 and upcast to fp32 on
+# device inside the streamed update — the offload step is wire-bound
+# (PERF.md "ZeRO-Offload wire bytes"), so halving the bytes on the
+# PCIe wire is the step-time lever streaming overlap cannot reach.
+# Sub-block of zero_optimization; also accepts the shorthand string
+# "bf16"/"fp16" meaning master+momentum+variance all at that dtype.
+ZERO_OFFLOAD_STATE_DTYPE = "offload_state_dtype"
+# storage dtype of the flat fp32 master ("fp32" | "bf16"; fp16's 5-bit
+# exponent cannot carry master weights safely and is rejected)
+ZERO_OFFLOAD_STATE_DTYPE_MASTER = "master"
+ZERO_OFFLOAD_STATE_DTYPE_MASTER_DEFAULT = "fp32"
+# storage dtype of Adam's first moment m ("fp32" | "bf16" | "fp16")
+ZERO_OFFLOAD_STATE_DTYPE_MOMENTUM = "momentum"
+ZERO_OFFLOAD_STATE_DTYPE_MOMENTUM_DEFAULT = "fp32"
+# storage dtype of Adam's second moment v ("fp32" | "bf16" | "fp16")
+ZERO_OFFLOAD_STATE_DTYPE_VARIANCE = "variance"
+ZERO_OFFLOAD_STATE_DTYPE_VARIANCE_DEFAULT = "fp32"
+# write-back mechanism: false (default) -> the `rounding` mode below;
+# true -> a persistent error-feedback residual buffer per reduced
+# buffer (deterministic, rides the chunk stream AND the checkpoint, at
+# the cost of its own wire bytes)
+ZERO_OFFLOAD_STATE_DTYPE_ERROR_FEEDBACK = "error_feedback"
+ZERO_OFFLOAD_STATE_DTYPE_ERROR_FEEDBACK_DEFAULT = False
+# "stochastic" (default: unbiased SR downcast — sub-ulp updates survive
+# in expectation at zero extra wire bytes) | "nearest" (plain downcast;
+# drifts by construction — kept as the measurable control)
+ZERO_OFFLOAD_STATE_DTYPE_ROUNDING = "rounding"
+ZERO_OFFLOAD_STATE_DTYPE_ROUNDING_DEFAULT = "stochastic"
+# seed of the stochastic-rounding bit stream (folded with the optimizer
+# step and chunk index, so directions decorrelate across steps/chunks)
+ZERO_OFFLOAD_STATE_DTYPE_SEED = "seed"
+ZERO_OFFLOAD_STATE_DTYPE_SEED_DEFAULT = 0
 
 #############################################
 # Pipeline (reference runtime/config.py:363-374)

@@ -310,6 +310,9 @@ class DeepSpeedEngine:
         # typed key: the impl rides in the dtype, so split/fold_in downstream
         # (models, dropout) never mistake it for a default-impl raw key
         self._rng = jax.random.key(rng_seed, impl=prng_impl)
+        # stochastic-rounding bit streams (reduced-precision offload
+        # state) reuse the same impl choice: rbg bits are ~free on TPU
+        self._prng_impl = prng_impl
         # model init always derives from threefry: same seed → same initial
         # params on every backend, independent of the training-stream impl
         init_rng = jax.random.PRNGKey(rng_seed)
@@ -362,6 +365,17 @@ class DeepSpeedEngine:
         uniform_cfg = getattr(zc, "offload_uniform_chunks", "auto")
         chunk_rows_cfg = (max(1, (zc.offload_chunk_mb << 20) // (LANES * 4))
                           if zc.offload_chunk_mb else None)
+        # reduced-precision host state (zero/qstate.py): the master's
+        # storage dtype shapes the coordinator's buffers; the residual
+        # and gradient buffer FAMILIES count toward the host-buffer
+        # total the auto group layout must cap (the AOT crash mode)
+        from .zero.qstate import STATE_DTYPES
+
+        sd_cfg = zc.offload_state_dtype
+        self._state_reduced = bool(
+            getattr(zc, "offload_state_reduced", False))
+        host_families = (3 + (1 if zc.offload_gradients else 0)
+                         + getattr(zc, "offload_state_residual_count", 0))
         self.flat = FlatParamCoordinator(
             mesh=self.mesh, params_template=params0, stage=self.zero_stage,
             dp_size=self.dp_world_size,
@@ -373,7 +387,10 @@ class DeepSpeedEngine:
                                 if zc.cpu_offload and uniform_cfg is not False
                                 else None),
             uniform_min_chunks=(1 if uniform_cfg is True
-                                else UNIFORM_MIN_CHUNKS))
+                                else UNIFORM_MIN_CHUNKS),
+            host_families=host_families,
+            master_dtype=(STATE_DTYPES[sd_cfg["master"]]
+                          if self._state_reduced else None))
         self.segments = self.flat.segments
 
         # master weights (flat fp32, sharded per stage)
@@ -398,6 +415,23 @@ class DeepSpeedEngine:
         # themselves) or 'eager' (state parked in pinned host between steps)
         self._offload = self.flat.cpu_offload
         self._offload_eager = self._offload and not self.flat.injit_placement
+        if self._state_reduced:
+            # loud, not silent: the flag exists to halve the wire bytes
+            # of the STREAMED update — paths that cannot stream (eager
+            # offload parks full buffers; non-Adam optimizers take the
+            # one-shot update) would run fp32 math on reduced storage
+            # or silently keep fp32 wire traffic
+            if self._offload_eager:
+                raise ValueError(
+                    "offload_state_dtype with reduced dtypes requires "
+                    "in-jit host placement (TPU backend, or "
+                    "DS_OFFLOAD_FORCE_INJIT=1 for CPU tests); this "
+                    "backend only supports eager offload mode")
+            if getattr(self.optimizer, "name", "") != "adam":
+                raise ValueError(
+                    "offload_state_dtype with reduced dtypes requires "
+                    "the flat Adam optimizer (the chunk-streamed update "
+                    "the compression rides)")
         if self._offload and self.flat.memory_spaces:
             self._opt_shardings_device = jax.tree_util.tree_map(
                 lambda s: s.with_memory_kind("device"), self._opt_shardings)
@@ -428,19 +462,32 @@ class DeepSpeedEngine:
                     jax.ShapeDtypeStruct(self.segments.shape, jnp.float32))
                 bounds = (self.flat.host_group_bounds
                           or ((0, self.segments.rows),))
+                # reduced host state: flat leaves store in their
+                # configured dtype (exp_avg -> momentum, exp_avg_sq ->
+                # variance); scalars and the fp32 default are untouched
+                sd_by_name = {}
+                if self._state_reduced:
+                    sd_by_name = {
+                        "exp_avg": STATE_DTYPES[sd_cfg["momentum"]],
+                        "exp_avg_sq": STATE_DTYPES[sd_cfg["variance"]]}
 
-                def _mk(leaf):
+                def _mk(leaf, dtype):
                     if leaf.shape == self.segments.shape:
                         grps = tuple(
                             jax.device_put(np.zeros((rc, LANES),
-                                                    leaf.dtype),
+                                                    np.dtype(dtype)),
                                            self.flat.master_sharding)
                             for _, rc in bounds)
                         return (grps if self.flat.host_group_bounds
                                 is not None else grps[0])
                     return jnp.zeros(leaf.shape, leaf.dtype)
 
-                opt0 = jax.tree_util.tree_map(_mk, opt_shape)
+                flat_sh, opt_def0 = jax.tree_util.tree_flatten_with_path(
+                    opt_shape)
+                opt0 = jax.tree_util.tree_unflatten(opt_def0, [
+                    _mk(leaf, sd_by_name.get(
+                        tree_path_key(path).lstrip("."), leaf.dtype))
+                    for path, leaf in flat_sh])
             elif self.flat.host_group_bounds is not None:
                 raise ValueError(
                     "cpu_offload with row-grouped host state requires a "
@@ -496,10 +543,35 @@ class DeepSpeedEngine:
         hostgrad0 = (self.flat.alloc_host_grads()
                      if self._offload_grads else None)
 
+        # persistent error-feedback residuals (reduced-precision offload
+        # state, zero/qstate.py): one pinned-host buffer per reduced
+        # state buffer, grouped like the master, zero-init (the init
+        # downcast error is absorbed within the first few steps)
+        qres0 = None
+        if self._state_reduced and sd_cfg["error_feedback"]:
+            res_bounds = (self.flat.host_group_bounds
+                          or ((0, self.segments.rows),))
+
+            def _zeros_grouped(dtype):
+                grps = tuple(
+                    jax.device_put(np.zeros((rc, LANES), np.dtype(dtype)),
+                                   self.flat.master_sharding)
+                    for _, rc in res_bounds)
+                return (grps if self.flat.host_group_bounds is not None
+                        else grps[0])
+
+            qres0 = {}
+            for name, field in (("master", "master"),
+                                ("exp_avg", "momentum"),
+                                ("exp_avg_sq", "variance")):
+                if sd_cfg[field] != "fp32":
+                    qres0[name] = _zeros_grouped(STATE_DTYPES[sd_cfg[field]])
+
         self.state = {
             "master": master0,
             "opt": opt0,
             "hostgrad": hostgrad0,
+            "qres": qres0,
             "scale": scale0,
             "skipped": jnp.asarray(0, jnp.int32),
             # device-resident step counter: the fused train step derives its
@@ -656,6 +728,20 @@ class DeepSpeedEngine:
 
     def zero_cpu_offload(self):
         return self._config.zero_config.cpu_offload
+
+    def host_state_dtype(self):
+        """Storage dtype of the offloaded host state: one canonical name
+        when master/momentum/variance agree, else "mixed" (bench rows and
+        telemetry quote this next to host_state_bytes_per_step)."""
+        sd = self._config.zero_config.offload_state_dtype
+        names = {sd["master"], sd["momentum"], sd["variance"]}
+        return sd["master"] if len(names) == 1 else "mixed"
+
+    def host_state_bytes_per_step(self):
+        """Wire bytes the streamed update moves per step for the host
+        optimizer state (both directions; gradients separate).  None
+        when offload is off."""
+        return getattr(self, "_host_state_bytes_per_step", None)
 
     def fp16_enabled(self):
         return self._config.fp16_enabled
@@ -941,9 +1027,26 @@ class DeepSpeedEngine:
             pass
         chunk_mb_forced = (chunk_mb > 0 and getattr(
             self._config.zero_config, "offload_chunk_mb_explicit", False))
+        # Reduced-precision host state (zero/qstate.py): squant is None
+        # on the fp32 default path, and every insertion below is gated
+        # on it — the default-path programs stay byte-identical.
+        from .zero.qstate import (build_state_quant,
+                                  host_state_bytes_per_step)
+
+        opt_shape_flat = (jax.eval_shape(
+            optimizer.init_state,
+            jax.ShapeDtypeStruct(segments.shape, jnp.float32))
+            if offload else None)
+        squant = None
+        if self._state_reduced:
+            squant = build_state_quant(
+                self._config.zero_config.offload_state_dtype,
+                opt_shape_flat, prng_impl=self._prng_impl)
+        self._state_quant = squant
         offload_stream = (
             offload and getattr(optimizer, "name", "") == "adam"
             and (self._offload_grads  # host grads ride the chunk stream
+                 or squant is not None  # compression rides the stream
                  or groups is not None
                  or (rows_per_chunk is not None
                      and segments.rows > rows_per_chunk
@@ -1000,6 +1103,30 @@ class DeepSpeedEngine:
                     f"{len(gb_all)} group(s)) — compile cost is "
                     f"O(groups), not O(chunks)", ranks=[0])
         self._offload_uniform = offload_uniform
+
+        # Wire-bytes accounting (PERF.md "ZeRO-Offload wire bytes"): the
+        # streamed update moves every host state buffer down and back up
+        # exactly once per step — a deterministic figure the bench JSON
+        # and telemetry carry so reduced-precision claims are auditable.
+        self._host_state_bytes_per_step = None
+        if offload:
+            n_flat_leaves = sum(
+                1 for l in jax.tree_util.tree_leaves(opt_shape_flat)
+                if getattr(l, "ndim", 0) == 2)
+            self._host_state_bytes_per_step = host_state_bytes_per_step(
+                segments.rows, LANES, squant, n_flat_leaves=n_flat_leaves)
+            if self.telemetry.enabled:
+                self.telemetry.gauge(
+                    "offload/host_state_bytes_per_step").set(
+                    float(self._host_state_bytes_per_step))
+            if squant is not None:
+                log_dist(
+                    f"ZeRO-Offload: reduced-precision host state "
+                    f"{self._config.zero_config.offload_state_dtype} — "
+                    f"{self._host_state_bytes_per_step / 2**30:.2f} GB "
+                    f"state wire bytes/step (fp32 layout: "
+                    f"{host_state_bytes_per_step(segments.rows, LANES, None, n_flat_leaves=n_flat_leaves) / 2**30:.2f} GB)",
+                    ranks=[0])
 
         host_big = self.flat.master_sharding
 
@@ -1077,8 +1204,21 @@ class DeepSpeedEngine:
                 lambda x, s: jax.lax.with_sharding_constraint(x, s),
                 params, param_shardings)
 
+        def _qres_group_bufs(qres):
+            """state["qres"] dict -> {name: per-group buffer list}; the
+            residual buffers share the master's row-group layout."""
+            return {k: (list(v) if type(v) is tuple else [v])
+                    for k, v in (qres or {}).items()}
+
+        def _qres_regroup(res_bufs, qres):
+            """Inverse: per-group lists back into the state layout."""
+            if not res_bufs:
+                return qres
+            return {k: (tuple(v) if groups is not None else v[0])
+                    for k, v in res_bufs.items()}
+
         def chunked_offload_update(master, opt_state, g, hp, overflow,
-                                   coef=None, g_on_host=False,
+                                   qres=None, coef=None, g_on_host=False,
                                    want_cast=False):
             """Chunk-streamed offloaded update, ROUND-ROBIN over host
             groups.
@@ -1108,6 +1248,23 @@ class DeepSpeedEngine:
             group_leaves, is_flat, opt_defs = _split_group_states(
                 opt_state, n_g)
             scalar_out = [None] * len(is_flat)
+            nf = sum(is_flat)
+            res_bufs = _qres_group_bufs(qres)
+            # residual read/write plan: master first, then reduced flat
+            # leaves in leaf order — tags must match the scan form so
+            # stochastic-rounding draws agree across the two layouts
+            res_items = []
+            if squant is not None:
+                if "master" in res_bufs:
+                    res_items.append(("master", None))
+                fi_of_li = {}
+                fi = 0
+                for li, f in enumerate(is_flat):
+                    if f:
+                        fi_of_li[li] = fi
+                        fi += 1
+                for li in squant.res_leaf_lis:
+                    res_items.append((squant.leaf_names[li], li))
 
             per_group = [_chunks(grc) for _, grc in gb]
             jobs, idx = [], [0] * n_g
@@ -1126,15 +1283,33 @@ class DeepSpeedEngine:
                 slices = [jax.lax.slice_in_dim(master_g, r0, r0 + rc)] + [
                     jax.lax.slice_in_dim(l, r0, r0 + rc)
                     for l, f in zip(leaves, is_flat) if f]
+                for name, _li in res_items:
+                    slices.append(jax.lax.slice_in_dim(
+                        res_bufs[name][gi], r0, r0 + rc))
                 if g_on_host:
                     g_g = g[gi] if type(g) is tuple else g
                     slices.append(jax.lax.slice_in_dim(g_g, r0, r0 + rc))
                 host_slices = _after(tok2, slices)
-                pm = jax.device_put(host_slices[0], dev_sharding)
-                it = iter(host_slices[1:])
-                chunk_leaves = [
+                pm_q = jax.device_put(host_slices[0], dev_sharding)
+                it = iter(host_slices[1:1 + nf])
+                chunk_leaves_q = [
                     jax.device_put(next(it), dev_sharding) if f else l
                     for l, f in zip(leaves, is_flat)]
+                res_dev = [jax.device_put(x, dev_sharding)
+                           for x in host_slices[1 + nf:1 + nf
+                                                + len(res_items)]]
+                if squant is None:
+                    pm, chunk_leaves = pm_q, chunk_leaves_q
+                else:
+                    res_by_li = {li: res_dev[i] for i, (_, li)
+                                 in enumerate(res_items) if li is not None}
+                    res_m = (res_dev[0] if res_items
+                             and res_items[0][0] == "master" else None)
+                    pm = squant.load(pm_q, res_m)
+                    chunk_leaves = [
+                        squant.load(cq, res_by_li.get(li))
+                        if is_flat[li] else cq
+                        for li, cq in enumerate(chunk_leaves_q)]
                 st = jax.tree_util.tree_unflatten(opt_defs, chunk_leaves)
                 if g_on_host:
                     gc_ = jax.device_put(host_slices[-1],
@@ -1142,22 +1317,71 @@ class DeepSpeedEngine:
                 else:
                     gc_ = jax.lax.slice_in_dim(g, gr0 + r0, gr0 + r0 + rc)
                 new_p, new_st = optimizer.update(st, pm, gc_, hp)
+                new_leaves = jax.tree_util.tree_leaves(new_st)
                 tok2, tok1 = tok1, new_p[0, 0]
-                if skip_bad:
-                    new_p = jnp.where(overflow, pm, new_p)
+                key_base = None
+                if squant is not None and squant._key0 is not None:
+                    scal = [new_leaves[li] for li, f in enumerate(is_flat)
+                            if not f]
+                    key_base = squant.chunk_key(
+                        scal[squant.step_scalar_idx], jnp.uint32(jn))
+                if squant is None:
+                    if skip_bad:
+                        new_p = jnp.where(overflow, pm, new_p)
+                    write_p = new_p
+                else:
+                    q_p, r_p = squant.store(
+                        new_p, squant.master_dtype,
+                        key=(jax.random.fold_in(key_base, 0)
+                             if key_base is not None and squant.master_dtype
+                             != jnp.float32 else None))
+                    if skip_bad:
+                        q_p = jnp.where(overflow, pm_q, q_p)
+                        if r_p is not None:
+                            r_p = jnp.where(overflow, res_m, r_p)
+                    write_p = q_p
+                    if r_p is not None:
+                        res_bufs["master"][gi] = jax.lax.dynamic_update_slice(
+                            res_bufs["master"][gi],
+                            jax.device_put(r_p, host_big), (r0, 0))
                 if cast_parts is not None:
                     # fold the compute-dtype param cast into the update:
                     # the new-param chunk is already on device, so the
                     # post-update streamed cast's re-download of the
-                    # whole master disappears
-                    cast_parts[(gi, r0)] = new_p.astype(self.compute_dtype)
+                    # whole master disappears.  Under reduced storage the
+                    # cast derives from the QUANTIZED value, so forward
+                    # params equal the stored master exactly in both
+                    # streamed forms
+                    cast_parts[(gi, r0)] = write_p.astype(self.compute_dtype)
                 masters[gi] = jax.lax.dynamic_update_slice(
-                    master_g, jax.device_put(new_p, host_big), (r0, 0))
-                for li, (old_c, new_l) in enumerate(zip(
-                        chunk_leaves, jax.tree_util.tree_leaves(new_st))):
+                    master_g, jax.device_put(write_p, host_big), (r0, 0))
+                for li, (old_q, new_l) in enumerate(zip(
+                        chunk_leaves_q, new_leaves)):
                     if is_flat[li]:
-                        if skip_bad:
-                            new_l = jnp.where(overflow, old_c, new_l)
+                        if squant is None:
+                            if skip_bad:
+                                new_l = jnp.where(overflow, old_q, new_l)
+                        else:
+                            q_l, r_l = squant.store(
+                                new_l, squant.leaf_dtypes[li],
+                                key=(jax.random.fold_in(
+                                    key_base, 1 + fi_of_li[li])
+                                    if key_base is not None
+                                    and squant.leaf_dtypes[li]
+                                    != jnp.float32 else None))
+                            if skip_bad:
+                                q_l = jnp.where(overflow, old_q, q_l)
+                            if li in res_by_li and r_l is not None:
+                                if skip_bad:
+                                    r_l = jnp.where(overflow,
+                                                    res_by_li[li], r_l)
+                                nm = squant.leaf_names[li]
+                                res_bufs[nm][gi] = \
+                                    jax.lax.dynamic_update_slice(
+                                        res_bufs[nm][gi],
+                                        jax.device_put(r_l, host_big),
+                                        (r0, 0))
+                            new_l = q_l
                         leaves[li] = jax.lax.dynamic_update_slice(
                             leaves[li], jax.device_put(new_l, host_big),
                             (r0, 0))
@@ -1180,12 +1404,13 @@ class DeepSpeedEngine:
                 new_sts.append(jax.tree_util.tree_unflatten(opt_defs,
                                                             out_leaves))
             new_opt = _recombine_group_states(opt_state, new_sts)
+            new_qres = _qres_regroup(res_bufs, qres)
             if groups is None:
-                return masters[0], new_opt, cast_list
-            return tuple(masters), new_opt, cast_list
+                return masters[0], new_opt, new_qres, cast_list
+            return tuple(masters), new_opt, new_qres, cast_list
 
         def uniform_offload_update(master, opt_state, g, hp, overflow,
-                                   coef=None, g_on_host=False):
+                                   qres=None, coef=None, g_on_host=False):
             """The O(1)-compile streamed update: same per-chunk math and
             group structure as :func:`chunked_offload_update`, but the
             chunk loop is a ``lax.scan`` over (group, row) index data
@@ -1197,14 +1422,23 @@ class DeepSpeedEngine:
             streamed ``cast_params`` (2 HLO ops per chunk) instead."""
             masters = list(master) if type(master) is tuple else [master]
             gb = groups or ((0, segments.rows),)
+            n_g = len(gb)
             group_leaves, is_flat, opt_defs = _split_group_states(
-                opt_state, len(gb))
+                opt_state, n_g)
             g_groups = gg = None
             if g_on_host:
                 g_groups = list(g) if type(g) is tuple else [g]
             else:
                 gg = g
-            new_masters, new_group_leaves, _ = uniform_scan_update(
+            res_bufs = _qres_group_bufs(qres)
+            res_masters = res_bufs.get("master")
+            res_names = ([squant.leaf_names[li]
+                          for li in squant.res_leaf_lis]
+                         if squant is not None else [])
+            res_group_leaves = ([[res_bufs[nm][gi] for nm in res_names]
+                                 for gi in range(n_g)]
+                                if res_names else None)
+            out = uniform_scan_update(
                 masters=masters, group_leaves=group_leaves,
                 is_flat=is_flat, opt_treedef=opt_defs,
                 update_fn=optimizer.update, hp=hp, overflow=overflow,
@@ -1213,13 +1447,25 @@ class DeepSpeedEngine:
                 chunk_rows=rows_per_chunk, lanes=LANES,
                 g=gg, g_groups=g_groups, coef=coef,
                 to_dev=lambda x: jax.device_put(x, dev_sharding),
-                to_host=lambda x: jax.device_put(x, host_big))
+                to_host=lambda x: jax.device_put(x, host_big),
+                quant=squant, res_masters=res_masters,
+                res_group_leaves=res_group_leaves)
+            if len(out) == 5:
+                (new_masters, new_group_leaves, _, new_resm,
+                 new_resf) = out
+                if new_resm is not None:
+                    res_bufs["master"] = list(new_resm)
+                for k, nm in enumerate(res_names):
+                    res_bufs[nm] = [new_resf[gi][k] for gi in range(n_g)]
+            else:
+                new_masters, new_group_leaves, _ = out
+            new_qres = _qres_regroup(res_bufs, qres)
             new_sts = [jax.tree_util.tree_unflatten(opt_defs, gl)
                        for gl in new_group_leaves]
             new_opt = _recombine_group_states(opt_state, new_sts)
             if groups is None:
-                return new_masters[0], new_opt, None
-            return tuple(new_masters), new_opt, None
+                return new_masters[0], new_opt, new_qres, None
+            return tuple(new_masters), new_opt, new_qres, None
 
         host_grad_big = self.flat.grad_host_sharding
         offload_grads_mode = self._offload_grads and offload_stream
@@ -1288,7 +1534,7 @@ class DeepSpeedEngine:
             return out, sq, finite
 
         def apply_update_hostg(master, opt_state, scale_state, skipped,
-                               hostg, sq, finite, hp):
+                               hostg, sq, finite, hp, qres=None):
             """The offload_gradients update: gradients stream back from
             the pinned-host buffer per chunk; unscale + clip fold into a
             single per-chunk multiply (``coef``)."""
@@ -1302,13 +1548,15 @@ class DeepSpeedEngine:
                 gnorm = jnp.asarray(0.0, jnp.float32)
                 coef = jnp.asarray(inv, jnp.float32)
             if offload_uniform:
-                new_master, new_opt, cast_list = uniform_offload_update(
-                    master, opt_state, hostg, hp, overflow, coef=coef,
-                    g_on_host=True)
+                new_master, new_opt, qres, cast_list = \
+                    uniform_offload_update(
+                        master, opt_state, hostg, hp, overflow, qres=qres,
+                        coef=coef, g_on_host=True)
             else:
-                new_master, new_opt, cast_list = chunked_offload_update(
-                    master, opt_state, hostg, hp, overflow, coef=coef,
-                    g_on_host=True, want_cast=True)
+                new_master, new_opt, qres, cast_list = \
+                    chunked_offload_update(
+                        master, opt_state, hostg, hp, overflow, qres=qres,
+                        coef=coef, g_on_host=True, want_cast=True)
             if fp16 and dynamic:
                 scale_state = update_scale_state(
                     scale_state, overflow,
@@ -1318,7 +1566,7 @@ class DeepSpeedEngine:
             if skip_bad:
                 skipped = skipped + overflow.astype(jnp.int32)
             return (new_master, new_opt, scale_state, skipped, overflow,
-                    gnorm, cast_list)
+                    gnorm, qres, cast_list)
 
         def cast_params(master):
             # stage 3 skips the up-front full replication: each leaf's row
@@ -1506,7 +1754,7 @@ class DeepSpeedEngine:
                                  out_shardings=grad_sharding)
 
         def apply_update(master, opt_state, scale_state, skipped, flat_g, hp,
-                         segment_ids, want_cast=False):
+                         segment_ids, qres=None, want_cast=False):
             inv = 1.0 / scale_state.cur_scale
             # .astype keeps a compute-dtype flat buffer in its dtype (a
             # traced fp32 scalar would silently promote the whole buffer)
@@ -1525,12 +1773,14 @@ class DeepSpeedEngine:
             if offload_stream:
                 # streamed offload: per-chunk fp16 pick happens inside
                 if offload_uniform:
-                    new_master, new_opt, cast_list = uniform_offload_update(
-                        master, opt_state, g, hp, overflow)
+                    new_master, new_opt, qres, cast_list = \
+                        uniform_offload_update(
+                            master, opt_state, g, hp, overflow, qres=qres)
                 else:
-                    new_master, new_opt, cast_list = chunked_offload_update(
-                        master, opt_state, g, hp, overflow,
-                        want_cast=want_cast)
+                    new_master, new_opt, qres, cast_list = \
+                        chunked_offload_update(
+                            master, opt_state, g, hp, overflow, qres=qres,
+                            want_cast=want_cast)
                 if fp16 and dynamic:
                     scale_state = update_scale_state(
                         scale_state, overflow,
@@ -1540,7 +1790,7 @@ class DeepSpeedEngine:
                 if skip_bad:
                     skipped = skipped + overflow.astype(jnp.int32)
                 base = (new_master, new_opt, scale_state, skipped, overflow,
-                        gnorm)
+                        gnorm, qres)
                 return base + (cast_list,) if want_cast else base
 
             master = to_device(master)
@@ -1562,13 +1812,22 @@ class DeepSpeedEngine:
                         min_scale=scale_args.get("min_scale", 1.0),
                         delayed_shift=scale_args.get("delayed_shift", 1))
                 skipped = skipped + overflow.astype(jnp.int32)
-            return new_master, new_opt, scale_state, skipped, overflow, gnorm
+            return (new_master, new_opt, scale_state, skipped, overflow,
+                    gnorm, qres)
 
+        # residual buffers live in the master's (grouped) host sharding
+        qres_sharding = None
+        if self.state.get("qres"):
+            qres_sharding = {
+                k: (tuple(host_big for _ in v) if type(v) is tuple
+                    else host_big)
+                for k, v in self.state["qres"].items()}
         self._apply_fn = jax.jit(
             apply_update,
-            donate_argnums=(0, 1, 4),
+            donate_argnums=(0, 1, 4) + ((7,) if self.state.get("qres")
+                                        else ()),
             out_shardings=(master_out_sharding, opt_out_shardings,
-                           None, None, None, None))
+                           None, None, None, None, qres_sharding))
 
         def eval_fwd(params_or_master, batch, rng, extra):
             set_current_mesh(mesh)
@@ -1594,7 +1853,7 @@ class DeepSpeedEngine:
 
         def train_step(master, opt_state, scale_state, skipped, ustep, params,
                        packed, unpack_spec, hp, segment_ids, extra,
-                       hostgrad):
+                       hostgrad, qres):
             set_current_mesh(mesh)
             cur_scale = scale_state.cur_scale
             fwd_params = cast_params(master) if stage3 else params
@@ -1612,9 +1871,9 @@ class DeepSpeedEngine:
                 hostgrad, sq, finite = grads_tree_to_host(grads, hostgrad)
                 del grads
                 (master, opt_state, scale_state, skipped, overflow,
-                 gnorm, cast_list) = apply_update_hostg(
+                 gnorm, qres, cast_list) = apply_update_hostg(
                     master, opt_state, scale_state, skipped, hostgrad, sq,
-                    finite, hp)
+                    finite, hp, qres=qres)
                 if stage3:
                     new_params = None
                 elif cast_list is not None:
@@ -1624,7 +1883,7 @@ class DeepSpeedEngine:
                 drops = {k: jnp.asarray(0, jnp.int32) for k in sparse_paths}
                 return (loss, master, opt_state, scale_state, skipped,
                         ustep + jnp.uint32(1), overflow, gnorm, new_params,
-                        drops, hostgrad)
+                        drops, hostgrad, qres)
 
             def micro(carry, xs):
                 acc, i, drops_acc = carry
@@ -1652,36 +1911,40 @@ class DeepSpeedEngine:
                             jnp.asarray(0, jnp.int32), drops0), batches)
 
             upd = apply_update(master, opt_state, scale_state, skipped,
-                               flat_g, hp, segment_ids,
+                               flat_g, hp, segment_ids, qres=qres,
                                want_cast=offload_stream)
             (master, opt_state, scale_state, skipped, overflow,
-             gnorm) = upd[:6]
+             gnorm, qres) = upd[:7]
             if stage3:
                 new_params = None
-            elif offload_stream and upd[6] is not None:
+            elif offload_stream and upd[7] is not None:
                 # params assembled from the update's own device chunks —
                 # no post-update re-read of the host master
-                new_params = carve_leaves(upd[6])
+                new_params = carve_leaves(upd[7])
             else:
                 new_params = cast_params(master)
             return (jnp.mean(losses), master, opt_state, scale_state, skipped,
                     ustep + jnp.uint32(1), overflow, gnorm, new_params, drops,
-                    hostgrad)
+                    hostgrad, qres)
 
         hostgrad_sharding = None
         if offload_grads_mode:
             hostgrad_sharding = (
                 tuple(host_grad_big for _ in groups) if groups is not None
                 else host_grad_big)
+        donate = (0, 1, 5)
+        if offload_grads_mode:
+            donate = donate + (11,)
+        if self.state.get("qres"):
+            donate = donate + (12,)
         self._train_step_fn = jax.jit(
             train_step,
             static_argnums=(7,),
-            donate_argnums=(0, 1, 5, 11) if offload_grads_mode
-            else (0, 1, 5),
+            donate_argnums=donate,
             out_shardings=(None, master_out_sharding, opt_out_shardings, None,
                            None, None, None, None,
                            None if stage3 else param_shardings, None,
-                           hostgrad_sharding))
+                           hostgrad_sharding, qres_sharding))
 
         # 1-bit Adam compressed phase: a second program with NO dense
         # gradient allreduce (host-side phase switch at freeze_step — the
@@ -1914,9 +2177,11 @@ class DeepSpeedEngine:
             self._state_memory("device")
         with self.mesh:
             (self.state["master"], self.state["opt"], self.state["scale"],
-             self.state["skipped"], overflow, gnorm) = self._apply_fn(
+             self.state["skipped"], overflow, gnorm,
+             self.state["qres"]) = self._apply_fn(
                 self.state["master"], self.state["opt"], self.state["scale"],
-                self.state["skipped"], self._acc_grads, hp, self._segment_ids)
+                self.state["skipped"], self._acc_grads, hp,
+                self._segment_ids, self.state.get("qres"))
             self._refresh_module_params()
         if self._offload_eager:
             self._state_memory("pinned_host")
@@ -2107,7 +2372,8 @@ class DeepSpeedEngine:
                               self.state["ustep"], self._module_params,
                               packed, spec, hp,
                               self._segment_ids, self._extra_kwargs(),
-                              self.state.get("hostgrad"))
+                              self.state.get("hostgrad"),
+                              self.state.get("qres"))
             else:  # 1-bit compressed program (no hostgrad leg)
                 out = step_fn(self.state["master"], self.state["opt"],
                               self.state["scale"], self.state["skipped"],
@@ -2124,6 +2390,8 @@ class DeepSpeedEngine:
             self._last_sparse_drops = out[9]
         if len(out) > 10:
             self.state["hostgrad"] = out[10]
+        if len(out) > 11:
+            self.state["qres"] = out[11]
         if self.zero_stage < 3:
             self._module_params = new_params
         if self._offload_eager:
@@ -2434,14 +2702,74 @@ class DeepSpeedEngine:
             meta = json.load(f)
 
         opt_npz = np.load(os.path.join(ckpt_dir, OPTIM_STATES_NPZ))
+        # Reduced-precision offload state: checkpoints are canonical
+        # fp32 (+ optional qres/<name> error-feedback residuals) and
+        # load across state-dtype layouts.  Same layout -> raw buffers
+        # restore bit-exactly; any other layout -> residuals fold into
+        # the values, the scatter re-rounds once, and a current-layout
+        # residual re-derives from the exact rounding error.
+        from .zero.qstate import STATE_DTYPES
+
+        ck_layout = meta.get("offload_state_dtype")
+        qres_host = {k[len("qres/"):]: opt_npz[k]
+                     for k in opt_npz.files if k.startswith("qres/")}
+        sd_cur = (self._config.zero_config.offload_state_dtype
+                  if self._state_reduced else None)
+        name2field = {"master": "master", "exp_avg": "momentum",
+                      "exp_avg_sq": "variance"}
+
+        def _layout_match(name):
+            field = name2field.get(name)
+            return (field is not None and ck_layout is not None
+                    and sd_cur is not None
+                    and ck_layout.get("error_feedback")
+                    and sd_cur["error_feedback"]
+                    and ck_layout.get(field) == sd_cur[field]
+                    and name in qres_host)
+
+        def _folded(name, arr):
+            # opt leaf path keys render as ".exp_avg"; qres buffers are
+            # named by the bare field
+            r = qres_host.get(name.lstrip("."))
+            if r is None or _layout_match(name.lstrip(".")):
+                return arr
+            return (np.asarray(arr, np.float32)
+                    + np.asarray(r, np.float32))
+
         with self.mesh:
+            master_arr = _folded("master", opt_npz["master"])
             self.state["master"] = self.flat.scatter_master_from_unpadded(
-                opt_npz["master"])
+                master_arr)
+            opt_host = None
             if load_optimizer_states:
-                opt_host = {k[len("opt/"):]: opt_npz[k]
+                opt_host = {k[len("opt/"):]: _folded(k[len("opt/"):],
+                                                     opt_npz[k])
                             for k in opt_npz.files if k.startswith("opt/")}
                 self.state["opt"] = self._restore_tree_like(
                     self.state["opt"], opt_host)
+            if self.state.get("qres"):
+                opt_host_n = {k.lstrip("."): v
+                              for k, v in (opt_host or {}).items()}
+                new_qres = {}
+                for name, cur in self.state["qres"].items():
+                    st_dt = STATE_DTYPES[sd_cur[name2field[name]]]
+                    if _layout_match(name):
+                        r_arr = np.asarray(qres_host[name], np.float32)
+                    else:
+                        if name == "master":
+                            val = np.asarray(master_arr, np.float32)
+                        elif name in opt_host_n:
+                            val = np.asarray(opt_host_n[name], np.float32)
+                        else:
+                            # leaf state not loaded: reset the residual
+                            new_qres[name] = self._scatter_flat_like(
+                                cur, None)
+                            continue
+                        # exact rounding error of the value scatter above
+                        q = val.astype(np.dtype(st_dt))
+                        r_arr = val - q.astype(np.float32)
+                    new_qres[name] = self._scatter_flat_like(cur, r_arr)
+                self.state["qres"] = new_qres
             self._refresh_module_params()
 
         ss = meta["scale_state"]
@@ -2474,6 +2802,21 @@ class DeepSpeedEngine:
                             checkpoint=ckpt_dir)
         log_dist(f"loaded checkpoint {ckpt_dir}", ranks=[0])
         return ckpt_dir, client_state
+
+    def _scatter_flat_like(self, like, arr):
+        """True-sized 1-D fp32 host array -> a (possibly row-grouped)
+        flat host buffer matching ``like``'s dtype/sharding/layout;
+        ``arr=None`` zero-fills (residual reset)."""
+        if arr is None:
+            padded = np.zeros(self.segments.shape, np.float32)
+        else:
+            padded = self.flat.repad_unpadded(np.asarray(arr).reshape(-1))
+        if type(like) is tuple:
+            return tuple(
+                jax.device_put(padded[r0:r0 + rc].astype(g.dtype),
+                               g.sharding)
+                for (r0, rc), g in zip(self.flat.host_group_bounds, like))
+        return jax.device_put(padded.astype(like.dtype), like.sharding)
 
     def _restore_tree_like(self, tree, host_dict):
         """Place host arrays into a pytree matching ``tree``'s structure and

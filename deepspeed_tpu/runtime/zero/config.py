@@ -94,6 +94,116 @@ class DeepSpeedZeroConfig:
                 f"disables chunking), got {self.offload_chunk_mb!r}")
         self.elastic_checkpoint = get_scalar_param(d, C.ZERO_ELASTIC_CHECKPOINT,
                                                    C.ZERO_ELASTIC_CHECKPOINT_DEFAULT)
+        self.offload_state_dtype = self._parse_state_dtype(
+            d.get(C.ZERO_OFFLOAD_STATE_DTYPE))
+
+    def _parse_state_dtype(self, raw):
+        """``offload_state_dtype`` sub-block -> canonical dict.
+
+        Accepts the shorthand string form (``"bf16"`` ≡ master +
+        momentum + variance all bf16... except master, which stays at
+        the widest 16-bit type: fp16's 5-bit exponent cannot hold
+        master weights, so ``"fp16"`` shorthand reduces only m/v) or
+        the explicit dict form.  All-fp32 (the default) must leave the
+        compiled programs byte-identical to pre-reduced-state builds —
+        the engine treats that case as "no quantization plan at all".
+        """
+        dtypes = ("fp32", "bf16", "fp16")
+        out = {
+            C.ZERO_OFFLOAD_STATE_DTYPE_MASTER:
+                C.ZERO_OFFLOAD_STATE_DTYPE_MASTER_DEFAULT,
+            C.ZERO_OFFLOAD_STATE_DTYPE_MOMENTUM:
+                C.ZERO_OFFLOAD_STATE_DTYPE_MOMENTUM_DEFAULT,
+            C.ZERO_OFFLOAD_STATE_DTYPE_VARIANCE:
+                C.ZERO_OFFLOAD_STATE_DTYPE_VARIANCE_DEFAULT,
+            C.ZERO_OFFLOAD_STATE_DTYPE_ERROR_FEEDBACK:
+                C.ZERO_OFFLOAD_STATE_DTYPE_ERROR_FEEDBACK_DEFAULT,
+            C.ZERO_OFFLOAD_STATE_DTYPE_ROUNDING:
+                C.ZERO_OFFLOAD_STATE_DTYPE_ROUNDING_DEFAULT,
+            C.ZERO_OFFLOAD_STATE_DTYPE_SEED:
+                C.ZERO_OFFLOAD_STATE_DTYPE_SEED_DEFAULT,
+        }
+        if raw is None:
+            return out
+        if isinstance(raw, str):
+            if raw not in dtypes:
+                raise ValueError(
+                    f"offload_state_dtype shorthand must be one of "
+                    f"{dtypes}, got {raw!r}")
+            out[C.ZERO_OFFLOAD_STATE_DTYPE_MOMENTUM] = raw
+            out[C.ZERO_OFFLOAD_STATE_DTYPE_VARIANCE] = raw
+            out[C.ZERO_OFFLOAD_STATE_DTYPE_MASTER] = (
+                "bf16" if raw != "fp32" else "fp32")
+            raw = {}
+        if not isinstance(raw, dict):
+            raise ValueError(
+                f"offload_state_dtype must be a dict or a dtype-name "
+                f"shorthand string, got {raw!r}")
+        for key in (C.ZERO_OFFLOAD_STATE_DTYPE_MASTER,
+                    C.ZERO_OFFLOAD_STATE_DTYPE_MOMENTUM,
+                    C.ZERO_OFFLOAD_STATE_DTYPE_VARIANCE):
+            val = raw.get(key, out[key])
+            if val not in dtypes:
+                raise ValueError(
+                    f"offload_state_dtype.{key} must be one of {dtypes}, "
+                    f"got {val!r}")
+            out[key] = val
+        if out[C.ZERO_OFFLOAD_STATE_DTYPE_MASTER] == "fp16":
+            raise ValueError(
+                "offload_state_dtype.master does not support fp16 (5-bit "
+                "exponent: master weights over/underflow); use bf16")
+        ef = raw.get(C.ZERO_OFFLOAD_STATE_DTYPE_ERROR_FEEDBACK,
+                     out[C.ZERO_OFFLOAD_STATE_DTYPE_ERROR_FEEDBACK])
+        if not isinstance(ef, bool):
+            raise ValueError(
+                f"offload_state_dtype.error_feedback must be a bool, got "
+                f"{ef!r}")
+        out[C.ZERO_OFFLOAD_STATE_DTYPE_ERROR_FEEDBACK] = ef
+        rounding = raw.get(C.ZERO_OFFLOAD_STATE_DTYPE_ROUNDING,
+                           out[C.ZERO_OFFLOAD_STATE_DTYPE_ROUNDING])
+        if rounding not in ("stochastic", "nearest"):
+            raise ValueError(
+                f"offload_state_dtype.rounding must be \"stochastic\" or "
+                f"\"nearest\", got {rounding!r}")
+        out[C.ZERO_OFFLOAD_STATE_DTYPE_ROUNDING] = rounding
+        seed = raw.get(C.ZERO_OFFLOAD_STATE_DTYPE_SEED,
+                       out[C.ZERO_OFFLOAD_STATE_DTYPE_SEED])
+        if isinstance(seed, bool) or not isinstance(seed, int):
+            raise ValueError(
+                f"offload_state_dtype.seed must be an int, got {seed!r}")
+        out[C.ZERO_OFFLOAD_STATE_DTYPE_SEED] = seed
+        reduced = any(
+            out[k] != "fp32" for k in (C.ZERO_OFFLOAD_STATE_DTYPE_MASTER,
+                                       C.ZERO_OFFLOAD_STATE_DTYPE_MOMENTUM,
+                                       C.ZERO_OFFLOAD_STATE_DTYPE_VARIANCE))
+        if reduced and not self.cpu_offload:
+            raise ValueError(
+                "offload_state_dtype with reduced dtypes requires "
+                "cpu_offload: true (it compresses the pinned-host state "
+                "buffers the streamed update reads over the wire)")
+        return out
+
+    @property
+    def offload_state_reduced(self):
+        """True when any host state buffer is stored below fp32."""
+        return any(self.offload_state_dtype[k] != "fp32" for k in (
+            C.ZERO_OFFLOAD_STATE_DTYPE_MASTER,
+            C.ZERO_OFFLOAD_STATE_DTYPE_MOMENTUM,
+            C.ZERO_OFFLOAD_STATE_DTYPE_VARIANCE))
+
+    @property
+    def offload_state_residual_count(self):
+        """Number of persistent error-feedback residual buffers the
+        layout carries (0 unless error_feedback is on) — one extra host
+        buffer FAMILY each, which the coordinator's buffer-count cap
+        must account for."""
+        if not self.offload_state_dtype[
+                C.ZERO_OFFLOAD_STATE_DTYPE_ERROR_FEEDBACK]:
+            return 0
+        return sum(self.offload_state_dtype[k] != "fp32" for k in (
+            C.ZERO_OFFLOAD_STATE_DTYPE_MASTER,
+            C.ZERO_OFFLOAD_STATE_DTYPE_MOMENTUM,
+            C.ZERO_OFFLOAD_STATE_DTYPE_VARIANCE))
 
     def repr(self):
         return dict(stage=self.stage,
@@ -106,6 +216,7 @@ class DeepSpeedZeroConfig:
                     offload_chunk_mb=self.offload_chunk_mb,
                     offload_gradients=self.offload_gradients,
                     offload_uniform_chunks=self.offload_uniform_chunks,
+                    offload_state_dtype=self.offload_state_dtype,
                     elastic_checkpoint=self.elastic_checkpoint)
 
     def __repr__(self):

@@ -58,6 +58,48 @@ from .stream import UNIFORM_MIN_CHUNKS
 # any state big enough to stream should split into at least two groups.
 HOST_GROUP_BYTES = 1792 << 20
 
+# Per-buffer hard bound with margin below the measured 4.92–5.53 GB
+# SIGABRT wall (see HOST_GROUP_BYTES note above).
+HOST_GROUP_BYTES_MAX = 3584 << 20
+
+# Total host-buffer COUNT bound: the remote AOT compile helper crashes
+# on the 16-buffer gpt2-xl + offload_gradients program (4 families ×
+# 4 groups at 1792 MB) and compiles its 8-buffer form (4 × 2 at
+# 3584 MB) — round-5 receipt, PERF.md "ZeRO-Offload capacity".  The
+# group layout is auto-derived to stay at or under this count; the
+# manual offload_group_mb override remains as the escape hatch.
+MAX_HOST_BUFFERS = 8
+
+
+def derive_group_bytes(total_bytes, families):
+    """Auto host-group size: smallest group layout that (a) keeps at
+    least two groups for round-robin transfer/compute overlap when the
+    state streams at all (the HOST_GROUP_BYTES calibration), and (b)
+    caps the TOTAL buffer count — ``families`` host-buffer families
+    (master + flat optimizer leaves [+ gradients] [+ error-feedback
+    residuals]) × group count — at :data:`MAX_HOST_BUFFERS`, the
+    observed AOT-crash mode.  When both are impossible (state too big
+    for the per-buffer SIGABRT bound), the per-buffer bound wins and
+    the count cap is reported loudly."""
+    per_family = max(1, MAX_HOST_BUFFERS // max(1, families))
+    need = -(-int(total_bytes) // per_family)
+    out = max(HOST_GROUP_BYTES, need)
+    if out > HOST_GROUP_BYTES_MAX:
+        from ...utils.logging import logger
+
+        logger.warning(
+            "offload host-group layout: %d buffer families over %.2f GB "
+            "of state cannot fit %d total host buffers under the %.2f GB "
+            "per-buffer toolchain bound; capping group size at the "
+            "per-buffer bound (%d buffers total) — expect AOT-helper "
+            "instability past %d buffers",
+            families, total_bytes / 2**30, MAX_HOST_BUFFERS,
+            HOST_GROUP_BYTES_MAX / 2**30,
+            families * -(-int(total_bytes) // HOST_GROUP_BYTES_MAX),
+            MAX_HOST_BUFFERS)
+        out = HOST_GROUP_BYTES_MAX
+    return out
+
 
 def split_rows_balanced(total_rows, rows_per, align):
     """Near-equal contiguous (start, count) groups, each at most
@@ -102,10 +144,20 @@ class FlatParamCoordinator:
     def __init__(self, mesh, params_template, stage, dp_size,
                  cpu_offload=False, group_bytes=None,
                  uniform_chunk_rows=None,
-                 uniform_min_chunks=UNIFORM_MIN_CHUNKS):
+                 uniform_min_chunks=UNIFORM_MIN_CHUNKS,
+                 host_families=3, master_dtype=None):
         self.mesh = mesh
         self.stage = stage
         self.dp_size = dp_size
+        # how many host-buffer FAMILIES share this row-group layout
+        # (master + flat optimizer leaves + optional gradient buffer +
+        # optional error-feedback residuals) — the auto group size caps
+        # families x groups at MAX_HOST_BUFFERS (AOT crash mode)
+        self.host_families = int(host_families)
+        # storage dtype of the flat master in host memory (reduced-
+        # precision offload state, zero/qstate.py); checkpoints stay
+        # canonical fp32 regardless (gather upcasts, scatter downcasts)
+        self.master_dtype = master_dtype or jnp.float32
 
         leaves = jax.tree_util.tree_leaves(params_template)
         sizes = [int(np.prod(x.shape)) for x in leaves]
@@ -187,8 +239,14 @@ class FlatParamCoordinator:
         # toolchain limit (see HOST_GROUP_BYTES); None = single buffer
         self.host_group_bounds = None
         if cpu_offload and self.injit_placement:
-            rows_per = max(1, (group_bytes or HOST_GROUP_BYTES)
-                           // (LANES * 4))
+            # byte accounting stays at fp32 rows even under reduced
+            # storage dtypes: the fp32 families (gradients, any fp32
+            # state buffer) set the worst-case per-buffer size, and a
+            # conservative bound can only produce more (smaller) groups
+            if group_bytes is None:
+                group_bytes = derive_group_bytes(
+                    self.segments.rows * LANES * 4, self.host_families)
+            rows_per = max(1, group_bytes // (LANES * 4))
             if self.segments.rows > rows_per:
                 self.host_group_bounds = split_rows_balanced(
                     self.segments.rows, rows_per, pad_to)
@@ -250,7 +308,13 @@ class FlatParamCoordinator:
                                                               hi - start]
             del arr
         groups = []
+        np_master = np.dtype(self.master_dtype)
         for buf in bufs:
+            if buf.dtype != np_master:
+                # reduced master storage: nearest downcast at init (both
+                # write-back mechanisms start from the same rounded
+                # point; residuals, when enabled, zero-init)
+                buf = buf.astype(np_master)
             groups.append(jax.device_put(buf, self.master_sharding))
             groups[-1].block_until_ready()
         del bufs, flat_views
@@ -260,13 +324,18 @@ class FlatParamCoordinator:
 
     def gather_master_unpadded(self, master) -> np.ndarray:
         """Concatenated true-sized 1-D host copy (checkpoint format).
-        Accepts the row-group tuple form (grouped offload state)."""
+        Accepts the row-group tuple form (grouped offload state).
+        Always fp32: reduced-dtype storage upcasts exactly, so the
+        checkpoint format stays canonical across state-dtype layouts."""
+        def _up(g):
+            arr = np.asarray(jax.device_get(g))
+            return arr if arr.dtype == np.float32 else arr.astype(np.float32)
+
         if type(master) is tuple:  # row-group form (NamedTuples are pytree nodes)
-            host = np.concatenate(
-                [np.asarray(jax.device_get(g)) for g in master],
-                axis=0).reshape(-1)
+            host = np.concatenate([_up(g) for g in master],
+                                  axis=0).reshape(-1)
         else:
-            host = np.asarray(jax.device_get(master)).reshape(-1)
+            host = _up(master).reshape(-1)
         parts = []
         for ro, n in zip(self.segments.row_offsets, self.segments.sizes):
             start = ro * LANES
@@ -287,6 +356,14 @@ class FlatParamCoordinator:
 
     def scatter_master_from_unpadded(self, arr: np.ndarray):
         padded = self.repad_unpadded(arr)
+        np_master = np.dtype(self.master_dtype)
+        if padded.dtype != np_master:
+            # reduced master layout: nearest downcast — exact when the
+            # checkpoint came from the same layout (stored values are
+            # already representable); cross-dtype loads round once (the
+            # engine captures the rounding error into the error-feedback
+            # residual when that mechanism is on)
+            padded = padded.astype(np_master)
         if self.host_group_bounds is not None:
             return tuple(jax.device_put(padded[r0:r0 + rc],
                                         self.master_sharding)

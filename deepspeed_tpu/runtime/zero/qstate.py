@@ -1,0 +1,263 @@
+"""Reduced-precision host optimizer state for streamed ZeRO-Offload.
+
+The 0.77B offload tax is wire-bound by construction: 18.6 GB of fp32
+(p, m, v) round-trips over PCIe at ~14 GB/s every step — a ~1.33 s
+floor no amount of streaming overlap can beat (PERF.md "ZeRO-Offload
+wire bytes").  The reference sidesteps the wire by computing the update
+ON the host (``csrc/adam/cpu_adam.cpp`` across many AVX cores); this
+attachment has one CPU core, so the TPU-native fix is moving FEWER
+bytes: store the pinned-host ``(rows, LANES)`` state buffers in
+bf16/fp16, upcast to fp32 on device inside the existing chunk-streamed
+update, compute the Adam step in fp32 exactly as today, and downcast on
+write-back with a mechanism that stops quantization error accumulating
+across steps:
+
+- **stochastic rounding** (default): the downcast rounds up/down with
+  probability proportional to the distance to each neighbor, so the
+  write-back is unbiased and sub-ulp updates survive IN EXPECTATION —
+  the Gopher/Habana recipe for bf16 master weights.  Zero extra bytes:
+  all-bf16 (p, m, v) state moves exactly HALF the fp32 wire bytes.
+- **error feedback** (``error_feedback: true``): a persistent residual
+  buffer per reduced buffer carries the exact rounding error to the
+  next step (store ``q = cast(y)``, ``r = y - q``; load ``y ≈ up(q) +
+  up(r)``) — deterministic, effectively ~16 mantissa bits, the 1-bit
+  Adam mechanism applied at 16-bit granularity.  The residuals live in
+  pinned host memory, ride the same chunk stream, and are carried by
+  checkpoints; they cost their own wire bytes (an all-bf16 + residuals
+  layout moves 2/3 of fp32, not 1/2), which is why stochastic rounding
+  is the default mechanism.
+
+Plain nearest rounding with both mechanisms off (``rounding:
+"nearest"``, ``error_feedback: false``) is deliberately reachable as a
+control: sub-ulp updates are then silently dropped every step (bf16's
+8 mantissa bits lose Adam's ``(1-beta2) = 1e-3``-scale variance
+increments entirely), and the drift test in
+``tests/unit/test_offload_state_dtype.py`` pins that failure mode —
+proving the mechanism, not the dtype, is load-bearing.
+
+Everything here is placement-agnostic pure functions on traced arrays;
+the engine composes them into both streamed update forms (the unrolled
+round-robin chunks and the ``lax.scan`` core in ``stream.py``).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# canonical config names -> jnp storage dtypes
+STATE_DTYPES = {
+    "fp32": jnp.float32, "float32": jnp.float32,
+    "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+    "fp16": jnp.float16, "float16": jnp.float16,
+}
+
+ROUNDING_NEAREST = "nearest"
+ROUNDING_STOCHASTIC = "stochastic"
+
+
+def up32(x):
+    """Storage -> fp32 compute (exact for bf16/fp16 sources)."""
+    return x.astype(jnp.float32)
+
+
+def stochastic_round(x, dtype, key):
+    """fp32 -> ``dtype`` with stochastic rounding.
+
+    Bit-trick form: add uniform random bits below the target mantissa to
+    the fp32 bit pattern, then truncate — for sign-magnitude floats the
+    carry rounds magnitude up with exactly the right probability.
+    Non-finite inputs bypass the add (random bits would walk an inf
+    pattern into the NaN space) and convert with ordinary ``astype``.
+    """
+    x = x.astype(jnp.float32)
+    bits = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    if dtype == jnp.bfloat16:
+        rnd = jax.random.bits(key, x.shape, jnp.uint32) & jnp.uint32(0xFFFF)
+        q = jax.lax.bitcast_convert_type(
+            ((bits + rnd) >> 16).astype(jnp.uint16), jnp.bfloat16)
+    elif dtype == jnp.float16:
+        # SR in "fp32 with a 10-bit mantissa" space, then an exact-ish
+        # astype (denormal/overflow handling stays numpy-conformant)
+        rnd = jax.random.bits(key, x.shape, jnp.uint32) & jnp.uint32(0x1FFF)
+        trunc = (bits + rnd) & jnp.uint32(0xFFFFE000)
+        q = jax.lax.bitcast_convert_type(trunc, jnp.float32).astype(
+            jnp.float16)
+    else:
+        return x.astype(dtype)
+    return jnp.where(jnp.isfinite(x), q, x.astype(dtype))
+
+
+def ef_store(x32, dtype):
+    """fp32 -> (nearest-rounded ``dtype`` value, residual in ``dtype``).
+
+    The residual is the exact rounding error; storing it in the same
+    16-bit dtype keeps ~8 further mantissa bits (second-order error
+    decays geometrically), so ``up(q) + up(r)`` is fp32-grade."""
+    q = x32.astype(dtype)
+    r = (x32 - up32(q)).astype(dtype)
+    return q, r
+
+
+class StateQuant:
+    """Storage-dtype plan for the streamed offload update.
+
+    Built by :func:`build_state_quant` only when at least one buffer is
+    reduced — a ``None`` quant plan leaves every streamed-update program
+    byte-identical to the fp32-only form (the default-path contract).
+
+    Attributes consumed by the engine / ``stream.py``:
+
+    - ``master_dtype`` — storage dtype of the flat fp32 master.
+    - ``leaf_dtypes`` — per-flattened-optimizer-leaf storage dtype
+      (``None`` for non-flat/scalar leaves), aligned with
+      ``tree_leaves`` order.
+    - ``error_feedback`` / ``rounding`` — the write-back mechanism.
+    - ``res_master`` / ``res_leaf_lis`` — which buffers carry persistent
+      residuals (master flag + leaf indices).
+    - ``step_scalar_idx`` — index of the optimizer step counter among
+      the non-flat leaves (the SR stream is keyed per optimizer step so
+      rounding directions decorrelate across steps).
+    """
+
+    def __init__(self, master_dtype, leaf_dtypes, leaf_names,
+                 error_feedback, rounding, seed, step_scalar_idx,
+                 prng_impl=None):
+        self.master_dtype = master_dtype
+        self.leaf_dtypes = tuple(leaf_dtypes)
+        self.leaf_names = tuple(leaf_names)
+        self.error_feedback = bool(error_feedback)
+        self.rounding = rounding
+        self.seed = int(seed)
+        self.step_scalar_idx = int(step_scalar_idx)
+        self.res_master = self.error_feedback and master_dtype != jnp.float32
+        self.res_leaf_lis = tuple(
+            li for li, dt in enumerate(self.leaf_dtypes)
+            if self.error_feedback and dt is not None
+            and dt != jnp.float32)
+        self._key0 = None
+        if rounding == ROUNDING_STOCHASTIC and not self.error_feedback:
+            # typed key: the impl (rbg on TPU — near-free bits; threefry
+            # elsewhere — deterministic CPU tests) rides in the dtype
+            self._key0 = (jax.random.key(self.seed, impl=prng_impl)
+                          if prng_impl else jax.random.PRNGKey(self.seed))
+
+    @property
+    def reduced_names(self):
+        out = []
+        if self.master_dtype != jnp.float32:
+            out.append("master")
+        out.extend(n for li, (n, dt) in enumerate(
+            zip(self.leaf_names, self.leaf_dtypes))
+            if dt is not None and dt != jnp.float32)
+        return out
+
+    def residual_names(self):
+        """Buffer names carrying persistent error-feedback residuals."""
+        out = []
+        if self.res_master:
+            out.append("master")
+        out.extend(self.leaf_names[li] for li in self.res_leaf_lis)
+        return out
+
+    # -- traced helpers -------------------------------------------------
+    def chunk_key(self, step_scalar, tag):
+        """SR key for one (optimizer step, chunk-or-buffer tag) pair."""
+        k = jax.random.fold_in(self._key0, step_scalar.astype(jnp.uint32))
+        return jax.random.fold_in(k, tag)
+
+    def load(self, q, res=None):
+        """Storage chunk (+ optional residual chunk) -> fp32 chunk."""
+        if q.dtype == jnp.float32:
+            return q
+        y = up32(q)
+        if res is not None:
+            y = y + up32(res)
+        return y
+
+    def store(self, x32, dtype, key=None, tag=None, step=None):
+        """fp32 chunk -> (storage chunk, residual chunk or None)."""
+        if dtype == jnp.float32:
+            return x32, None
+        if self.error_feedback:
+            return ef_store(x32, dtype)
+        if self.rounding == ROUNDING_STOCHASTIC:
+            if key is None:
+                key = self.chunk_key(step, tag)
+            return stochastic_round(x32, dtype, key), None
+        return x32.astype(dtype), None
+
+
+def build_state_quant(state_dtype_cfg, opt_shape, prng_impl=None):
+    """Resolve the ``offload_state_dtype`` config block against a flat
+    optimizer's state shape -> :class:`StateQuant`, or ``None`` when
+    everything is fp32 (the byte-identical default path).
+
+    ``opt_shape`` is the ``jax.eval_shape`` of ``optimizer.init_state``
+    on the flat master: 2-D leaves are row buffers that stream, scalars
+    (the step counter) replicate.  Leaf names come from the tree paths,
+    so ``exp_avg``/``exp_avg_sq`` map to ``momentum``/``variance``
+    regardless of field order.
+    """
+    cfg = state_dtype_cfg or {}
+    m_dt = STATE_DTYPES[cfg.get("master", "fp32")]
+    mom_dt = STATE_DTYPES[cfg.get("momentum", "fp32")]
+    var_dt = STATE_DTYPES[cfg.get("variance", "fp32")]
+    if m_dt == mom_dt == var_dt == jnp.float32:
+        return None
+
+    from ..utils import tree_path_key
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(opt_shape)
+    by_name = {"exp_avg": mom_dt, "exp_avg_sq": var_dt}
+    leaf_dtypes, leaf_names, scalar_names = [], [], []
+    for path, leaf in flat:
+        # NamedTuple attr paths render as ".exp_avg" — strip to the
+        # bare field name the config keys map against
+        name = tree_path_key(path).lstrip(".")
+        leaf_names.append(name)
+        if getattr(leaf, "ndim", 0) == 2:
+            leaf_dtypes.append(by_name.get(name, jnp.float32))
+        else:
+            leaf_dtypes.append(None)
+            scalar_names.append(name)
+    step_idx = scalar_names.index("step") if "step" in scalar_names else 0
+    return StateQuant(
+        master_dtype=m_dt, leaf_dtypes=leaf_dtypes, leaf_names=leaf_names,
+        error_feedback=bool(cfg.get("error_feedback", False)),
+        rounding=cfg.get("rounding", ROUNDING_STOCHASTIC),
+        seed=int(cfg.get("seed", 0)), step_scalar_idx=step_idx,
+        prng_impl=prng_impl)
+
+
+def np_dtype(dt):
+    """jnp storage dtype -> numpy dtype usable for host staging buffers
+    (bf16 resolves through ml_dtypes, which jax guarantees)."""
+    return np.dtype(dt)
+
+
+def host_state_bytes_per_step(rows, lanes, quant, n_flat_leaves=2,
+                              master_included=True):
+    """Wire bytes one optimizer step moves for the host state buffers:
+    each streamed buffer (master + flat optimizer leaves + residuals)
+    crosses the PCIe wire DOWN (load) and UP (write-back) exactly once.
+
+    ``quant=None`` means the fp32 layout.  Gradients
+    (``offload_gradients``) and the leaf-direct param-cast re-read are
+    accounted separately — this is the optimizer-state figure PERF.md's
+    wire table quotes."""
+    elems = rows * lanes
+    if quant is None:
+        per_buf = [4] * (int(master_included) + n_flat_leaves)
+    else:
+        per_buf = []
+        if master_included:
+            per_buf.append(np_dtype(quant.master_dtype).itemsize)
+            if quant.res_master:
+                per_buf.append(np_dtype(quant.master_dtype).itemsize)
+        for li, dt in enumerate(quant.leaf_dtypes):
+            if dt is None:
+                continue
+            per_buf.append(np_dtype(dt).itemsize)
+            if li in quant.res_leaf_lis:
+                per_buf.append(np_dtype(dt).itemsize)
+    return 2 * elems * sum(per_buf)

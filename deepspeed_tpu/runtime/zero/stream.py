@@ -104,7 +104,8 @@ def uniform_geometry_ok(group_bounds, chunk_rows):
 def uniform_scan_update(*, masters, group_leaves, is_flat, opt_treedef,
                         update_fn, hp, overflow, skip_bad, jobs, chunk_rows,
                         lanes, g=None, g_groups=None, coef=None,
-                        to_dev=None, to_host=None):
+                        to_dev=None, to_host=None,
+                        quant=None, res_masters=None, res_group_leaves=None):
     """Scan the uniform-chunk offload update over ``jobs``.
 
     Args:
@@ -126,9 +127,23 @@ def uniform_scan_update(*, masters, group_leaves, is_flat, opt_treedef,
         ``coef`` then folds unscale+clip into one per-chunk multiply.
       to_dev / to_host: placement callables (device_put into the
         engine's shardings; identity under test).
+      quant: optional ``zero.qstate.StateQuant`` — reduced-precision
+        host storage.  Chunks load in their storage dtype, upcast to
+        fp32 (folding the error-feedback residual when present), update
+        in fp32 exactly as the plain path, and downcast on write-back
+        (stochastic rounding keyed by (optimizer step, job index), or
+        nearest + fresh residual).  ``None`` leaves this function's
+        traced program BYTE-IDENTICAL to the fp32-only form — the
+        residual placeholders below are empty pytrees contributing no
+        ops and no scan inputs.
+      res_masters / res_group_leaves: per-group residual buffers for
+        the master and for the reduced flat leaves (aligned with
+        ``quant.res_leaf_lis``); only with ``quant.error_feedback``.
 
-    Returns ``(new_masters, new_group_leaves, new_scalars)`` with the
-    same group structure as the inputs.
+    Returns ``(new_masters, new_group_leaves, new_scalars[,
+    new_res_masters, new_res_group_leaves])`` with the same group
+    structure as the inputs (the residual tails only when ``quant``
+    carries residuals).
     """
     if to_dev is None:
         to_dev = lambda x: x
@@ -143,13 +158,29 @@ def uniform_scan_update(*, masters, group_leaves, is_flat, opt_treedef,
     flat_pos = [li for li, f in enumerate(is_flat) if f]
     scalars0 = [l for l, f in zip(group_leaves[0], is_flat) if not f]
 
+    has_resm = quant is not None and res_masters is not None
+    n_resf = (len(res_group_leaves[0])
+              if quant is not None and res_group_leaves else 0)
+    # flat-leaf slot (fi, counting only is_flat leaves) -> residual slot
+    res_slot_by_fi = {}
+    if quant is not None:
+        for k, li in enumerate(quant.res_leaf_lis):
+            res_slot_by_fi[flat_pos.index(li)] = k
+    sr_keys = quant is not None and quant._key0 is not None
+
     gi_arr = jnp.asarray([j[0] for j in jobs], jnp.int32)
     r0_arr = jnp.asarray([j[1] for j in jobs], jnp.int32)
     abs_arr = jnp.asarray([j[2] for j in jobs], jnp.int32)
+    xs = (gi_arr, r0_arr, abs_arr)
+    if sr_keys:
+        xs = xs + (jnp.arange(len(jobs), dtype=jnp.uint32),)
 
-    def body(carry, xs):
-        masters_c, flats_c, _ = carry
-        gi, r0, r0a = xs
+    def body(carry, xs_c):
+        masters_c, flats_c, _, resm_c, resf_c = carry
+        if sr_keys:
+            gi, r0, r0a, jid = xs_c
+        else:
+            gi, r0, r0a = xs_c
 
         def read(i):
             def branch(r):
@@ -158,20 +189,38 @@ def uniform_scan_update(*, masters, group_leaves, is_flat, opt_treedef,
                 fl = tuple(jax.lax.dynamic_slice(
                     flats_c[i][k], (r, 0), (chunk_rows, lanes))
                     for k in range(len(flat_pos)))
+                rm = ((jax.lax.dynamic_slice(
+                    resm_c[i], (r, 0), (chunk_rows, lanes)),)
+                    if has_resm else ())
+                rf = tuple(jax.lax.dynamic_slice(
+                    resf_c[i][k], (r, 0), (chunk_rows, lanes))
+                    for k in range(n_resf))
                 if g_on_host:
                     gg = jax.lax.dynamic_slice(
                         g_groups[i], (r, 0), (chunk_rows, lanes))
-                    return pm, fl, gg
-                return pm, fl
+                    return pm, fl, rm, rf, gg
+                return pm, fl, rm, rf
             return branch
 
         got = jax.lax.switch(gi, [read(i) for i in range(n_g)], r0)
-        pm = to_dev(got[0])
-        chunk_flat = [to_dev(x) for x in got[1]]
+        pm_q = to_dev(got[0])
+        chunk_flat_q = [to_dev(x) for x in got[1]]
+        rm_q = tuple(to_dev(x) for x in got[2])
+        rf_q = tuple(to_dev(x) for x in got[3])
         if g_on_host:
-            gc = to_dev(got[2]) * coef
+            gc = to_dev(got[4]) * coef
         else:
             gc = jax.lax.dynamic_slice(g, (r0a, 0), (chunk_rows, lanes))
+
+        if quant is None:
+            pm = pm_q
+            chunk_flat = chunk_flat_q
+        else:
+            pm = quant.load(pm_q, rm_q[0] if rm_q else None)
+            chunk_flat = [
+                quant.load(cq, rf_q[res_slot_by_fi[fi]]
+                           if fi in res_slot_by_fi else None)
+                for fi, cq in enumerate(chunk_flat_q)]
 
         leaves, it_f, it_s = [], iter(chunk_flat), iter(scalars0)
         for f in is_flat:
@@ -179,15 +228,52 @@ def uniform_scan_update(*, masters, group_leaves, is_flat, opt_treedef,
         st = jax.tree_util.tree_unflatten(opt_treedef, leaves)
         new_p, new_st = update_fn(st, pm, gc, hp)
         new_leaves = jax.tree_util.tree_leaves(new_st)
-        if skip_bad:
-            new_p = jnp.where(overflow, pm, new_p)
-        new_p_h = to_host(new_p)
+
+        key_base = None
+        if sr_keys:
+            scalar_vals = [new_leaves[li] for li, f in enumerate(is_flat)
+                           if not f]
+            key_base = quant.chunk_key(
+                scalar_vals[quant.step_scalar_idx], jid)
+
+        if quant is None:
+            if skip_bad:
+                new_p = jnp.where(overflow, pm, new_p)
+            new_p_h = to_host(new_p)
+            new_rm_h, new_rf_h = (), {}
+        else:
+            q_p, r_p = quant.store(
+                new_p, quant.master_dtype,
+                key=(jax.random.fold_in(key_base, 0) if sr_keys
+                     and quant.master_dtype != jnp.float32 else None))
+            if skip_bad:
+                q_p = jnp.where(overflow, pm_q, q_p)
+                if r_p is not None:
+                    r_p = jnp.where(overflow, rm_q[0], r_p)
+            new_p_h = to_host(q_p)
+            new_rm_h = (to_host(r_p),) if has_resm else ()
+            new_rf_h = {}
         new_flat_h, new_scalars, fi = [], [], 0
         for li, f in enumerate(is_flat):
             if f:
                 nl = new_leaves[li]
-                if skip_bad:
-                    nl = jnp.where(overflow, chunk_flat[fi], nl)
+                if quant is None:
+                    if skip_bad:
+                        nl = jnp.where(overflow, chunk_flat[fi], nl)
+                else:
+                    q_l, r_l = quant.store(
+                        nl, quant.leaf_dtypes[li],
+                        key=(jax.random.fold_in(key_base, 1 + fi)
+                             if sr_keys and quant.leaf_dtypes[li]
+                             != jnp.float32 else None))
+                    if skip_bad:
+                        q_l = jnp.where(overflow, chunk_flat_q[fi], q_l)
+                    if fi in res_slot_by_fi:
+                        if skip_bad:
+                            r_l = jnp.where(overflow,
+                                            rf_q[res_slot_by_fi[fi]], r_l)
+                        new_rf_h[res_slot_by_fi[fi]] = to_host(r_l)
+                    nl = q_l
                 new_flat_h.append(to_host(nl))
                 fi += 1
             else:
@@ -195,10 +281,11 @@ def uniform_scan_update(*, masters, group_leaves, is_flat, opt_treedef,
                 if skip_bad:
                     ns = jnp.where(overflow, scalars0[len(new_scalars)], ns)
                 new_scalars.append(ns)
+        new_rf_h = tuple(new_rf_h[k] for k in range(n_resf))
 
         def write(i):
             def branch(args):
-                r, pm_h, fl_h = args
+                r, pm_h, fl_h, rm_h, rf_h = args
                 ms = tuple(
                     jax.lax.dynamic_update_slice(m, pm_h, (r, 0))
                     if j == i else m for j, m in enumerate(masters_c))
@@ -208,21 +295,35 @@ def uniform_scan_update(*, masters, group_leaves, is_flat, opt_treedef,
                         if j == i else flats_c[j][k]
                         for k in range(len(flat_pos)))
                     for j in range(n_g))
-                return ms, fls
+                rms = tuple(
+                    jax.lax.dynamic_update_slice(m, rm_h[0], (r, 0))
+                    if j == i else m
+                    for j, m in enumerate(resm_c)) if has_resm else ()
+                rfs = tuple(
+                    tuple(jax.lax.dynamic_update_slice(
+                        resf_c[j][k], rf_h[k], (r, 0))
+                        if j == i else resf_c[j][k]
+                        for k in range(n_resf))
+                    for j in range(n_g)) if n_resf else ()
+                return ms, fls, rms, rfs
             return branch
 
-        masters_n, flats_n = jax.lax.switch(
+        masters_n, flats_n, resm_n, resf_n = jax.lax.switch(
             gi, [write(i) for i in range(n_g)],
-            (r0, new_p_h, tuple(new_flat_h)))
-        return (masters_n, flats_n, tuple(new_scalars)), None
+            (r0, new_p_h, tuple(new_flat_h), new_rm_h, new_rf_h))
+        return (masters_n, flats_n, tuple(new_scalars), resm_n,
+                resf_n), None
 
     flats0 = tuple(tuple(group_leaves[gi][li] for li in flat_pos)
                    for gi in range(n_g))
+    resm0 = tuple(res_masters) if has_resm else ()
+    resf0 = (tuple(tuple(res_group_leaves[gi][k] for k in range(n_resf))
+                   for gi in range(n_g)) if n_resf else ())
     # scalar carry slot: pre-seeded with the originals so an (impossible)
     # empty job list degrades to "no update" rather than garbage
-    carry0 = (tuple(masters), flats0, tuple(scalars0))
-    (masters_n, flats_n, scalars_n), _ = jax.lax.scan(
-        body, carry0, (gi_arr, r0_arr, abs_arr))
+    carry0 = (tuple(masters), flats0, tuple(scalars0), resm0, resf0)
+    (masters_n, flats_n, scalars_n, resm_n, resf_n), _ = jax.lax.scan(
+        body, carry0, xs)
 
     new_group_leaves = []
     for gi in range(n_g):
@@ -235,4 +336,8 @@ def uniform_scan_update(*, masters, group_leaves, is_flat, opt_treedef,
                 out.append(scalars_n[si])
                 si += 1
         new_group_leaves.append(out)
+    if has_resm or n_resf:
+        return (list(masters_n), new_group_leaves, list(scalars_n),
+                list(resm_n) if has_resm else None,
+                [list(rg) for rg in resf_n] if n_resf else None)
     return list(masters_n), new_group_leaves, list(scalars_n)

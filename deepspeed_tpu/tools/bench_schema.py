@@ -1,0 +1,112 @@
+"""Schema of the driver-bench JSON record (``bench.py``'s one line).
+
+The standing measurement rule (ROADMAP) is that every README/PERF
+headline quotes a driver artifact — which only works if the artifact's
+fields are stable and auditable.  This module is the registry: every
+field ``bench.py`` may emit, its type, and its unit, plus
+:func:`validate_record` which the bench runs over its record before
+printing (fail-soft: schema drift is reported to stderr, never allowed
+to lose a measured record).
+
+Two field families are pattern-based rather than enumerated:
+
+- ``offload_<row>_*`` — one group per offload bench row (``gpt2_large``,
+  ``gpt2_large_bf16``, ``gpt2_xl``, ...).  Since round 6 every row
+  carries ``host_state_dtype`` and ``host_state_bytes_per_step`` so the
+  reduced-precision wire-bytes claim is checkable from the JSON alone.
+- ``*_exc`` / ``*_error`` — per-row failure strings (a secondary row
+  failure must never lose the validated primary metric).
+"""
+
+import numbers
+import re
+
+# exact field name -> (type, unit/notes)
+FIELDS = {
+    "metric": (str, "primary metric name"),
+    "value": (numbers.Real, "samples/s"),
+    "unit": (str, "unit of value"),
+    "vs_baseline": (numbers.Real, "ratio vs reference V100 baseline"),
+    "model_tflops_per_sec": (numbers.Real, "TFLOP/s"),
+    "mfu": (numbers.Real, "model-FLOPs utilisation, 0..1"),
+    "chip_peak_tflops": (numbers.Real, "bf16 peak TFLOP/s"),
+    "loss": (numbers.Real, "final step loss"),
+    "batch": (numbers.Integral, "primary row batch size"),
+    "dropout": (numbers.Real, "dropout probability"),
+    "device": (str, "device_kind"),
+    "error": (str, "primary-metric failure"),
+    "seq512_batch": (numbers.Integral, ""),
+    "seq512_samples_per_sec": (numbers.Real, "samples/s"),
+    "seq512_vs_baseline": (numbers.Real, ""),
+    "seq512_mfu": (numbers.Real, ""),
+    "gpt2_medium_seq1024_samples_per_sec": (numbers.Real, "samples/s"),
+    "gpt2_medium_tokens_per_sec": (numbers.Real, "tokens/s"),
+    "gpt2_mfu": (numbers.Real, ""),
+    "gpt2_batch": (numbers.Integral, ""),
+    "sparse_attn_seq": (numbers.Integral, "sequence length"),
+    "sparse_attn_dense_ms": (numbers.Real, "ms, min of repeats"),
+    "sparse_attn_sparse_ms": (numbers.Real, "ms, min of repeats"),
+    "sparse_attn_speedup_vs_dense": (numbers.Real, "ratio"),
+    "sparse_attn_repeats": (numbers.Integral,
+                            "interleaved timing repeats (min-aggregated)"),
+    "offload_xl_note": (str, ""),
+    "compile_cache_hits": (numbers.Integral, ""),
+    "compile_cache_misses": (numbers.Integral, ""),
+    "compile_seconds_cold": (numbers.Real, "s, cache-miss compile wall"),
+    "compile_seconds_warm": (numbers.Real, "s, cache-hit retrieval wall"),
+    "compile_programs": (numbers.Integral, ""),
+    "compile_cache_dir": (str, ""),
+}
+
+# offload row fields: offload_<row>_<field>
+_OFFLOAD_ROW_FIELDS = {
+    "ms_per_step": numbers.Real,
+    "params_b": numbers.Real,
+    # reduced-precision receipts (round 6): storage dtype and the wire
+    # bytes one update moves for host state — "bf16 ≈ half the fp32
+    # row" is asserted against these, not prose
+    "host_state_dtype": str,
+    "host_state_bytes_per_step": numbers.Integral,
+    "host_groups": numbers.Integral,
+    "error": str,
+    "note": str,
+}
+_OFFLOAD_RE = re.compile(
+    r"^offload_(?P<row>[a-z0-9_]+?)_(?P<field>%s)$"
+    % "|".join(sorted(_OFFLOAD_ROW_FIELDS, key=len, reverse=True)))
+# per-row failure strings: `<row>_exc` (guarded-retry exceptions) and
+# `<row>_error` (invalid-measurement reports, e.g. gpt2_error,
+# seq512_error) — both carry prose, never metrics
+_EXC_RE = re.compile(r"^[a-z0-9_]+_(exc|error)$")
+
+
+def field_type(key):
+    """Expected python type for a record key, or None if unknown."""
+    if key in FIELDS:
+        return FIELDS[key][0]
+    m = _OFFLOAD_RE.match(key)
+    if m:
+        return _OFFLOAD_ROW_FIELDS[m.group("field")]
+    if _EXC_RE.match(key):
+        return str
+    return None
+
+
+def validate_record(record):
+    """Return a list of problem strings (empty = schema-clean).
+
+    Booleans are rejected where numbers are expected (bool is an int
+    subclass — a True smuggled into a metric field is a bug)."""
+    problems = []
+    for key, value in record.items():
+        want = field_type(key)
+        if want is None:
+            problems.append(f"unknown bench field {key!r}")
+            continue
+        ok = isinstance(value, want) and not (
+            want is not str and isinstance(value, bool))
+        if not ok:
+            problems.append(
+                f"bench field {key!r} expected {want.__name__}, got "
+                f"{type(value).__name__} ({value!r})")
+    return problems

@@ -62,11 +62,17 @@ class KeyInfo(NamedTuple):
 class ConfigSchema(NamedTuple):
     top_level: Dict[str, KeyInfo]
     sections: Dict[str, Dict[str, KeyInfo]]
+    # one-level-nested sub-blocks: (section, sub-block key) -> sub-keys
+    # (e.g. zero_optimization.offload_state_dtype.{master,momentum,...});
+    # None (not a shared mutable {}) when constructed without it
+    nested: Optional[Dict] = None
 
     def all_keys(self) -> Dict[str, KeyInfo]:
         out = dict(self.top_level)
         for sec in self.sections.values():
             out.update(sec)
+        for sub in (self.nested or {}).values():
+            out.update(sub)
         return out
 
 
@@ -117,6 +123,16 @@ _SECTION_PREFIXES = (
     ("COMPILATION_", "compilation"),
     ("ACT_CHKPT_", "activation_checkpointing"),
     ("FLOPS_PROFILER_", "flops_profiler"),
+)
+
+# constant-name prefix -> (section, sub-block key) for one-level-nested
+# config blocks; checked BEFORE the flat section prefixes (a nested
+# prefix is always a strict extension of its section prefix).  The
+# sub-block's own name constant (no trailing segment) stays an ordinary
+# key of the parent section.
+_NESTED_SECTION_PREFIXES = (
+    ("ZERO_OFFLOAD_STATE_DTYPE_",
+     ("zero_optimization", "offload_state_dtype")),
 )
 
 # prefixed names that are nonetheless TOP-LEVEL json keys
@@ -185,6 +201,7 @@ def extract_schema(root: Optional[str] = None) -> ConfigSchema:
     root = root or package_root()
     top: Dict[str, KeyInfo] = {}
     sections: Dict[str, Dict[str, KeyInfo]] = {}
+    nested: Dict = {}
 
     for rel, default_section, excluded in _CONSTANT_MODULES:
         path = os.path.join(root, rel)
@@ -195,8 +212,15 @@ def extract_schema(root: Optional[str] = None) -> ConfigSchema:
             if name in excluded:
                 continue
             section = default_section
+            nest = None
             if rel == "runtime/constants.py":
-                if name in _TOP_LEVEL_OVERRIDES:
+                for prefix, nest_addr in _NESTED_SECTION_PREFIXES:
+                    if name.startswith(prefix):
+                        nest = nest_addr
+                        break
+                if nest is not None:
+                    section = None
+                elif name in _TOP_LEVEL_OVERRIDES:
                     section = None
                 elif name in _SECTION_NAME_OVERRIDES:
                     section = _SECTION_NAME_OVERRIDES[name]
@@ -205,6 +229,13 @@ def extract_schema(root: Optional[str] = None) -> ConfigSchema:
                         if name.startswith(prefix):
                             section = sec
                             break
+            if nest is not None:
+                nested.setdefault(nest, {}).setdefault(key, KeyInfo(
+                    key=key, const_name=name, section="%s.%s" % nest,
+                    default=defaults.get(name + "_DEFAULT"),
+                    has_default=(name + "_DEFAULT") in defaults,
+                    source=rel, line=line))
+                continue
             # a section-name constant (FP16 = "fp16") stays top-level even
             # when the module maps to a section (ACT_CHKPT, FLOPS_PROFILER,
             # ELASTICITY declare their own section key)
@@ -229,7 +260,7 @@ def extract_schema(root: Optional[str] = None) -> ConfigSchema:
         top.setdefault(key, KeyInfo(
             key=key, const_name="", section=None, default=None,
             has_default=False, source="<supplemental>", line=0))
-    return ConfigSchema(top_level=top, sections=sections)
+    return ConfigSchema(top_level=top, sections=sections, nested=nested)
 
 
 _SCHEMA_CACHE: Optional[ConfigSchema] = None
@@ -279,8 +310,25 @@ def validate_config_dict(param_dict: dict,
         if section_schema is None or not isinstance(value, dict):
             continue  # scalar key, free-form section, or deprecated bool
         known_sub = set(section_schema) | _FREEFORM_SUBKEYS
-        for sub in value:
+        for sub, sub_value in value.items():
             if sub in known_sub:
+                # one-level-nested sub-block (e.g. zero_optimization.
+                # offload_state_dtype): descend when a nested schema
+                # exists and the value is the dict form (shorthand
+                # strings are validated by the section parser)
+                nested_schema = (schema.nested or {}).get((key, sub))
+                if nested_schema is not None and isinstance(sub_value,
+                                                            dict):
+                    for k2 in sub_value:
+                        if k2 in nested_schema:
+                            continue
+                        sug = _suggest(k2, nested_schema)
+                        hint = f"; did you mean '{sug}'?" if sug else ""
+                        issues.append(ConfigIssue(
+                            key=k2, section=f"{key}.{sub}",
+                            suggestion=sug,
+                            message=f"unknown key '{k2}' in config "
+                                    f"sub-block '{key}.{sub}'{hint}"))
                 continue
             sug = _suggest(sub, known_sub)
             hint = f"; did you mean '{sug}'?" if sug else ""

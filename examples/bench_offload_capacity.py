@@ -65,9 +65,14 @@ og = os.environ.get("T_OG") == "1"
 zero = {"stage": 2, "cpu_offload": off, "offload_gradients": og and off}
 gmb = int(os.environ.get("T_GMB", "0"))
 if gmb:
-    # fewer, bigger host buffers: the remote AOT compile helper crashes
-    # on many-buffer programs (round-5 receipt: gpt2-xl needed 3584)
+    # manual escape hatch only: the coordinator auto-derives the group
+    # layout by capping total buffer COUNT since round 6 (the round-5
+    # many-buffer AOT crash mode; gpt2-xl needed a manual 3584 then)
     zero["offload_group_mb"] = gmb
+sdt = os.environ.get("T_SDT", "")
+if sdt:
+    # reduced-precision host state ("bf16"/"fp16"): halves state wire
+    zero["offload_state_dtype"] = sdt
 engine, *_ = deepspeed.initialize(model=model, mesh=mesh,
     config={"train_batch_size": batch, "steps_per_print": 10 ** 9,
             "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
@@ -90,6 +95,10 @@ s = stats.as_dict()
 print(f"CAP_COMPILE cold={s['compile_seconds_cold']} "
       f"warm={s['compile_seconds_warm']} hits={s['compile_cache_hits']} "
       f"misses={s['compile_cache_misses']}")
+if off:
+    print(f"CAP_STATE dtype={engine.host_state_dtype()} "
+          f"bytes_per_step={engine.host_state_bytes_per_step()} "
+          f"groups={len(engine.flat.host_group_bounds or ((0, 0),))}")
 print(f"CAP_RESULT {dt * 1e3:.0f}")
 """
 
@@ -104,8 +113,9 @@ def try_step(offload, hidden, layers, heads, offload_grads=False,
                T_HEADS=str(heads), T_OFF="1" if offload else "0",
                T_B=str(BATCH), T_S=str(STEPS),
                T_OG="1" if offload_grads else "0")
-    if params >= 1.4e9:
-        env.setdefault("T_GMB", "3584")
+    # no T_GMB default: the coordinator's buffer-count cap derives the
+    # round-5 3584 layout (and beyond) automatically; export T_GMB to
+    # force a manual group size, T_SDT=bf16 for reduced host state
     # one shared warm cache across every fresh-subprocess trial
     env.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -120,6 +130,9 @@ def try_step(offload, hidden, layers, heads, offload_grads=False,
     for line in proc.stdout.splitlines():
         if line.startswith("CAP_COMPILE "):
             compile_line = line[len("CAP_COMPILE "):]
+        if line.startswith("CAP_STATE "):
+            compile_line = (compile_line + "  " if compile_line
+                            else "") + line[len("CAP_STATE "):]
         if line.startswith("CAP_RESULT "):
             return True, float(line.split()[1]) / 1e3, compile_line
     err = proc.stdout[-300:] + proc.stderr[-300:]

@@ -40,8 +40,17 @@ WARMUP = int(os.environ.get("BENCH_WARMUP", "2"))
 BLOCK = int(os.environ.get("BENCH_BLOCK", "512"))
 
 
-def timed_fwd_bwd(attn_fn, q, k, v, steps):
-    """Mean fwd+bwd wall seconds per step, attn_fn(q, k, v) -> [b,s,h,d]."""
+REPEATS = int(os.environ.get("BENCH_REPEATS", "3"))
+
+
+def make_runner(attn_fn, q, k, v, steps):
+    """Compile + warm a scan-of-``steps`` runner; returns a zero-arg
+    timed call (ONE dispatch, fenced by a host round-trip, seconds per
+    step).  Splitting build from timing lets callers interleave repeats
+    across kernels — the PERF.md methodology: a single timed shot
+    swings ±50% on the remote attachment, and back-to-back repeats let
+    one load spike mis-rank a whole kernel (the round-5 driver-vs-
+    example sparse discrepancy, VERDICT r5 item 3)."""
 
     @jax.jit
     def run(q, k, v):
@@ -62,11 +71,32 @@ def timed_fwd_bwd(attn_fn, q, k, v, steps):
     float(jax.device_get(run(q, k, v)))  # compile + warm
     for _ in range(WARMUP):
         float(jax.device_get(run(q, k, v)))
-    t0 = time.perf_counter()
-    r = float(jax.device_get(run(q, k, v)))
-    dt = time.perf_counter() - t0
-    assert np.isfinite(r)
-    return dt / steps
+
+    def timed():
+        t0 = time.perf_counter()
+        r = float(jax.device_get(run(q, k, v)))
+        dt = time.perf_counter() - t0
+        assert np.isfinite(r)
+        return dt / steps
+
+    return timed
+
+
+def timed_min_interleaved(runners, repeats=REPEATS):
+    """Min-aggregated per-step seconds for each warmed runner, repeats
+    INTERLEAVED across runners so ambient load cancels in the ratio."""
+    results = [[] for _ in runners]
+    for _ in range(repeats):
+        for i, timed in enumerate(runners):
+            results[i].append(timed())
+    return [min(rs) for rs in results]
+
+
+def timed_fwd_bwd(attn_fn, q, k, v, steps):
+    """Min-of-repeats fwd+bwd wall seconds per step (single-kernel
+    form; pairwise comparisons should interleave via make_runner +
+    timed_min_interleaved)."""
+    return timed_min_interleaved([make_runner(attn_fn, q, k, v, steps)])[0]
 
 
 def main():
@@ -85,11 +115,11 @@ def main():
         layout = cfg.make_layout(s)
         active = layout[0].sum() / layout[0].size
 
-        t_dense = timed_fwd_bwd(
-            lambda a, b_, c: flash_attention(a, b_, c), q, k, v, STEPS)
-        t_sparse = timed_fwd_bwd(
-            lambda a, b_, c: flash_block_sparse_attention(a, b_, c, layout),
-            q, k, v, STEPS)
+        t_dense, t_sparse = timed_min_interleaved([
+            make_runner(lambda a, b_, c: flash_attention(a, b_, c),
+                        q, k, v, STEPS),
+            make_runner(lambda a, b_, c: flash_block_sparse_attention(
+                a, b_, c, layout), q, k, v, STEPS)])
         print(f"seq {s:6d}: dense {t_dense * 1e3:8.2f} ms  "
               f"sparse {t_sparse * 1e3:8.2f} ms  "
               f"speedup {t_dense / t_sparse:5.2f}x  "

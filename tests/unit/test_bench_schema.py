@@ -1,0 +1,60 @@
+"""Driver-bench JSON schema (``tools/bench_schema.py``).
+
+The standing ROADMAP rule — every README/PERF headline quotes a driver
+artifact — needs the artifact's fields to be stable; this suite pins
+the registry against the real round-5 artifact and the round-6 fields
+(reduced-precision ``host_state_dtype`` / ``host_state_bytes_per_step``).
+"""
+
+import json
+import os
+
+from deepspeed_tpu.tools.bench_schema import field_type, validate_record
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def test_round5_artifact_validates():
+    path = os.path.join(REPO, "BENCH_r05.json")
+    with open(path) as f:
+        record = json.load(f)["parsed"]
+    assert validate_record(record) == []
+
+
+def test_round6_reduced_precision_fields():
+    """The new offload rows must carry auditable wire-bytes receipts."""
+    record = {
+        "offload_gpt2_large_ms_per_step": 1292.0,
+        "offload_gpt2_large_params_b": 0.77,
+        "offload_gpt2_large_host_state_dtype": "fp32",
+        "offload_gpt2_large_host_state_bytes_per_step": 18598986752,
+        "offload_gpt2_large_bf16_ms_per_step": 880.0,
+        "offload_gpt2_large_bf16_params_b": 0.77,
+        "offload_gpt2_large_bf16_host_state_dtype": "bf16",
+        "offload_gpt2_large_bf16_host_state_bytes_per_step": 9299493376,
+        "offload_gpt2_xl_host_groups": 2,
+        "sparse_attn_repeats": 3,
+    }
+    assert validate_record(record) == []
+    # the dtype/bytes pattern applies to ANY row name, not a fixed list
+    assert field_type("offload_gpt2_27b_host_state_bytes_per_step")
+    assert field_type("offload_gpt2_27b_host_state_dtype") is str
+
+
+def test_unknown_and_mistyped_fields_are_flagged():
+    probs = validate_record({
+        "offload_gpt2_large_host_state_bytes_per_step": "lots",
+        "made_up_field": 1,
+        "mfu": True,  # bool smuggled into a metric
+    })
+    assert len(probs) == 3
+    assert any("made_up_field" in p for p in probs)
+
+
+def test_failure_strings_allowed_per_row():
+    assert validate_record({
+        "offload_xl_exc": "xl run failed (try 2): ...",
+        "seq512_exc": "secondary run failed (try 1): ...",
+        "offload_gpt2_large_bf16_error": "non-finite loss nan",
+    }) == []

@@ -554,6 +554,41 @@ def test_validator_round_trips_known_good_configs():
         assert validate_config_dict(cfg) == [], cfg
 
 
+def test_validator_nested_sub_block_typo():
+    """One-level-nested sub-blocks (zero_optimization.offload_state_dtype)
+    are schema'd from their ZERO_OFFLOAD_STATE_DTYPE_* constants and
+    validated one level deeper than plain sections."""
+    schema = extract_schema()
+    assert ("zero_optimization", "offload_state_dtype") in schema.nested
+    nested = schema.nested[("zero_optimization", "offload_state_dtype")]
+    assert {"master", "momentum", "variance", "error_feedback",
+            "rounding", "seed"} <= set(nested)
+
+    issues = validate_config_dict(
+        {"zero_optimization": {"stage": 2, "cpu_offload": True,
+                               "offload_state_dtype": {
+                                   "momentum": "bf16",
+                                   "varience": "bf16"}}})
+    assert len(issues) == 1
+    assert issues[0].section == "zero_optimization.offload_state_dtype"
+    assert issues[0].suggestion == "variance"
+
+
+def test_validator_nested_sub_block_accepts_good_forms():
+    # dict form, shorthand string form, and absence all validate clean
+    for zo in ({"stage": 2, "cpu_offload": True,
+                "offload_state_dtype": {"momentum": "bf16",
+                                        "variance": "bf16",
+                                        "master": "bf16",
+                                        "error_feedback": True,
+                                        "rounding": "stochastic",
+                                        "seed": 7}},
+               {"stage": 2, "cpu_offload": True,
+                "offload_state_dtype": "bf16"},
+               {"stage": 2}):
+        assert validate_config_dict({"zero_optimization": zo}) == [], zo
+
+
 def test_validator_skips_freeform_params():
     issues = validate_config_dict({
         "optimizer": {"type": "Adam",

@@ -88,6 +88,43 @@ def test_cache_roundtrip(monkeypatch, tmp_path):
     assert got2 == (512, 512)
 
 
+def test_tuner_version_bump_invalidates_cache(monkeypatch, tmp_path):
+    """VERDICT r5 item 6: winners persist to disk indefinitely, so a
+    ranking produced by an older tuner must not survive a tuner upgrade
+    — the cache key carries TUNER_VERSION, and a bump forces re-tune."""
+    assert f"v{kt.TUNER_VERSION}|" in kt._key(
+        1536, 1536, 64, False, 0.0, _FakeTpu.device_kind)
+
+    cache = tmp_path / "cache.json"
+    monkeypatch.setattr(kt, "_CACHE_PATH", str(cache))
+    monkeypatch.setattr(kt.jax, "devices", lambda *a: [_FakeTpu()])
+    # a winner cached by the CURRENT tuner version...
+    key = kt._key(1536, 1536, 64, False, 0.0, _FakeTpu.device_kind)
+    monkeypatch.setattr(kt, "_memory_cache", {key: [256, 512]})
+    monkeypatch.setattr(kt, "_disk_loaded", True)
+    kt._save_disk()
+
+    def boom(*a, **k):  # noqa: ANN001
+        raise AssertionError("same-version cached shape must not re-tune")
+
+    monkeypatch.setattr(kt, "_memory_cache", {})
+    monkeypatch.setattr(kt, "_disk_loaded", False)
+    assert kt.tune(1536, 1536, 64, False, 0.0, boom, (512, 512)) == (256, 512)
+
+    # ...is INVISIBLE to a bumped tuner: the stale entry is ignored and
+    # the search runs again (falls back to the heuristic here, since no
+    # candidate can compile on this fake backend)
+    monkeypatch.setattr(kt, "TUNER_VERSION", kt.TUNER_VERSION + 1)
+    monkeypatch.setattr(kt, "_memory_cache", {})
+    monkeypatch.setattr(kt, "_disk_loaded", False)
+
+    def no_compile(*a, **k):  # noqa: ANN001
+        raise RuntimeError("no kernels on this backend")
+
+    got = kt.tune(1536, 1536, 64, False, 0.0, no_compile, (512, 512))
+    assert got == (512, 512)  # re-tuned (heuristic fallback), not [256, 512]
+
+
 @pytest.mark.tpu
 def test_tune_searches_on_chip(monkeypatch, tmp_path):
     """First-use micro-search on the real chip for an un-anchored shape:

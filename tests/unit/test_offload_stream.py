@@ -145,6 +145,41 @@ def test_checkpoint_roundtrip_across_forms(force_injit, cpu_devices,
     assert losses[-1] < losses[0]
 
 
+# ------------------------------------------------------- group layout
+def test_derive_group_bytes_caps_buffer_count():
+    """ROADMAP item 1 refactor: the host-group layout is auto-derived by
+    capping total buffer COUNT (the observed AOT-crash mode), so the
+    gpt2-xl bench row runs with an EMPTY offload_group_mb override.
+    The round-5 receipt: 4 families x 4 groups (1792 MB) crashed the
+    AOT helper; 4 x 2 (3584 MB) compiled."""
+    gb = coord.derive_group_bytes
+    xl_bytes = int(1.56e9) * 4  # gpt2-xl fp32 rows
+    # 4 families (p, m, v, g): cap 8 buffers -> 2 groups of <= 3584 MB
+    got = gb(xl_bytes, 4)
+    assert got <= coord.HOST_GROUP_BYTES_MAX
+    n_groups = -(-xl_bytes // got)
+    assert n_groups * 4 <= coord.MAX_HOST_BUFFERS
+    # small states keep the >=2-group round-robin calibration size
+    assert gb(100 << 20, 3) == coord.HOST_GROUP_BYTES
+    # state too big for the count cap under the per-buffer bound: the
+    # per-buffer bound wins (loud warning), never a SIGABRT-sized buffer
+    assert gb(int(30e9), 7) == coord.HOST_GROUP_BYTES_MAX
+
+
+def test_engine_uses_derived_group_layout(force_injit, cpu_devices,
+                                          monkeypatch):
+    """With no offload_group_mb override, the engine's layout respects
+    the buffer-count cap at toy scale: families x groups <= the cap."""
+    monkeypatch.setattr(coord, "HOST_GROUP_BYTES", 256 << 10)
+    monkeypatch.setattr(coord, "MAX_HOST_BUFFERS", 8)
+    engine = _engine(cpu_devices, uniform=False, offload_gradients=True)
+    bounds = engine.flat.host_group_bounds or ((0, engine.segments.rows),)
+    assert len(bounds) * engine.flat.host_families <= 8
+    assert engine.flat.host_families == 4  # p, m, v + host gradients
+    losses = _losses(engine)
+    assert losses[-1] < losses[0], losses
+
+
 # ----------------------------------------------------------------- core
 def _core_jaxpr(n_chunks, n_groups=2, chunk_rows=8):
     """jaxpr of the scan core at a given chunk count (state size grows,

@@ -20,7 +20,7 @@ LAYERS = 2
 
 
 def _losses(cpu_offload, steps=4, chunk_mb=1, offload_gradients=False,
-            clip=0.0, uniform="auto"):
+            clip=0.0, uniform="auto", state_dtype=None):
     import deepspeed_tpu as deepspeed
     from deepspeed_tpu.models import GPT2Config, GPT2LMHeadTPU
     from deepspeed_tpu.parallel import make_mesh
@@ -30,16 +30,18 @@ def _losses(cpu_offload, steps=4, chunk_mb=1, offload_gradients=False,
                      embd_dropout=0.0, attn_dropout=0.0, resid_dropout=0.0)
     mesh = make_mesh({"data": 1}, devices=jax.devices()[:1])
     model = GPT2LMHeadTPU(cfg)
+    zero = {"stage": 2, "cpu_offload": cpu_offload,
+            "offload_chunk_mb": chunk_mb,
+            "offload_uniform_chunks": uniform,
+            "offload_gradients": offload_gradients and cpu_offload}
+    if state_dtype is not None:
+        zero["offload_state_dtype"] = state_dtype
     engine, *_ = deepspeed.initialize(
         model=model, mesh=mesh,
         config={"train_batch_size": 4, "steps_per_print": 10 ** 9,
                 "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
                 "gradient_clipping": clip,
-                "zero_optimization": {"stage": 2, "cpu_offload": cpu_offload,
-                                      "offload_chunk_mb": chunk_mb,
-                                      "offload_uniform_chunks": uniform,
-                                      "offload_gradients": (
-                                          offload_gradients and cpu_offload)},
+                "zero_optimization": zero,
                 "bf16": {"enabled": True}})
     rng = np.random.default_rng(0)
     batch = {"input_ids": rng.integers(0, 1024, size=(4, 128)).astype(np.int32)}
@@ -125,6 +127,27 @@ def test_uniform_scan_offload_matches_device_training(monkeypatch):
     np.testing.assert_allclose(streamed, base, rtol=2e-4, atol=2e-4)
     for g in engine.state["master"]:
         assert g.sharding.memory_kind == "pinned_host"
+
+
+def test_reduced_state_bf16_matches_device_training(monkeypatch):
+    """Reduced-precision host state ON THE REAL CHIP: bf16 pinned-host
+    buffers with stochastic-rounding write-back track device-resident
+    fp32 training, with grouping forced and the scan layout engaged
+    (the pinned_host<->device placements around the quantize/dequantize
+    are the one thing the CPU-forced suite cannot exercise)."""
+    import deepspeed_tpu.runtime.zero.coordinator as coord
+
+    base, _ = _losses(cpu_offload=False, steps=8)
+    monkeypatch.setattr(coord, "HOST_GROUP_BYTES", 1 << 20)
+    reduced, engine = _losses(cpu_offload=True, chunk_mb=1, steps=8,
+                              uniform=True, state_dtype="bf16")
+    assert engine._state_quant is not None
+    assert engine.host_state_bytes_per_step() * 2 == \
+        8 * engine.segments.rows * engine.state["master"][0].shape[1] * 3
+    for g in engine.state["master"]:
+        assert g.sharding.memory_kind == "pinned_host"
+        assert str(g.dtype) == "bfloat16"
+    np.testing.assert_allclose(reduced, base, rtol=2e-2, atol=2e-3)
 
 
 def test_streamed_offload_grouped_with_chunking_disabled(monkeypatch):
