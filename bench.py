@@ -32,17 +32,11 @@ BASELINE_SEQ512_SAMPLES_PER_SEC = 52.0  # same post, seq 512 row
 SEQ = 128
 VOCAB = 30528
 
-# bf16 peak TFLOP/s per chip, by device_kind substring (conservative defaults).
-PEAK_TFLOPS = {
-    "v5 lite": 197.0,  # TPU v5e
-    "v5e": 197.0,
-    "v4": 275.0,
-    "v5p": 459.0,
-    "v6": 918.0,  # Trillium
-}
-# Unknown accelerators assume the fastest plausible chip so the MFU>1
-# no-sync guard never false-fails a legitimately fast device.
-DEFAULT_PEAK_TFLOPS = 990.0
+# Chip peak table + MFU math live in deepspeed_tpu/profiling/utilization.py
+# (ONE implementation shared with the flops profiler and the capacity
+# planner, so utilisation numbers cannot drift between reporters);
+# imported lazily below — bench defers every deepspeed_tpu/jax import
+# until after the compile cache is configured.
 
 
 def bert_model_flops_per_sample(cfg, seq):
@@ -99,12 +93,30 @@ def exact_count_mlm_labels(rng, ids, n_pred):
     return labels
 
 
-def chip_peak_tflops(device):
-    kind = getattr(device, "device_kind", "").lower()
-    for key, val in PEAK_TFLOPS.items():
-        if key in kind:
-            return val
-    return DEFAULT_PEAK_TFLOPS
+def memory_receipts(record, engine, prefix=None):
+    """Memory receipts for one bench row (fail-soft): the compiled
+    train-step program's predicted temp bytes (ledger), the live HBM
+    peak watermark summed over local devices, and — offload rows — the
+    pinned-host buffer bytes.  Registered in ``tools/bench_schema.py``
+    as ``*_peak_hbm_bytes`` / ``*_predicted_temp_bytes`` /
+    ``*_host_buffer_bytes``."""
+    try:
+        from deepspeed_tpu.profiling.memory import device_memory_summary
+
+        tag = (lambda f: f"{prefix}_{f}") if prefix else (lambda f: f)
+        temps = engine.memory_ledger.predicted_temp_bytes("train_step")
+        if temps is not None:
+            record[tag("predicted_temp_bytes")] = int(temps)
+        summary = device_memory_summary()
+        if summary["reporting"]:
+            record[tag("peak_hbm_bytes")] = int(
+                summary["peak_bytes_in_use"])
+        host_bytes = engine.memory_ledger.host_buffers.total_bytes()
+        if prefix and host_bytes:
+            record[tag("host_buffer_bytes")] = int(host_bytes)
+    except Exception as e:  # pragma: no cover - receipts never gate rows
+        print(f"bench: memory receipts unavailable: {e!r:.200}",
+              file=sys.stderr)
 
 
 def main():
@@ -128,6 +140,8 @@ def main():
     import deepspeed_tpu as deepspeed
     from deepspeed_tpu.models import BertConfig, BertForPreTrainingTPU
     from deepspeed_tpu.parallel import make_mesh
+    from deepspeed_tpu.profiling.utilization import (
+        achieved_tflops, chip_peak_tflops, model_flops_utilization)
 
     batch = int(os.environ.get("BENCH_BATCH", "112"))
     steps = int(os.environ.get("BENCH_STEPS", "30"))
@@ -161,6 +175,9 @@ def main():
         "steps_per_print": 10 ** 9,
         "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
         "bf16": {"enabled": True},
+        # compiled-program memory ledger: predicted_temp_bytes /
+        # peak_hbm_bytes receipts ride the bench JSON (zero step cost)
+        "profiling": {"memory_ledger": True},
     }
     # 20 = bing_bert's max_predictions_per_seq at seq 128; the MLM head
     # gathers these positions before the vocab projection (~8% of step
@@ -200,9 +217,9 @@ def main():
 
     samples_per_sec = batch * steps / dt
     model_flops = bert_model_flops_per_sample(bert_cfg, SEQ)
-    tflops = samples_per_sec * model_flops / 1e12
+    tflops = achieved_tflops(samples_per_sec, model_flops)
     peak = chip_peak_tflops(dev)
-    mfu = tflops / peak
+    mfu = model_flops_utilization(samples_per_sec, model_flops, peak)
 
     if not math.isfinite(final_loss):
         print(json.dumps({"metric": "bert_large_seq128_samples_per_sec_per_chip",
@@ -230,6 +247,10 @@ def main():
         "dropout": dropout_p,
         "device": getattr(dev, "device_kind", str(dev)),
     }
+
+    # memory receipts for the primary row: predicted temp bytes from the
+    # compiled train step + the live peak watermark (profiling/memory)
+    memory_receipts(record, engine)
 
     # HBM discipline: each engine holds ~5 GB of master+optimizer state for
     # these model sizes; three co-resident engines exhaust a 16 GB chip.
@@ -379,6 +400,7 @@ def _measure_offload(record, deepspeed, mesh, rng):
             config={"train_batch_size": 4, "steps_per_print": 10 ** 9,
                     "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
                     "zero_optimization": zero,
+                    "profiling": {"memory_ledger": True},
                     "bf16": {"enabled": True}})
         for _ in range(2):
             loss = engine.train_batch(iter([batch]))
@@ -394,6 +416,7 @@ def _measure_offload(record, deepspeed, mesh, rng):
             record[f"{prefix}_host_state_dtype"] = engine.host_state_dtype()
             record[f"{prefix}_host_state_bytes_per_step"] = int(
                 engine.host_state_bytes_per_step())
+            memory_receipts(record, engine, prefix=prefix)
         else:
             record[f"{prefix}_error"] = f"non-finite loss {v}"
         del engine, model
@@ -447,6 +470,7 @@ def _measure_offload_xl(record, deepspeed, mesh, rng):
         config={"train_batch_size": 4, "steps_per_print": 10 ** 9,
                 "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
                 "zero_optimization": zero,
+                "profiling": {"memory_ledger": True},
                 "bf16": {"enabled": True}})
     batch = {"input_ids": rng.integers(
         0, cfg.vocab_size, size=(4, 1024)).astype(np.int32)}
@@ -468,6 +492,7 @@ def _measure_offload_xl(record, deepspeed, mesh, rng):
             engine.host_state_bytes_per_step())
         record["offload_gpt2_xl_host_groups"] = len(
             engine.flat.host_group_bounds or ((0, 0),))
+        memory_receipts(record, engine, prefix="offload_gpt2_xl")
     else:
         record["offload_xl_error"] = f"non-finite loss {v}"
     del engine, model
@@ -548,8 +573,11 @@ def _measure_gpt2(record, deepspeed, mesh, rng, steps, warmup, dropout_p,
         loss = engine.train_batch(iter([batch]))
     final = float(jax.device_get(loss))
     dt = time.perf_counter() - t0
+    from deepspeed_tpu.profiling.utilization import model_flops_utilization
+
     sps = bg * g_steps / dt
-    mfu = sps * gpt2_model_flops_per_sample(cfg, seq) / 1e12 / peak
+    mfu = model_flops_utilization(sps, gpt2_model_flops_per_sample(cfg, seq),
+                                  peak)
     if mfu > 1.0 or not math.isfinite(final):
         record["gpt2_error"] = f"invalid measurement: mfu={mfu:.2f} loss={final}"
     else:
@@ -602,8 +630,12 @@ def _measure_seq512(record, deepspeed, BertConfig, BertForPreTrainingTPU,
             loss512 = eng512.train_batch(iter([batch512]))
         final512 = float(jax.device_get(loss512))
         dt512 = time.perf_counter() - t0
+        from deepspeed_tpu.profiling.utilization import \
+            model_flops_utilization
+
         sps512 = b512 * s512_steps / dt512
-        mfu512 = sps512 * bert_model_flops_per_sample(cfg512, 512) / 1e12 / peak
+        mfu512 = model_flops_utilization(
+            sps512, bert_model_flops_per_sample(cfg512, 512), peak)
         if mfu512 > 1.0 or not math.isfinite(final512):
             # same discipline as the primary metric: an unsynchronized or
             # NaN measurement is reported as invalid, not silently omitted

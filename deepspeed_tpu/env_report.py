@@ -64,6 +64,21 @@ def main():
         print(f"memory spaces ......... {', '.join(mems)}")
     except Exception:  # dslint: disable=DSE502 -- optional backend API probe; the report line is simply omitted
         pass
+    # per-device HBM capacity (memory_stats bytes_limit): what the AOT
+    # capacity planner (profiling/capacity.py) plans against
+    from .profiling.memory import device_memory_summary
+
+    local = jax.local_devices()
+    summary = device_memory_summary(local)
+    if summary["reporting"]:
+        gib = 1024.0 ** 3
+        per_dev = summary["bytes_limit"] / max(summary["reporting"], 1)
+        print(f"hbm capacity .......... {summary['reporting']} x "
+              f"{per_dev / gib:.2f} GiB "
+              f"({summary['bytes_limit'] / gib:.2f} GiB local total)")
+    else:
+        print("hbm capacity .......... unreported on this backend "
+              "(capacity planner needs --capacity-gb)")
     print("-" * 64)
     print(f"{'op name':<28} {'compatible':<12} detail")
     print("-" * 64)

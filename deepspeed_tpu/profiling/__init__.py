@@ -1,8 +1,16 @@
-from .config import DeepSpeedFlopsProfilerConfig
+from .config import DeepSpeedFlopsProfilerConfig, DeepSpeedProfilingConfig
 from .flops_profiler import (FlopsProfiler, count_fn_flops, get_model_profile)
+from .memory import (HostBufferRegistry, MemoryLedger, device_memory_summary,
+                     see_memory_usage)
 from .step_profiler import (model_scope_breakdown, timed_loop, timed_scan,
                             wall_breakdown)
+from .utilization import (DEFAULT_PEAK_TFLOPS, PEAK_TFLOPS, chip_peak_tflops,
+                          model_flops_utilization)
 
-__all__ = ["DeepSpeedFlopsProfilerConfig", "FlopsProfiler", "count_fn_flops",
-           "get_model_profile", "wall_breakdown", "model_scope_breakdown",
-           "timed_loop", "timed_scan"]
+__all__ = ["DeepSpeedFlopsProfilerConfig", "DeepSpeedProfilingConfig",
+           "FlopsProfiler", "count_fn_flops", "get_model_profile",
+           "wall_breakdown", "model_scope_breakdown", "timed_loop",
+           "timed_scan", "MemoryLedger", "HostBufferRegistry",
+           "device_memory_summary", "see_memory_usage", "PEAK_TFLOPS",
+           "DEFAULT_PEAK_TFLOPS", "chip_peak_tflops",
+           "model_flops_utilization"]

@@ -1,0 +1,330 @@
+"""AOT capacity planner: "will this config fit?" without a trial run.
+
+``python -m deepspeed_tpu.profiling.capacity --config ds_config.json
+--model gpt2-xl`` builds the engine in *plan mode* (``aot_plan=True``:
+step programs are built and jitted, module params never materialize on
+device), lowers + compiles the fused train step WITHOUT executing it,
+and reads the executable's ``memory_analysis()`` — the compiler's own
+statement of argument/output/temp/alias bytes.  Warm under the PR 5
+persistent compile cache this is a seconds-long query; each capacity
+ladder rung used to cost a full trial run to learn the same answer by
+OOM-ing (ROADMAP item 1).
+
+Verdict: ``predicted peak HBM = arguments + outputs − aliased (donated)
++ temporaries + generated code`` per device, compared against the
+device's ``memory_stats()['bytes_limit']`` (or ``--capacity-gb``)
+scaled by ``--headroom``.  ``--bisect-layers LO HI`` bisects the layer
+count to estimate the largest fitting model of the family.
+
+Exit codes: 0 fit, 1 no-fit, 2 usage error, 3 unknown (the backend
+lacks ``memory_analysis`` or no device capacity is known — fail-soft by
+design, the planner must degrade to "unknown", never crash).
+"""
+
+import argparse
+import gc
+import json
+import sys
+import time
+
+# model presets: name -> GPT2Config kwargs (the bench/ladder shapes)
+GPT2_PRESETS = {
+    "gpt2-medium": dict(hidden_size=1024, num_layers=24, num_heads=16),
+    "gpt2-large": dict(hidden_size=1280, num_layers=36, num_heads=20),
+    "gpt2-xl": dict(hidden_size=1600, num_layers=48, num_heads=25),
+    "gpt2-2.7b": dict(hidden_size=2560, num_layers=32, num_heads=32),
+    "gpt2-4b": dict(hidden_size=3072, num_layers=36, num_heads=32),
+    "gpt2-6b": dict(hidden_size=4096, num_layers=30, num_heads=32),
+}
+
+DEFAULT_HEADROOM = 0.92
+
+
+def gpt2_param_count(hidden_size, num_layers, vocab_size=50257,
+                     max_position_embeddings=1024):
+    """Analytic GPT-2 parameter count (tied LM head)."""
+    h, L = hidden_size, num_layers
+    per_layer = 12 * h * h + 13 * h  # qkv/proj/mlp + ln/biases
+    return vocab_size * h + max_position_embeddings * h \
+        + L * per_layer + 2 * h
+
+
+def _build_model(args, num_layers=None):
+    from ..models import GPT2Config, GPT2LMHeadTPU
+
+    explicit = (args.hidden, args.layers, args.heads)
+    if all(explicit):
+        # explicit dims always win over the --model preset default
+        kw = dict(hidden_size=args.hidden, num_layers=args.layers,
+                  num_heads=args.heads)
+    elif any(explicit):
+        # a PARTIAL spec must not silently plan the preset default —
+        # the verdict would be about a different model than asked
+        raise ValueError(
+            "--hidden/--layers/--heads must all be given together "
+            f"(got hidden={args.hidden} layers={args.layers} "
+            f"heads={args.heads})")
+    elif args.model in GPT2_PRESETS:
+        kw = dict(GPT2_PRESETS[args.model])
+    else:
+        raise ValueError(
+            f"--model must be one of {sorted(GPT2_PRESETS)} or "
+            "--hidden/--layers/--heads must all be given")
+    if num_layers is not None:
+        kw["num_layers"] = int(num_layers)
+    cfg = GPT2Config(max_position_embeddings=args.seq, embd_dropout=0.0,
+                     attn_dropout=0.0, resid_dropout=0.0, remat=True,
+                     loss_chunk=(256 if args.seq % 256 == 0 else None), **kw)
+    return GPT2LMHeadTPU(cfg), kw
+
+
+def device_capacity_bytes(capacity_gb=None):
+    """Per-device HBM capacity: explicit override, else
+    ``memory_stats()['bytes_limit']`` of local device 0 (None when the
+    backend reports nothing — CPU)."""
+    if capacity_gb:
+        return int(capacity_gb * (1 << 30))
+    from .memory import device_memory_summary
+
+    try:
+        import jax
+
+        summary = device_memory_summary(devices=jax.local_devices()[:1])
+    except Exception:  # dslint: disable=DSE502 -- no backend: capacity unknown
+        return None
+    return summary["bytes_limit"] if summary["reporting"] else None
+
+
+def plan(config, model, sample_batch, mesh=None, capacity_bytes=None,
+         headroom=DEFAULT_HEADROOM):
+    """Compile-only fit analysis for one (config, model) pair.
+
+    Returns a dict: per-space byte breakdown, predicted peak, capacity,
+    and ``fit`` (True/False/None-unknown).  Fail-soft: a backend without
+    ``memory_analysis`` yields ``predicted_peak_hbm_bytes=None`` and
+    ``fit=None``."""
+    import deepspeed_tpu as deepspeed
+
+    from .memory import predicted_host_bytes, predicted_peak_bytes
+
+    t0 = time.perf_counter()
+    if mesh is None:
+        # single-chip planning by default: "will this fit ONE device" is
+        # the capacity-ladder question (pass a mesh for multi-chip plans)
+        import jax
+
+        from ..parallel import make_mesh
+
+        mesh = make_mesh({"data": 1}, devices=[jax.devices()[0]])
+    engine, *_ = deepspeed.initialize(model=model, config=config,
+                                      mesh=mesh, aot_plan=True)
+    try:
+        _, entry = engine.aot_compile_train_step(sample_batch)
+        out = {
+            "analysis_available": entry is not None,
+            "predicted_peak_hbm_bytes": predicted_peak_bytes(entry),
+            "predicted_temp_bytes": (entry or {}).get("temp_size_in_bytes"),
+            "argument_bytes": (entry or {}).get("argument_size_in_bytes"),
+            "output_bytes": (entry or {}).get("output_size_in_bytes"),
+            "alias_bytes": (entry or {}).get("alias_size_in_bytes"),
+            "generated_code_bytes": (entry or {}).get(
+                "generated_code_size_in_bytes"),
+            "predicted_host_bytes": predicted_host_bytes(entry),
+            "host_buffer_bytes":
+                engine.memory_ledger.host_buffers.total_bytes(),
+            "host_buffer_count":
+                engine.memory_ledger.host_buffers.total_count(),
+            "host_state_wire_bytes_per_step":
+                engine.host_state_bytes_per_step(),
+            "capacity_bytes": capacity_bytes,
+            "headroom": headroom,
+            "plan_seconds": round(time.perf_counter() - t0, 3),
+        }
+        peak = out["predicted_peak_hbm_bytes"]
+        if peak is None or capacity_bytes is None:
+            out["fit"] = None
+        else:
+            out["fit"] = peak <= capacity_bytes * headroom
+        return out
+    finally:
+        engine.close()
+        del engine
+        gc.collect()
+
+
+def bisect_max_layers(args, config, mesh, capacity_bytes, lo, hi,
+                      log=print):
+    """Largest layer count in [lo, hi] whose plan fits (None when even
+    ``lo`` does not fit or fit is unknowable)."""
+    batch = _sample_batch(args)
+    best = None
+    while lo <= hi:
+        mid = (lo + hi) // 2
+        model, kw = _build_model(args, num_layers=mid)
+        result = plan(config, model, batch, mesh=mesh,
+                      capacity_bytes=capacity_bytes,
+                      headroom=args.headroom)
+        del model
+        gc.collect()
+        if result["fit"] is None:
+            log(f"# bisect: fit unknowable at layers={mid}; stopping")
+            return None, None
+        params = gpt2_param_count(kw["hidden_size"], mid,
+                                  max_position_embeddings=args.seq)
+        log(f"# bisect: layers={mid} params={params / 1e9:.2f}B "
+            f"peak={result['predicted_peak_hbm_bytes']} "
+            f"fit={result['fit']}")
+        if result["fit"]:
+            best = (mid, params)
+            lo = mid + 1
+        else:
+            hi = mid - 1
+    return best if best else (None, None)
+
+
+def _sample_batch(args):
+    import numpy as np
+
+    return {"input_ids": np.zeros((args.batch, args.seq), np.int32)}
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.profiling.capacity",
+        description="AOT capacity planner: compile the train step, "
+                    "predict peak HBM, emit a fit/no-fit verdict — no "
+                    "trial run")
+    parser.add_argument("--config", required=True,
+                        help="DeepSpeed config JSON (the training config "
+                             "to plan for)")
+    parser.add_argument("--model", default="gpt2-xl",
+                        help=f"model preset ({', '.join(sorted(GPT2_PRESETS))})"
+                             " or use --hidden/--layers/--heads")
+    parser.add_argument("--hidden", type=int, default=0)
+    parser.add_argument("--layers", type=int, default=0)
+    parser.add_argument("--heads", type=int, default=0)
+    parser.add_argument("--batch", type=int, default=0,
+                        help="micro-batch size (default: derived from the "
+                             "config's train_batch_size / "
+                             "gradient_accumulation_steps at dp=1)")
+    parser.add_argument("--seq", type=int, default=1024)
+    parser.add_argument("--capacity-gb", type=float, default=0.0,
+                        help="per-device HBM capacity override (GiB); "
+                             "default: memory_stats()['bytes_limit']")
+    parser.add_argument("--headroom", type=float, default=DEFAULT_HEADROOM,
+                        help="usable fraction of capacity (allocator "
+                             "fragmentation margin)")
+    parser.add_argument("--bisect-layers", type=int, nargs=2,
+                        metavar=("LO", "HI"),
+                        help="also bisect num_layers in [LO, HI] for the "
+                             "max fitting model size")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit one JSON line instead of the report")
+    args = parser.parse_args(argv)
+
+    try:
+        with open(args.config, encoding="utf-8") as f:
+            config = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"error: cannot read --config {args.config}: {e}",
+              file=sys.stderr)
+        return 2
+
+    if not args.batch:
+        tbs = int(config.get("train_batch_size", 4) or 4)
+        acc = int(config.get("gradient_accumulation_steps", 1) or 1)
+        args.batch = max(1, tbs // acc)
+
+    capacity = device_capacity_bytes(args.capacity_gb or None)
+    try:
+        model, kw = _build_model(args)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    try:
+        result = plan(config, model, _sample_batch(args),
+                      capacity_bytes=capacity, headroom=args.headroom)
+    except Exception as e:
+        # the exit-code contract reserves 1 for NO-FIT: a crashed plan
+        # (bad config, compile failure) must not read as "does not fit"
+        print(f"error: capacity plan failed: {e!r:.500}", file=sys.stderr)
+        return 2
+    del model
+    gc.collect()
+    result["model"] = (f"gpt2(h{args.hidden},L{args.layers})"
+                       if args.hidden and args.layers and args.heads
+                       else args.model)
+    result["params_b"] = round(gpt2_param_count(
+        kw["hidden_size"], kw["num_layers"],
+        max_position_embeddings=args.seq) / 1e9, 3)
+    result["batch"], result["seq"] = args.batch, args.seq
+
+    if args.bisect_layers:
+        try:
+            layers, params = bisect_max_layers(
+                args, config, None, capacity, *args.bisect_layers,
+                log=(lambda *a: None) if args.as_json else print)
+        except Exception as e:
+            print(f"error: bisect failed: {e!r:.500}", file=sys.stderr)
+            layers = params = None
+        result["max_fitting_layers"] = layers
+        result["max_fitting_params_b"] = (round(params / 1e9, 3)
+                                          if params else None)
+
+    if args.as_json:
+        print(json.dumps(result))
+    else:
+        _print_report(result)
+    if result["fit"] is True:
+        return 0
+    if result["fit"] is False:
+        return 1
+    return 3
+
+
+def _fmt_bytes(n):
+    if n is None:
+        return "unknown"
+    return f"{n / (1 << 30):.2f} GiB ({n})"
+
+
+def _print_report(r):
+    print(f"capacity plan: {r.get('model')} ({r.get('params_b')}B params) "
+          f"batch={r.get('batch')} seq={r.get('seq')}")
+    print(f"  predicted peak HBM ... {_fmt_bytes(r['predicted_peak_hbm_bytes'])}")
+    print(f"    arguments .......... {_fmt_bytes(r['argument_bytes'])}")
+    print(f"    outputs ............ {_fmt_bytes(r['output_bytes'])}")
+    print(f"    aliased (donated) .. -{_fmt_bytes(r['alias_bytes'])}")
+    if r["alias_bytes"] == 0 and r["analysis_available"]:
+        # measured: executables deserialized from the persistent compile
+        # cache can report alias_size_in_bytes=0 even though the program
+        # donates its state buffers — the peak then OVERCOUNTS donated
+        # arguments (conservative: never claims fit falsely)
+        print("    (no aliasing reported — cache-deserialized "
+              "executables may omit it; peak is conservative)")
+    print(f"    temporaries ........ {_fmt_bytes(r['predicted_temp_bytes'])}")
+    print(f"    generated code ..... {_fmt_bytes(r['generated_code_bytes'])}")
+    print(f"  predicted host bytes . {_fmt_bytes(r['predicted_host_bytes'])}")
+    print(f"  pinned host buffers .. {r['host_buffer_count']} buffer(s), "
+          f"{_fmt_bytes(r['host_buffer_bytes'])}")
+    if r.get("host_state_wire_bytes_per_step"):
+        print(f"  state wire bytes/step  "
+              f"{_fmt_bytes(r['host_state_wire_bytes_per_step'])}")
+    print(f"  device capacity ...... {_fmt_bytes(r['capacity_bytes'])} "
+          f"(headroom {r['headroom']:.2f})")
+    if r["fit"] is None:
+        why = ("backend lacks memory_analysis"
+               if not r["analysis_available"]
+               else "device capacity unknown; pass --capacity-gb")
+        print(f"  verdict .............. UNKNOWN ({why})")
+    else:
+        print(f"  verdict .............. {'FIT' if r['fit'] else 'NO FIT'}")
+    if "max_fitting_layers" in r:
+        print(f"  max fitting layers ... {r['max_fitting_layers']} "
+              f"(~{r['max_fitting_params_b']}B params)")
+    print(f"  planned in ........... {r['plan_seconds']} s "
+          f"(warm compile cache makes reruns ~free)")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,4 +1,5 @@
-"""Flops-profiler config (reference ``deepspeed/profiling/config.py``)."""
+"""Profiling configs: the reference-parity ``flops_profiler`` block and
+the ``profiling`` block (memory ledger + watermarks, new)."""
 
 FLOPS_PROFILER = "flops_profiler"
 FLOPS_PROFILER_ENABLED = "enabled"
@@ -26,3 +27,45 @@ class DeepSpeedFlopsProfilerConfig:
         return dict(enabled=self.enabled, profile_step=self.profile_step,
                     module_depth=self.module_depth, top_modules=self.top_modules,
                     detailed=self.detailed)
+
+
+def _tristate(value, name):
+    """"auto" | true | false (same convention as compilation.cache)."""
+    if value in (True, False) or value == "auto":
+        return value
+    raise ValueError(f"profiling.{name} must be true, false or \"auto\", "
+                     f"got {value!r}")
+
+
+class DeepSpeedProfilingConfig:
+    """Typed view of the ``profiling`` block (memory observability)."""
+
+    def __init__(self, param_dict):
+        from ..runtime import constants as C
+        from ..runtime.config_utils import get_scalar_param
+
+        prof = param_dict.get(C.PROFILING, {}) or {}
+        self.memory_ledger = _tristate(get_scalar_param(
+            prof, C.PROFILING_MEMORY_LEDGER,
+            C.PROFILING_MEMORY_LEDGER_DEFAULT), C.PROFILING_MEMORY_LEDGER)
+        self.memory_watermarks = _tristate(get_scalar_param(
+            prof, C.PROFILING_MEMORY_WATERMARKS,
+            C.PROFILING_MEMORY_WATERMARKS_DEFAULT),
+            C.PROFILING_MEMORY_WATERMARKS)
+
+    def memory_ledger_enabled(self, telemetry_enabled):
+        if self.memory_ledger == "auto":
+            return bool(telemetry_enabled)
+        return bool(self.memory_ledger)
+
+    def memory_watermarks_enabled(self, telemetry_enabled):
+        # watermark output is gauges/events — without telemetry there is
+        # no sink, so "true" still requires telemetry to matter
+        if self.memory_watermarks == "auto":
+            return bool(telemetry_enabled)
+        return bool(self.memory_watermarks) and bool(telemetry_enabled)
+
+    def __repr__(self):
+        return (f"DeepSpeedProfilingConfig(memory_ledger="
+                f"{self.memory_ledger!r}, memory_watermarks="
+                f"{self.memory_watermarks!r})")

@@ -202,13 +202,29 @@ class FlopsProfile:
             return None
         return self.flops / (self.wall_ms / 1e3) / 1e12
 
+    def mfu(self, device=None):
+        """Model-FLOPs utilisation against the chip's bf16 peak — the
+        SAME peak table bench.py quotes (``profiling/utilization.py``),
+        so profiler and bench utilisation cannot drift."""
+        if not self.wall_ms:
+            return None
+        from ..utilization import chip_peak_tflops
+
+        if device is None:
+            import jax
+
+            device = jax.devices()[0]
+        return self.achieved_tflops() / chip_peak_tflops(device)
+
     def print(self, top_modules=3, log=None):
         log = log or logger.info
         log(f"flops profile: {_fmt(self.flops)}FLOPs, {_fmt(self.macs)}MACs, "
             f"{_fmt(self.params)}params")
         if self.wall_ms:
+            mfu = self.mfu()
             log(f"  wall: {self.wall_ms:.2f} ms -> "
-                f"{self.achieved_tflops():.2f} TFLOP/s achieved")
+                f"{self.achieved_tflops():.2f} TFLOP/s achieved"
+                + (f" (MFU {mfu:.3f})" if mfu is not None else ""))
         if self.backend_cost.get("flops"):
             log(f"  backend cost model: {_fmt(self.backend_cost['flops'])}FLOPs")
         scopes = sorted(self.by_scope.items(), key=lambda kv: -kv[1])

@@ -1,0 +1,367 @@
+"""Memory observability: compiled-program HBM ledger + live watermarks.
+
+XLA makes device memory *statically knowable*: every compiled executable
+reports its argument/output/temp/alias byte totals at compile time
+(``compiled.memory_analysis()``), for free.  This module turns that into
+run artifacts:
+
+- :class:`MemoryLedger` — wraps the engine's jit entry points so the
+  FIRST dispatch of each program records its
+  :class:`~jaxlib.xla_extension.CompiledMemoryStats` as a
+  schema-versioned ``memory`` telemetry event plus registry gauges.
+  Everything here is host-only Python at *compile* time: the ledger adds
+  ZERO device syncs and nothing on the per-step path (the wrapped call
+  executes the exact compiled program jit would have built).
+- :func:`device_memory_summary` — live HBM watermarks
+  (``bytes_in_use`` / ``peak_bytes_in_use``) summed over ALL local
+  devices, the one shared implementation behind ``see_memory_usage``,
+  ``SynchronizedWallClockTimer.memory_usage`` and the engine's
+  print-cadence watermark sampling.  ``memory_stats()`` is a host-side
+  runtime query — no program dispatch, no ``device_get`` — so sampling
+  it at the existing ``steps_per_print`` fetch preserves the telemetry
+  zero-new-syncs invariant (asserted by the device_get-counting test;
+  the dslint DSH204 rule guards the cadence statically).
+- :class:`HostBufferRegistry` — the pinned-host buffer ledger fed by the
+  ZeRO offload coordinator (buffer count/bytes/dtype per family),
+  composing with the ``MAX_HOST_BUFFERS`` count cap and
+  ``engine.host_state_bytes_per_step()``.
+
+The AOT capacity planner (:mod:`.capacity`) consumes the same entries to
+predict peak HBM for a config *without running a step*.
+"""
+
+import threading
+
+from ..utils.logging import logger
+
+# CompiledMemoryStats fields recorded per program (device space first,
+# then the host memory space — pinned offload buffers land there on
+# backends that annotate memory spaces)
+ANALYSIS_FIELDS = (
+    "generated_code_size_in_bytes",
+    "argument_size_in_bytes",
+    "output_size_in_bytes",
+    "alias_size_in_bytes",
+    "temp_size_in_bytes",
+    "host_generated_code_size_in_bytes",
+    "host_argument_size_in_bytes",
+    "host_output_size_in_bytes",
+    "host_alias_size_in_bytes",
+    "host_temp_size_in_bytes",
+)
+
+# memory-event kinds (the ``kind`` data key of EVENT_MEMORY)
+KIND_PROGRAM = "program"
+KIND_WATERMARK = "watermark"
+KIND_HOST_BUFFERS = "host_buffers"
+
+
+def compiled_memory_entry(compiled):
+    """``{field: int}`` from one compiled executable's
+    ``memory_analysis()``, or None when the backend lacks the API
+    (fail-soft by design: observability must never take training down)."""
+    try:
+        analysis = compiled.memory_analysis()
+    except Exception as e:  # pragma: no cover - backend specific
+        logger.debug("memory_analysis unavailable: %s", e)
+        return None
+    if analysis is None:
+        return None
+    entry = {}
+    for field in ANALYSIS_FIELDS:
+        value = getattr(analysis, field, None)
+        if value is not None:
+            entry[field] = int(value)
+    return entry or None
+
+
+def predicted_peak_bytes(entry):
+    """Predicted device-memory peak of one program: arguments + outputs
+    − aliased (donated buffers reuse their argument's allocation) +
+    temporaries + the compiled code itself (executables live in HBM)."""
+    if not entry:
+        return None
+    return (entry.get("argument_size_in_bytes", 0)
+            + entry.get("output_size_in_bytes", 0)
+            - entry.get("alias_size_in_bytes", 0)
+            + entry.get("temp_size_in_bytes", 0)
+            + entry.get("generated_code_size_in_bytes", 0))
+
+
+def predicted_host_bytes(entry):
+    """Same accounting over the host memory space (pinned offload
+    buffers, on backends that annotate them)."""
+    if not entry:
+        return None
+    return (entry.get("host_argument_size_in_bytes", 0)
+            + entry.get("host_output_size_in_bytes", 0)
+            - entry.get("host_alias_size_in_bytes", 0)
+            + entry.get("host_temp_size_in_bytes", 0))
+
+
+# ---------------------------------------------------------------------------
+# Live watermarks (the one shared memory_stats() aggregation)
+# ---------------------------------------------------------------------------
+
+def device_memory_summary(devices=None):
+    """Allocation stats summed over ALL local devices.
+
+    Returns ``{"bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+    "devices", "reporting"}``; ``reporting`` counts the devices that
+    actually returned stats (0 on backends without ``memory_stats``,
+    e.g. CPU — callers must treat the sums as unavailable then).
+    Summing matters: on a multi-chip host, device 0 alone understates
+    the footprint by the local device count."""
+    out = {"bytes_in_use": 0, "peak_bytes_in_use": 0, "bytes_limit": 0,
+           "devices": 0, "reporting": 0}
+    try:
+        import jax
+
+        devices = list(devices) if devices is not None \
+            else jax.local_devices()
+    except Exception:  # dslint: disable=DSE502 -- no backend at all: report zero devices
+        return out
+    out["devices"] = len(devices)
+    for dev in devices:
+        try:
+            stats = dev.memory_stats() or {}
+        except Exception:  # pragma: no cover - backend specific
+            stats = {}
+        if stats:
+            out["reporting"] += 1
+        out["bytes_in_use"] += int(stats.get("bytes_in_use", 0))
+        out["peak_bytes_in_use"] += int(stats.get("peak_bytes_in_use", 0))
+        out["bytes_limit"] += int(stats.get("bytes_limit", 0))
+    return out
+
+
+def format_memory_summary(summary):
+    gib = 1024.0 ** 3
+    return (f"mem allocated {summary['bytes_in_use'] / gib:.4f} GB peak "
+            f"{summary['peak_bytes_in_use'] / gib:.4f} GB limit "
+            f"{summary['bytes_limit'] / gib:.4f} GB across "
+            f"{summary['reporting']}/{summary['devices']} local device(s)")
+
+
+def see_memory_usage(message, force=False):
+    """Log the cross-device memory summary (reference
+    ``see_memory_usage``, ``utils.py:547-566``).  The single shared
+    implementation behind ``runtime.utils.see_memory_usage`` and
+    ``utils.timer`` — both used to carry private copies, one of which
+    read only device 0."""
+    if not force:
+        return
+    summary = device_memory_summary()
+    if summary["reporting"] == 0:
+        logger.info(f"{message} | memory stats unavailable on this backend")
+        return
+    logger.info(f"{message} | {format_memory_summary(summary)}")
+
+
+# ---------------------------------------------------------------------------
+# Host pinned-buffer registry (fed by the ZeRO offload coordinator)
+# ---------------------------------------------------------------------------
+
+class HostBufferRegistry:
+    """Ledger of pinned-host buffer families the offload layout holds.
+
+    One entry per buffer *family* (master, each flat optimizer leaf,
+    gradients, error-feedback residuals), each a row-group tuple of at
+    most ``MAX_HOST_BUFFERS`` total buffers across families (the
+    coordinator's AOT-crash cap — see ``zero/coordinator.py``)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = []
+
+    def register(self, family, count, total_bytes, dtype):
+        with self._lock:
+            self._entries = [e for e in self._entries
+                             if e["family"] != family]
+            self._entries.append({"family": str(family), "count": int(count),
+                                  "bytes": int(total_bytes),
+                                  "dtype": str(dtype)})
+
+    def entries(self):
+        with self._lock:
+            return [dict(e) for e in self._entries]
+
+    def total_bytes(self):
+        with self._lock:
+            return sum(e["bytes"] for e in self._entries)
+
+    def total_count(self):
+        with self._lock:
+            return sum(e["count"] for e in self._entries)
+
+    def as_event_data(self):
+        return {"buffers": self.total_count(), "bytes": self.total_bytes(),
+                "families": self.entries()}
+
+
+# ---------------------------------------------------------------------------
+# MemoryLedger: per-program compile-time accounting
+# ---------------------------------------------------------------------------
+
+class _LedgeredJit:
+    """Transparent wrapper around one jitted entry point.
+
+    First call: ``fn.lower(args).compile()`` (the one backend compile jit
+    would have paid — this jax's AOT and ``__call__`` paths do NOT share
+    an executable cache, so the compiled object is kept and *executed*),
+    record its memory analysis, then run it.  Later calls execute the
+    same compiled program; any signature change (new shapes, different
+    static values, tracer arguments from an outer trace) falls back to
+    the plain jit callable, which retraces exactly as it would have
+    without the ledger."""
+
+    __slots__ = ("_ledger", "_name", "_fn", "_static_argnums", "_statics",
+                 "_compiled", "_fallback", "__weakref__")
+
+    def __init__(self, ledger, name, fn, static_argnums=()):
+        self._ledger = ledger
+        self._name = name
+        self._fn = fn
+        self._static_argnums = tuple(static_argnums)
+        self._statics = None
+        self._compiled = None
+        self._fallback = False
+
+    def _has_tracer(self, args, kwargs):
+        import jax
+
+        return any(isinstance(leaf, jax.core.Tracer) for leaf in
+                   jax.tree_util.tree_leaves((args, kwargs)))
+
+    def _drop_statics(self, args):
+        if not self._static_argnums:
+            return args
+        return tuple(a for i, a in enumerate(args)
+                     if i not in self._static_argnums)
+
+    def __call__(self, *args, **kwargs):
+        if self._fallback:
+            return self._fn(*args, **kwargs)
+        if self._compiled is None:
+            if self._has_tracer(args, kwargs):
+                # traced through by an outer transform (flops profiler's
+                # make_jaxpr): delegate without poisoning the ledger
+                return self._fn(*args, **kwargs)
+            try:
+                compiled = self._fn.lower(*args, **kwargs).compile()
+            except Exception as e:
+                self._fallback = True
+                logger.debug("memory ledger: AOT compile of %r failed "
+                             "(%s); program unrecorded", self._name, e)
+                return self._fn(*args, **kwargs)
+            self._compiled = compiled
+            self._statics = tuple(args[i] for i in self._static_argnums
+                                  if i < len(args))
+            self._ledger.record(self._name, compiled)
+        try:
+            statics = tuple(args[i] for i in self._static_argnums
+                            if i < len(args))
+            if statics != self._statics:
+                # the compiled program baked the FIRST call's static
+                # values; a different static must go through jit
+                return self._fn(*args, **kwargs)
+            return self._compiled(*self._drop_statics(args), **kwargs)
+        except TypeError:
+            if self._has_tracer(args, kwargs):
+                return self._fn(*args, **kwargs)
+            # shape/pytree change: hand this and every later call to jit
+            self._fallback = True
+            return self._fn(*args, **kwargs)
+
+    @property
+    def compiled(self):
+        return self._compiled
+
+    @property
+    def wrapped(self):
+        """The underlying jit callable (for AOT ``.lower`` users)."""
+        return self._fn
+
+
+class MemoryLedger:
+    """Per-engine ledger of compiled-program memory analyses.
+
+    ``wrap(name, jitted_fn)`` at program-build time; entries accumulate
+    as programs first dispatch.  With a :class:`TelemetryManager`
+    attached, each recording emits one ``memory`` event (kind
+    ``program``) and per-program gauges — all at compile time, never on
+    the step path."""
+
+    def __init__(self, enabled=True, telemetry=None):
+        self.enabled = bool(enabled)
+        self.telemetry = telemetry
+        self.host_buffers = HostBufferRegistry()
+        self._lock = threading.Lock()
+        self._entries = {}
+
+    # -- program accounting -------------------------------------------
+    def wrap(self, name, fn, static_argnums=()):
+        if not self.enabled:
+            return fn
+        return _LedgeredJit(self, name, fn, static_argnums=static_argnums)
+
+    def record(self, name, compiled):
+        """Record one compiled executable (fail-soft; also callable
+        directly with an AOT-compiled object, e.g. by the planner)."""
+        entry = compiled_memory_entry(compiled)
+        if entry is None:
+            with self._lock:
+                self._entries.setdefault(str(name), None)
+            return None
+        with self._lock:
+            self._entries[str(name)] = dict(entry)
+        tel = self.telemetry
+        if tel is not None and getattr(tel, "enabled", False):
+            from ..telemetry import events as TEL
+
+            tel.emit(TEL.EVENT_MEMORY, kind=KIND_PROGRAM, program=str(name),
+                     predicted_peak_bytes=predicted_peak_bytes(entry),
+                     predicted_host_bytes=predicted_host_bytes(entry),
+                     **entry)
+            for field in ("argument_size_in_bytes", "output_size_in_bytes",
+                          "temp_size_in_bytes",
+                          "generated_code_size_in_bytes"):
+                tel.gauge(f"memory/program/{name}/{field}").set(
+                    float(entry.get(field, 0)))
+            tel.gauge("memory/programs").set(float(len(self.entries())))
+        return entry
+
+    def entry(self, name):
+        with self._lock:
+            e = self._entries.get(str(name))
+        return dict(e) if e else None
+
+    def entries(self):
+        with self._lock:
+            return {k: (dict(v) if v else None)
+                    for k, v in self._entries.items()}
+
+    def predicted_peak_bytes(self, name):
+        return predicted_peak_bytes(self.entry(name))
+
+    def predicted_temp_bytes(self, name):
+        e = self.entry(name)
+        return e.get("temp_size_in_bytes") if e else None
+
+    # -- host pinned buffers ------------------------------------------
+    def record_host_buffers(self, bytes_per_step=None):
+        """Publish the host-buffer registry (one event + gauges); called
+        by the engine after the offload layout is fixed."""
+        tel = self.telemetry
+        if tel is None or not getattr(tel, "enabled", False):
+            return
+        from ..telemetry import events as TEL
+
+        data = self.host_buffers.as_event_data()
+        if bytes_per_step is not None:
+            data["state_wire_bytes_per_step"] = int(bytes_per_step)
+        tel.emit(TEL.EVENT_MEMORY, kind=KIND_HOST_BUFFERS, **data)
+        tel.gauge("memory/host_buffer_bytes").set(
+            float(self.host_buffers.total_bytes()))
+        tel.gauge("memory/host_buffers").set(
+            float(self.host_buffers.total_count()))

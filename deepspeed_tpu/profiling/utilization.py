@@ -1,0 +1,41 @@
+"""Chip peak FLOP/s table + model-FLOPs-utilisation (MFU) math.
+
+The ONE implementation shared by ``bench.py`` (three reporting sites),
+the flops profiler, and the capacity planner — utilisation numbers must
+not drift between reporters because each carried its own peak table.
+"""
+
+# bf16 peak TFLOP/s per chip, by device_kind substring (conservative
+# defaults).
+PEAK_TFLOPS = {
+    "v5 lite": 197.0,  # TPU v5e
+    "v5e": 197.0,
+    "v4": 275.0,
+    "v5p": 459.0,
+    "v6": 918.0,  # Trillium
+}
+
+# Unknown accelerators assume the fastest plausible chip so an MFU>1
+# no-sync guard never false-fails a legitimately fast device.
+DEFAULT_PEAK_TFLOPS = 990.0
+
+
+def chip_peak_tflops(device):
+    """bf16 peak TFLOP/s for one jax device (by ``device_kind``)."""
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in PEAK_TFLOPS.items():
+        if key in kind:
+            return val
+    return DEFAULT_PEAK_TFLOPS
+
+
+def achieved_tflops(samples_per_sec, flops_per_sample):
+    """Model TFLOP/s actually sustained."""
+    return samples_per_sec * flops_per_sample / 1e12
+
+
+def model_flops_utilization(samples_per_sec, flops_per_sample,
+                            peak_tflops):
+    """MFU in [0, 1] (values > 1 mean the harness measured nothing —
+    callers hard-fail on that, see ``bench.py``)."""
+    return achieved_tflops(samples_per_sec, flops_per_sample) / peak_tflops

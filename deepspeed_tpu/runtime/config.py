@@ -23,7 +23,8 @@ from . import constants as C
 from .config_utils import dict_raise_error_on_duplicate_keys, get_scalar_param
 from .zero.config import DeepSpeedZeroConfig
 from .activation_checkpointing.config import DeepSpeedActivationCheckpointingConfig
-from ..profiling.config import DeepSpeedFlopsProfilerConfig
+from ..profiling.config import (DeepSpeedFlopsProfilerConfig,
+                                DeepSpeedProfilingConfig)
 from ..checkpoint.config import DeepSpeedCheckpointConfig
 from ..resilience.config import DeepSpeedResilienceConfig
 from ..telemetry.config import DeepSpeedTelemetryConfig
@@ -352,6 +353,7 @@ class DeepSpeedConfig:
 
         self.activation_checkpointing_config = DeepSpeedActivationCheckpointingConfig(param_dict)
         self.flops_profiler_config = DeepSpeedFlopsProfilerConfig(param_dict)
+        self.profiling_config = DeepSpeedProfilingConfig(param_dict)
         self.checkpoint_config = DeepSpeedCheckpointConfig(param_dict)
         self.resilience_config = DeepSpeedResilienceConfig(param_dict)
         self.telemetry_config = DeepSpeedTelemetryConfig(param_dict)

@@ -374,6 +374,26 @@ TELEMETRY_DEVICE_TRACE_TRIGGER = "device_trace_trigger"
 TELEMETRY_DEVICE_TRACE_TRIGGER_DEFAULT = ""
 
 #############################################
+# Profiling subsystem (deepspeed_tpu/profiling; the "flops_profiler"
+# block keeps its reference-parity shape in profiling/config.py — this
+# block holds the NEW memory-observability knobs)
+#############################################
+PROFILING = "profiling"
+# compiled-program HBM ledger (profiling/memory.MemoryLedger): records
+# each engine program's memory_analysis() as telemetry events/gauges at
+# compile time.  "auto" follows telemetry.enabled; true forces it on
+# even without telemetry (entries still queryable via
+# engine.memory_ledger, e.g. for bench receipts); false disables
+PROFILING_MEMORY_LEDGER = "memory_ledger"
+PROFILING_MEMORY_LEDGER_DEFAULT = "auto"
+# live HBM watermark gauges/events (bytes_in_use/peak summed over local
+# devices + the host pinned-buffer registry), sampled ONLY at the
+# existing batched steps_per_print fetch — zero new per-step syncs.
+# "auto" follows telemetry.enabled
+PROFILING_MEMORY_WATERMARKS = "memory_watermarks"
+PROFILING_MEMORY_WATERMARKS_DEFAULT = "auto"
+
+#############################################
 # Compilation subsystem (deepspeed_tpu/runtime/compilation; new — the
 # reference has no compile-time story: CUDA kernels JIT per-op.  Under
 # XLA whole-program compiles are minutes-to-tens-of-minutes at offload

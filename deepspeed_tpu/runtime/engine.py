@@ -130,7 +130,8 @@ def initialize(args=None,
                config=None,
                config_params=None,
                mesh=None,
-               auto_resume=False):
+               auto_resume=False,
+               aot_plan=False):
     """Initialize the DeepSpeed-TPU engine (reference ``__init__.py:50-139``).
 
     Returns ``(engine, optimizer, training_dataloader, lr_scheduler)``.
@@ -141,6 +142,11 @@ def initialize(args=None,
     respawn half of the resilience contract: a launcher restarting a
     crashed/hung job re-runs the same script and lands on the last good
     step instead of step 0.
+
+    With ``aot_plan=True`` the engine builds and jits its step programs
+    but never materializes device-resident module params — the AOT
+    capacity planner's mode (``profiling/capacity.py``): lower + compile
+    the train step and read ``memory_analysis()`` without running it.
     """
     log_dist("DeepSpeed-TPU initialize", ranks=[0])
     from .pipe.module import PipelineModule
@@ -160,7 +166,8 @@ def initialize(args=None,
                                  training_data=training_data, lr_scheduler=lr_scheduler,
                                  mpu=mpu, dist_init_required=dist_init_required,
                                  collate_fn=collate_fn, config=config,
-                                 config_params=config_params, mesh=mesh)
+                                 config_params=config_params, mesh=mesh,
+                                 aot_plan=aot_plan)
     if auto_resume:
         load_dir = engine.resilience_config.checkpoint_dir
         if load_dir is None:
@@ -183,7 +190,8 @@ class DeepSpeedEngine:
     def __init__(self, args=None, model=None, optimizer=None, model_parameters=None,
                  training_data=None, lr_scheduler=None, mpu=None,
                  dist_init_required=None, collate_fn=None, config=None,
-                 config_params=None, mesh=None, dont_build_steps=False):
+                 config_params=None, mesh=None, dont_build_steps=False,
+                 aot_plan=False):
         assert model is not None, "deepspeed.initialize requires a model"
         if dist_init_required or dist_init_required is None:
             init_distributed()
@@ -627,6 +635,23 @@ class DeepSpeedEngine:
             from .compilation import install_compile_telemetry
 
             install_compile_telemetry(self.telemetry)
+
+        # -- memory observability (deepspeed_tpu/profiling/memory): the
+        # compiled-program ledger wraps every jit entry point built in
+        # _build_step_functions (memory_analysis recorded at compile
+        # time); HBM watermarks + the host-buffer registry are sampled
+        # ONLY at the steps_per_print cadence — zero new per-step syncs
+        from ..profiling.memory import MemoryLedger
+
+        self.profiling_config = self._config.profiling_config
+        self._aot_plan = bool(aot_plan)
+        self.memory_ledger = MemoryLedger(
+            enabled=(self.profiling_config.memory_ledger_enabled(
+                self.telemetry.enabled) or self._aot_plan),
+            telemetry=self.telemetry)
+        self._memory_watermarks = (
+            self.profiling_config.memory_watermarks_enabled(
+                self.telemetry.enabled))
         self.telemetry.emit(
             TEL.EVENT_RUN_START, step=0, world_size=self.world_size,
             dp=self.dp_world_size,
@@ -650,8 +675,9 @@ class DeepSpeedEngine:
 
         if not dont_build_steps:
             self._build_step_functions()
-            with self.mesh:
-                self._refresh_module_params()
+            if not self._aot_plan:
+                with self.mesh:
+                    self._refresh_module_params()
 
         # -- checkpoint subsystem (deepspeed_tpu/checkpoint) --
         self.checkpoint_config = self._config.checkpoint_config
@@ -812,6 +838,135 @@ class DeepSpeedEngine:
             stalled_secs=float(stalled_secs),
             timeout_secs=float(self.resilience_config.hang_timeout_secs))
         self.telemetry.flush(reason="watchdog_hang")
+
+    # ------------------------------------------------------------------
+    # memory observability (deepspeed_tpu/profiling/memory)
+    # ------------------------------------------------------------------
+    def _host_buffer_families(self):
+        """{family: [buffers]} over every pinned-host array the offload
+        layout holds: the flat master, each flat optimizer leaf, the
+        host gradient buffer, and any error-feedback residuals — each a
+        row-group tuple under the coordinator's shared layout."""
+        families = {}
+
+        def add(family, val):
+            if val is None:
+                return
+            for g in (val if type(val) is tuple else (val,)):
+                families.setdefault(family, []).append(g)
+
+        add("master", self.state.get("master"))
+        flat, _ = jax.tree_util.tree_flatten_with_path(
+            self.state.get("opt"))
+        for path, leaf in flat:
+            if getattr(leaf, "ndim", 0) == 2 and leaf.shape[-1] == LANES:
+                key = tree_path_key(path).lstrip("/")
+                parts = key.split("/")
+                # row-group tuples flatten to <leaf>/<index>; fold the
+                # group members back into one family
+                if parts[-1].isdigit():
+                    key = "/".join(parts[:-1])
+                families.setdefault(f"opt/{key}", []).append(leaf)
+        add("grads", self.state.get("hostgrad"))
+        for name, val in (self.state.get("qres") or {}).items():
+            add(f"qres/{name}", val)
+        return families
+
+    def _register_host_buffers(self):
+        """Feed the ledger's host-buffer registry from the live offload
+        state and publish it (one memory event + gauges).  Build-time
+        only — never on the step path."""
+        from .zero.coordinator import MAX_HOST_BUFFERS
+
+        registry = self.memory_ledger.host_buffers
+        for family, bufs in self._host_buffer_families().items():
+            registry.register(
+                family, len(bufs),
+                sum(int(b.size) * b.dtype.itemsize for b in bufs),
+                str(bufs[0].dtype))
+        bounds, groups_per_family = self.flat.host_buffer_layout()
+        state_families = [e for e in registry.entries()
+                         if e["family"] == "master"
+                         or e["family"].startswith("opt/")]
+        state_only = sum(e["count"] for e in state_families)
+        if state_only > MAX_HOST_BUFFERS:
+            logger.warning(
+                "host-buffer registry: %d state buffers exceed the "
+                "MAX_HOST_BUFFERS=%d layout cap (%d group(s) x %d "
+                "family(ies)) — expect AOT-helper instability",
+                state_only, MAX_HOST_BUFFERS, groups_per_family,
+                len(state_families))
+        self.memory_ledger.record_host_buffers(
+            bytes_per_step=self._host_state_bytes_per_step)
+
+    def _sample_memory_watermarks(self):
+        """Live HBM watermarks + host-buffer bytes at the steps_per_print
+        cadence.  ``memory_stats()`` is a host-side runtime query — no
+        program dispatch, no ``device_get`` — so this adds ZERO per-step
+        host syncs (the device_get-counting telemetry test covers a
+        memory-enabled run; dslint DSH204 guards the cadence)."""
+        if not self._memory_watermarks or not self.telemetry.enabled:
+            return
+        from ..profiling.memory import KIND_WATERMARK, device_memory_summary
+
+        summary = device_memory_summary()
+        if summary["reporting"]:
+            self.telemetry.gauge("memory/device_bytes_in_use").set(
+                float(summary["bytes_in_use"]))
+            self.telemetry.gauge("memory/device_peak_bytes_in_use").set(
+                float(summary["peak_bytes_in_use"]))
+            self.telemetry.gauge("memory/device_bytes_limit").set(
+                float(summary["bytes_limit"]))
+        self.telemetry.emit(
+            TEL.EVENT_MEMORY, step=self.global_steps, kind=KIND_WATERMARK,
+            bytes_in_use=summary["bytes_in_use"],
+            peak_bytes_in_use=summary["peak_bytes_in_use"],
+            bytes_limit=summary["bytes_limit"],
+            devices=summary["devices"], reporting=summary["reporting"],
+            host_buffer_bytes=self.memory_ledger.host_buffers.total_bytes())
+
+    def aot_compile_train_step(self, sample_batch):
+        """Lower + compile the fused train-step program WITHOUT running
+        it, and record its ``memory_analysis()`` in the ledger.
+
+        ``sample_batch`` is one host micro-batch pytree of the training
+        shapes (numpy; nothing is transferred).  State/optimizer
+        arguments lower from the engine's real (host-resident, under
+        offload) buffers, module params from their abstract shapes — so
+        with ``aot_plan=True`` nothing model-sized ever lands in device
+        memory.  Returns ``(compiled, ledger_entry)``; the entry is None
+        when the backend lacks ``memory_analysis``.  The AOT capacity
+        planner's core (``python -m deepspeed_tpu.profiling.capacity``);
+        warm under the persistent compile cache."""
+        from ..profiling.memory import _LedgeredJit
+
+        acc = self.gradient_accumulation_steps()
+        packed_host, spec = _pack_batches([sample_batch] * acc)
+        batch_sharding = NamedSharding(self.mesh, P(None, DATA_AXIS, None))
+        packed_sds = {k: jax.ShapeDtypeStruct(v.shape, v.dtype,
+                                              sharding=batch_sharding)
+                      for k, v in packed_host.items()}
+        if self.zero_stage >= 3:
+            params_arg = None
+        elif self._module_params is not None:
+            params_arg = self._module_params
+        else:
+            # abstract module params (plan mode never materializes them)
+            cast = self._cast_params_fn
+            cast = cast.wrapped if isinstance(cast, _LedgeredJit) else cast
+            params_arg = jax.eval_shape(cast, self.state["master"])
+        fn = self._train_step_fn
+        raw = fn.wrapped if isinstance(fn, _LedgeredJit) else fn
+        with self.mesh:
+            lowered = raw.lower(
+                self.state["master"], self.state["opt"], self.state["scale"],
+                self.state["skipped"], self.state["ustep"], params_arg,
+                packed_sds, spec, self._device_hyperparams(),
+                self._segment_ids, self._extra_kwargs(),
+                self.state.get("hostgrad"), self.state.get("qres"))
+            compiled = lowered.compile()
+        entry = self.memory_ledger.record("train_step", compiled)
+        return compiled, entry
 
     def close(self):
         """Flush + close every telemetry sink (events, trace, metrics
@@ -1617,8 +1772,9 @@ class DeepSpeedEngine:
                 lambda x, s: jax.lax.with_sharding_constraint(x, s),
                 params, param_shardings)
 
-        self._cast_params_fn = jax.jit(cast_params,
-                                       out_shardings=param_shardings)
+        self._cast_params_fn = self.memory_ledger.wrap(
+            "cast_params", jax.jit(cast_params,
+                                   out_shardings=param_shardings))
 
         sparse_paths = tuple(self._sparse_grad_paths)
         dp_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get(
@@ -1744,14 +1900,16 @@ class DeepSpeedEngine:
             params = cast_params(params_or_master) if stage3 else params_or_master
             return loss_and_flat_grads(params, batch, rng, cur_scale, extra)
 
-        self._fwd_bwd_fn = jax.jit(
-            fwd_bwd, out_shardings=(None, grad_sharding, None))
+        self._fwd_bwd_fn = self.memory_ledger.wrap(
+            "fwd_bwd", jax.jit(
+                fwd_bwd, out_shardings=(None, grad_sharding, None)))
 
         def accum(acc, g):
             return acc + g
 
-        self._accum_fn = jax.jit(accum, donate_argnums=(0,),
-                                 out_shardings=grad_sharding)
+        self._accum_fn = self.memory_ledger.wrap(
+            "accum", jax.jit(accum, donate_argnums=(0,),
+                             out_shardings=grad_sharding))
 
         def apply_update(master, opt_state, scale_state, skipped, flat_g, hp,
                          segment_ids, qres=None, want_cast=False):
@@ -1822,19 +1980,21 @@ class DeepSpeedEngine:
                 k: (tuple(host_big for _ in v) if type(v) is tuple
                     else host_big)
                 for k, v in self.state["qres"].items()}
-        self._apply_fn = jax.jit(
-            apply_update,
-            donate_argnums=(0, 1, 4) + ((7,) if self.state.get("qres")
-                                        else ()),
-            out_shardings=(master_out_sharding, opt_out_shardings,
-                           None, None, None, None, qres_sharding))
+        self._apply_fn = self.memory_ledger.wrap(
+            "apply_update", jax.jit(
+                apply_update,
+                donate_argnums=(0, 1, 4) + ((7,) if self.state.get("qres")
+                                            else ()),
+                out_shardings=(master_out_sharding, opt_out_shardings,
+                               None, None, None, None, qres_sharding)))
 
         def eval_fwd(params_or_master, batch, rng, extra):
             set_current_mesh(mesh)
             params = cast_params(params_or_master) if stage3 else params_or_master
             return self._loss_fn(params, batch, rng=rng, train=False, **extra)
 
-        self._eval_fn = jax.jit(eval_fwd)
+        self._eval_fn = self.memory_ledger.wrap("eval_fwd",
+                                                jax.jit(eval_fwd))
 
         # -- fully fused train step -------------------------------------
         # One compiled program per optimizer step: micro-batch scan
@@ -1937,14 +2097,16 @@ class DeepSpeedEngine:
             donate = donate + (11,)
         if self.state.get("qres"):
             donate = donate + (12,)
-        self._train_step_fn = jax.jit(
-            train_step,
-            static_argnums=(7,),
-            donate_argnums=donate,
-            out_shardings=(None, master_out_sharding, opt_out_shardings, None,
-                           None, None, None, None,
-                           None if stage3 else param_shardings, None,
-                           hostgrad_sharding, qres_sharding))
+        self._train_step_fn = self.memory_ledger.wrap(
+            "train_step", jax.jit(
+                train_step,
+                static_argnums=(7,),
+                donate_argnums=donate,
+                out_shardings=(None, master_out_sharding, opt_out_shardings,
+                               None, None, None, None, None,
+                               None if stage3 else param_shardings, None,
+                               hostgrad_sharding, qres_sharding)),
+            static_argnums=(7,))
 
         # 1-bit Adam compressed phase: a second program with NO dense
         # gradient allreduce (host-side phase switch at freeze_step — the
@@ -1970,14 +2132,25 @@ class DeepSpeedEngine:
                     "warmup (dense) phase; the compressed phase exchanges "
                     "1-bit momenta and cannot clip by global grad norm "
                     "(matches reference onebit_adam.py behavior)", clip)
-            self._train_step_compressed_fn = optimizer.build_compressed_step(
-                mesh=mesh, loss_fn=self._loss_fn, flat_coordinator=self.flat,
-                param_template=self._param_template,
-                compute_dtype=self.compute_dtype,
-                param_shardings=param_shardings, unpack_fn=_unpack_batches,
-                acc_steps=acc_steps, base_rng=base_rng,
-                master_sharding=master_sharding,
-                opt_shardings=self._opt_shardings)
+            self._train_step_compressed_fn = self.memory_ledger.wrap(
+                "train_step_compressed", optimizer.build_compressed_step(
+                    mesh=mesh, loss_fn=self._loss_fn,
+                    flat_coordinator=self.flat,
+                    param_template=self._param_template,
+                    compute_dtype=self.compute_dtype,
+                    param_shardings=param_shardings,
+                    unpack_fn=_unpack_batches,
+                    acc_steps=acc_steps, base_rng=base_rng,
+                    master_sharding=master_sharding,
+                    opt_shardings=self._opt_shardings),
+                static_argnums=(7,))
+
+        # host pinned-buffer registry (profiling/memory): one entry per
+        # buffer family, fed by the coordinator's row-group layout —
+        # published as a memory event + gauges, composing with the
+        # MAX_HOST_BUFFERS count cap and host_state_bytes_per_step
+        if self._offload:
+            self._register_host_buffers()
 
     @staticmethod
     def _try_host_init(model, init_rng):
@@ -2250,6 +2423,7 @@ class DeepSpeedEngine:
                 "Train/Samples/lr": lr,
                 "Train/Samples/loss_scale": scale,
             }, skipped=int(stats["skipped"]))
+            self._sample_memory_watermarks()
         self._losses = []
         if self._config.memory_breakdown:
             from .utils import see_memory_usage
@@ -2478,6 +2652,7 @@ class DeepSpeedEngine:
                 "Train/Samples/lr": lr,
                 "Train/Samples/loss_scale": scale,
             }, skipped=int(stats["skipped"]))
+            self._sample_memory_watermarks()
         if self.wall_clock_breakdown():
             # the fused program has no forward/step boundary to time
             # separately; report the whole fused step

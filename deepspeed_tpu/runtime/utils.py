@@ -15,8 +15,6 @@ from typing import List
 import jax
 import jax.numpy as jnp
 
-from ..utils.logging import logger
-
 
 def is_model_parallel_parameter(p) -> bool:
     return getattr(p, "model_parallel", False)
@@ -174,14 +172,10 @@ def prefix_sum_inc(weights: List[int]) -> List[int]:
 # ---------------------------------------------------------------------------
 
 def see_memory_usage(message: str, force: bool = False):
-    if not force:
-        return
-    try:
-        stats = jax.local_devices()[0].memory_stats() or {}
-        gb = 1024 ** 3
-        logger.info(
-            f"{message} | device alloc {stats.get('bytes_in_use', 0)/gb:.2f} GB | "
-            f"peak {stats.get('peak_bytes_in_use', 0)/gb:.2f} GB | "
-            f"limit {stats.get('bytes_limit', 0)/gb:.2f} GB")
-    except Exception:
-        logger.info(f"{message} | memory stats unavailable on this backend")
+    """Cross-device memory summary (ALL local devices summed — this used
+    to read device 0 only, understating multi-chip hosts).  The one
+    implementation lives in :mod:`deepspeed_tpu.profiling.memory`, shared
+    with ``utils.timer`` and the engine's watermark sampling."""
+    from ..profiling.memory import see_memory_usage as _impl
+
+    _impl(message, force=force)

@@ -257,6 +257,15 @@ class FlatParamCoordinator:
                           memory_kind=self._host_memory_kind)
             if cpu_offload else None)
 
+    def host_buffer_layout(self):
+        """(row-group bounds, buffers-per-family) of the pinned-host
+        layout — what the memory observability host-buffer registry
+        (``profiling/memory.HostBufferRegistry``) reports per family,
+        and what the :data:`MAX_HOST_BUFFERS` count cap (families ×
+        groups, the observed AOT-crash mode) was derived against."""
+        bounds = self.host_group_bounds or ((0, self.segments.rows),)
+        return bounds, len(bounds)
+
     def alloc_host_grads(self):
         """Pinned-host zero-filled flat gradient buffer (grouped like the
         master); donated in/out of every fused step under

@@ -52,6 +52,12 @@ EVENT_PROC_RESPAWN = "proc_respawn"
 # hits/misses ride the metrics registry as compile/cache_hit|miss
 # counters — they are high-frequency bookkeeping, not timeline moments
 EVENT_COMPILE = "compile"
+# memory observability (profiling/memory): ``kind`` selects the payload
+# shape — "program" (one per compiled program: memory_analysis bytes),
+# "watermark" (live HBM in-use/peak summed over local devices, sampled
+# only at the steps_per_print cadence), "host_buffers" (the pinned-host
+# offload buffer registry)
+EVENT_MEMORY = "memory"
 
 # type -> required data keys.  The report CLI and the golden-schema test
 # validate against this table; emitting an unknown type or dropping a
@@ -75,6 +81,7 @@ EVENT_TYPES = {
     EVENT_PROC_EXIT: ("proc_rank", "code"),
     EVENT_PROC_RESPAWN: ("proc_rank", "restart", "backoff_secs"),
     EVENT_COMPILE: ("duration_secs",),
+    EVENT_MEMORY: ("kind",),
 }
 
 

@@ -12,7 +12,10 @@ per-rank event streams (``events-rank*.jsonl``) and metric snapshots
   rank;
 - with ``--prometheus``, a Prometheus text-exposition dump of the merged
   metric snapshots (for scraping a finished or running job's artifacts);
-- with ``--json``, the merged event list as JSON (for tooling).
+- with ``--json``, the merged event list as JSON (for tooling);
+- with ``--diff OLD NEW``, a threshold-gated diff of two
+  ``BENCH_r*.json`` driver artifacts (``tools/bench_diff.py`` — the
+  bench regression gate; ``run_dir`` is optional in this mode).
 
 Stdlib-only: runs anywhere the artifacts are mounted, no jax required.
 """
@@ -165,8 +168,10 @@ def main(argv=None):
     sub = parser.add_subparsers(dest="command", required=True)
     rep = sub.add_parser("report",
                          help="timeline + metric summary for one run dir")
-    rep.add_argument("run_dir", help="telemetry run directory "
-                                     "(holds events-rank*.jsonl)")
+    rep.add_argument("run_dir", nargs="?", default=None,
+                     help="telemetry run directory "
+                          "(holds events-rank*.jsonl); optional with "
+                          "--diff")
     rep.add_argument("--prometheus", action="store_true",
                      help="emit a Prometheus text dump instead of the "
                           "human report")
@@ -174,14 +179,44 @@ def main(argv=None):
                      help="emit the merged event list as JSON")
     rep.add_argument("--strict", action="store_true",
                      help="fail on undecodable event lines")
+    rep.add_argument("--diff", nargs=2, metavar=("OLD", "NEW"),
+                     help="diff two BENCH_r*.json driver artifacts with "
+                          "the bench_schema regression thresholds")
     args = parser.parse_args(argv)
 
+    diff_regressed = False
+    if args.diff:
+        from ..tools.bench_diff import (diff_records, format_diff,
+                                        load_bench_record, regressions)
+
+        old_path, new_path = args.diff
+        try:
+            diffs = diff_records(load_bench_record(old_path),
+                                 load_bench_record(new_path))
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        diff_regressed = bool(regressions(diffs))
+        if args.as_json:
+            # one JSON document only: --json + --diff emits the diff
+            # rows and skips the run report even when run_dir is given
+            json.dump(diffs, sys.stdout, indent=1)
+            sys.stdout.write("\n")
+            return 1 if diff_regressed else 0
+        print(format_diff(diffs, old_path, new_path))
+        if args.run_dir is None:
+            return 1 if diff_regressed else 0
+        print()
+
+    if args.run_dir is None:
+        print("error: run_dir is required without --diff", file=sys.stderr)
+        return 2
     if not os.path.isdir(args.run_dir):
         print(f"error: {args.run_dir} is not a directory", file=sys.stderr)
         return 2
     if args.prometheus:
         sys.stdout.write(prometheus_dump(args.run_dir))
-        return 0
+        return 1 if diff_regressed else 0
     if args.as_json:
         records = ev.read_events(args.run_dir, strict=args.strict)
         json.dump(records, sys.stdout, indent=1)
@@ -189,4 +224,5 @@ def main(argv=None):
         return 0
     text, records = generate_report(args.run_dir, strict=args.strict)
     sys.stdout.write(text)
-    return 0 if records else 1
+    # a regressed --diff gates the combined form too (CI relies on it)
+    return 1 if (diff_regressed or not records) else 0

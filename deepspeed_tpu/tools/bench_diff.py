@@ -1,0 +1,173 @@
+"""Bench regression gate: diff two driver-bench JSON artifacts.
+
+``python tools/bench_diff.py OLD.json NEW.json`` (or
+``python -m deepspeed_tpu.telemetry report --diff OLD NEW``) compares
+two ``BENCH_r*.json`` records field by field using the per-field
+thresholds registered in :mod:`.bench_schema` — the BENCH trajectory
+becomes a *checked* artifact instead of a pile of JSON to eyeball.
+
+Classification per shared numeric field (direction + rel_tol from
+``bench_schema.threshold_for``):
+
+- **regressed** — moved against its direction by more than rel_tol;
+- **improved** — moved with its direction by more than rel_tol;
+- **ok** — within tolerance;
+- **info** — no threshold registered (diffed, never gated).
+
+Added/removed fields and non-numeric changes are reported as such.
+Exit code 1 when any field regressed (``--no-fail`` suppresses), 0
+otherwise.  ``--self-check A B C ...`` diffs each consecutive pair and
+always exits 0 — the CI mode over the checked-in historical sequence
+(threshold violations report; history is evidence, not a failure).
+
+Stdlib-only (like the rest of the telemetry readers): runs anywhere the
+artifacts are mounted, no jax required.
+"""
+
+import argparse
+import json
+import numbers
+import sys
+
+from .bench_schema import threshold_for
+
+STATUS_ORDER = ("regressed", "improved", "changed", "added", "removed",
+                "ok", "info")
+
+
+def load_bench_record(path):
+    """A bench record from ``path`` — either the raw one-line record or
+    the driver wrapper ``{"parsed": {...}, ...}``."""
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if isinstance(data, dict) and isinstance(data.get("parsed"), dict):
+        return data["parsed"]
+    if not isinstance(data, dict):
+        raise ValueError(f"{path}: not a JSON object")
+    return data
+
+
+def _is_num(v):
+    return isinstance(v, numbers.Real) and not isinstance(v, bool)
+
+
+def diff_records(old, new):
+    """List of per-field diff dicts (``STATUS_ORDER``-sorted):
+    ``{field, old, new, rel_change, direction, rel_tol, status}``."""
+    out = []
+    for field in sorted(set(old) | set(new)):
+        o, n = old.get(field), new.get(field)
+        direction, rel_tol = threshold_for(field)
+        row = {"field": field, "old": o, "new": n,
+               "direction": direction, "rel_tol": rel_tol,
+               "rel_change": None}
+        if field not in old:
+            row["status"] = "added"
+        elif field not in new:
+            row["status"] = "removed"
+        elif not (_is_num(o) and _is_num(n)):
+            row["status"] = "ok" if o == n else "changed"
+        else:
+            rel = (n - o) / abs(o) if o else (0.0 if n == o else
+                                              float("inf"))
+            row["rel_change"] = rel
+            if direction is None:
+                row["status"] = "info"
+            else:
+                signed = rel if direction == "higher" else -rel
+                if signed < -rel_tol:
+                    row["status"] = "regressed"
+                elif signed > rel_tol:
+                    row["status"] = "improved"
+                else:
+                    row["status"] = "ok"
+        out.append(row)
+    out.sort(key=lambda r: (STATUS_ORDER.index(r["status"]), r["field"]))
+    return out
+
+
+def regressions(diffs):
+    return [d for d in diffs if d["status"] == "regressed"]
+
+
+def _fmt_val(v):
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+def format_diff(diffs, old_name="old", new_name="new", verbose=False):
+    """Human-readable diff lines; ``verbose`` includes ok/info rows."""
+    lines = [f"bench diff: {old_name} -> {new_name}"]
+    shown = 0
+    for d in diffs:
+        if not verbose and d["status"] in ("ok", "info"):
+            continue
+        shown += 1
+        rel = ("" if d["rel_change"] is None
+               else f" ({d['rel_change']:+.1%})")
+        gate = ("" if d["direction"] is None
+                else f" [{d['direction']}-is-better, tol "
+                     f"{d['rel_tol']:.0%}]")
+        lines.append(f"  {d['status'].upper():<10} {d['field']}: "
+                     f"{_fmt_val(d['old'])} -> {_fmt_val(d['new'])}"
+                     f"{rel}{gate}")
+    n_reg = len(regressions(diffs))
+    if shown == 0:
+        lines.append("  (no changes outside tolerance)")
+    lines.append(f"  {len(diffs)} field(s) compared, {n_reg} regression(s)")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="bench_diff",
+        description="Diff two BENCH_r*.json artifacts with per-field "
+                    "regression thresholds from bench_schema")
+    parser.add_argument("artifacts", nargs="+",
+                        help="two bench JSON files (or, with "
+                             "--self-check, a whole sequence)")
+    parser.add_argument("--self-check", action="store_true",
+                        help="diff each consecutive pair; report "
+                             "violations, always exit 0 (CI mode over "
+                             "the checked-in history)")
+    parser.add_argument("--no-fail", action="store_true",
+                        help="exit 0 even on regressions")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit the diff rows as JSON")
+    parser.add_argument("--verbose", action="store_true",
+                        help="include within-tolerance fields")
+    args = parser.parse_args(argv)
+
+    if args.self_check:
+        if len(args.artifacts) < 2:
+            print("error: --self-check needs at least two artifacts",
+                  file=sys.stderr)
+            return 2
+        for old_path, new_path in zip(args.artifacts, args.artifacts[1:]):
+            diffs = diff_records(load_bench_record(old_path),
+                                 load_bench_record(new_path))
+            print(format_diff(diffs, old_path, new_path,
+                              verbose=args.verbose))
+            print()
+        return 0
+
+    if len(args.artifacts) != 2:
+        print("error: expected exactly two artifacts (or --self-check)",
+              file=sys.stderr)
+        return 2
+    old_path, new_path = args.artifacts
+    diffs = diff_records(load_bench_record(old_path),
+                         load_bench_record(new_path))
+    if args.as_json:
+        json.dump(diffs, sys.stdout, indent=1)
+        sys.stdout.write("\n")
+    else:
+        print(format_diff(diffs, old_path, new_path, verbose=args.verbose))
+    if regressions(diffs) and not args.no_fail:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -56,6 +56,14 @@ FIELDS = {
     "compile_seconds_warm": (numbers.Real, "s, cache-hit retrieval wall"),
     "compile_programs": (numbers.Integral, ""),
     "compile_cache_dir": (str, ""),
+    # memory receipts (round 7, profiling/memory): live watermark after
+    # the primary row + the compiled train-step program's own
+    # memory_analysis figures — "did the step fit, and by how much" is
+    # checkable from the JSON alone
+    "peak_hbm_bytes": (numbers.Integral,
+                       "peak_bytes_in_use summed over local devices"),
+    "predicted_temp_bytes": (numbers.Integral,
+                             "train_step memory_analysis temp bytes"),
 }
 
 # offload row fields: offload_<row>_<field>
@@ -68,6 +76,11 @@ _OFFLOAD_ROW_FIELDS = {
     "host_state_dtype": str,
     "host_state_bytes_per_step": numbers.Integral,
     "host_groups": numbers.Integral,
+    # memory receipts (round 7): per-row watermark + compile-time
+    # prediction + pinned-host registry total
+    "peak_hbm_bytes": numbers.Integral,
+    "predicted_temp_bytes": numbers.Integral,
+    "host_buffer_bytes": numbers.Integral,
     "error": str,
     "note": str,
 }
@@ -78,6 +91,56 @@ _OFFLOAD_RE = re.compile(
 # `<row>_error` (invalid-measurement reports, e.g. gpt2_error,
 # seq512_error) — both carry prose, never metrics
 _EXC_RE = re.compile(r"^[a-z0-9_]+_(exc|error)$")
+
+
+# -- regression-gate thresholds (tools/bench_diff.py) -----------------------
+#
+# field -> (direction, rel_tol).  direction "higher" = bigger is better
+# (throughput, MFU), "lower" = smaller is better (step time, bytes);
+# a change against the direction by more than rel_tol of the old value
+# is a REGRESSION.  Fields absent here (and (None, None) entries) are
+# informational: diffed, never gated — loss wobbles, device strings,
+# cold-compile walls that legitimately differ between cold/warm runs.
+THRESHOLDS = {
+    "value": ("higher", 0.05),
+    "vs_baseline": ("higher", 0.05),
+    "model_tflops_per_sec": ("higher", 0.05),
+    "mfu": ("higher", 0.05),
+    "batch": ("higher", 0.0),            # a downgraded-batch retry must show
+    "seq512_batch": ("higher", 0.0),
+    "gpt2_batch": ("higher", 0.0),
+    "seq512_samples_per_sec": ("higher", 0.05),
+    "seq512_vs_baseline": ("higher", 0.05),
+    "seq512_mfu": ("higher", 0.05),
+    "gpt2_medium_seq1024_samples_per_sec": ("higher", 0.05),
+    "gpt2_medium_tokens_per_sec": ("higher", 0.05),
+    "gpt2_mfu": ("higher", 0.05),
+    "sparse_attn_speedup_vs_dense": ("higher", 0.10),
+    "compile_seconds_warm": ("lower", 0.50),
+    "peak_hbm_bytes": ("lower", 0.10),
+    "predicted_temp_bytes": ("lower", 0.10),
+}
+
+# thresholds for the pattern-based offload_<row>_<field> family
+_OFFLOAD_FIELD_THRESHOLDS = {
+    "ms_per_step": ("lower", 0.10),
+    "host_state_bytes_per_step": ("lower", 0.01),
+    "peak_hbm_bytes": ("lower", 0.10),
+    "predicted_temp_bytes": ("lower", 0.10),
+    "host_buffer_bytes": ("lower", 0.10),
+}
+
+
+def threshold_for(key):
+    """(direction, rel_tol) for a record key; (None, None) =
+    informational (never gated)."""
+    if key in THRESHOLDS:
+        return THRESHOLDS[key]
+    m = _OFFLOAD_RE.match(key)
+    if m:
+        return _OFFLOAD_FIELD_THRESHOLDS.get(m.group("field"),
+                                             (None, None))
+    return (None, None)
 
 
 def field_type(key):

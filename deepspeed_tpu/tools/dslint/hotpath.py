@@ -98,6 +98,19 @@ register_rule(Rule(
                  "jax.device_get(...) before the loop."))
 
 register_rule(Rule(
+    id="DSH204", name="driver-memory-introspection", severity="warning",
+    summary="memory_stats()/memory_analysis() on the per-step hot path",
+    rationale="Device memory introspection is a host-side runtime query "
+              "per device per call; on the step path it serializes host "
+              "prep against the runtime and breaks the telemetry "
+              "zero-new-syncs ledger contract (memory watermarks are "
+              "sampled only at the steps_per_print cadence, and "
+              "memory_analysis belongs at compile time).",
+    autofix_hint="Route through profiling.memory: device_memory_summary "
+                 "at the existing steps_per_print batched fetch, "
+                 "MemoryLedger.record at program-build time."))
+
+register_rule(Rule(
     id="DSH203", name="driver-unbatched-sync", severity="warning",
     summary="multiple separate host-sync sites in one driver function",
     rationale="Each device_get/.item()/sync-property read is an "
@@ -166,6 +179,14 @@ def _is_scalar_cast(node: ast.Call) -> bool:
     return not _is_static_expr(node.args[0])
 
 
+_MEMORY_INTROSPECTION_ATTRS = ("memory_stats", "memory_analysis")
+
+
+def _is_memory_introspection(node: ast.Call) -> bool:
+    return (isinstance(node.func, ast.Attribute)
+            and node.func.attr in _MEMORY_INTROSPECTION_ATTRS)
+
+
 def _is_device_enum(expr) -> bool:
     return (isinstance(expr, ast.Call)
             and call_name(expr).rsplit(".", 1)[-1] in ("devices",
@@ -196,6 +217,11 @@ def _check_hot_function(pf: ParsedFile, index: ModuleIndex, fn) -> List:
                 out.append(diag(pf, node, "DSH104",
                                 f"print() {where}: runs once at trace "
                                 "time; use jax.debug.print"))
+            elif _is_memory_introspection(node):
+                out.append(diag(pf, node, "DSH204",
+                                f".{node.func.attr}() {where}: memory "
+                                "introspection evaluates once at trace "
+                                "time and is a per-device host query"))
             elif call_name(node) in _CLOCK_CALLS:
                 out.append(diag(pf, node, "DSH105",
                                 f"{call_name(node)}() {where}: wall clock "
@@ -278,6 +304,13 @@ def _check_driver_function(pf: ParsedFile, index: ModuleIndex, fn) -> List:
                                 f".{node.func.attr}() in driver "
                                 f"'{fn.qualname}': blocking per-scalar "
                                 "host sync on the step path"))
+            elif _is_memory_introspection(node):
+                out.append(diag(
+                    pf, node, "DSH204",
+                    f".{node.func.attr}() in driver '{fn.qualname}': "
+                    "per-device memory introspection on the step path; "
+                    "sample via profiling.memory.device_memory_summary "
+                    "at the steps_per_print cadence instead"))
             elif _is_device_get(node):
                 sites.append((node, "jax.device_get", in_loop))
             elif _is_np_materialize(node):

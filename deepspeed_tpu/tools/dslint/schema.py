@@ -120,7 +120,7 @@ _SECTION_PREFIXES = (
     ("MESH_", "mesh"), ("SPARSE_", "sparse_attention"),
     ("CHECKPOINT_", "checkpoint"), ("RING_ATTENTION_", "ring_attention"),
     ("RESILIENCE_", "resilience"), ("TELEMETRY_", "telemetry"),
-    ("COMPILATION_", "compilation"),
+    ("COMPILATION_", "compilation"), ("PROFILING_", "profiling"),
     ("ACT_CHKPT_", "activation_checkpointing"),
     ("FLOPS_PROFILER_", "flops_profiler"),
 )

@@ -87,23 +87,13 @@ class SynchronizedWallClockTimer:
     def memory_usage():
         """Aggregate allocation stats over ALL local devices (summing —
         on a multi-chip host, device 0 alone understates the footprint by
-        the local device count)."""
+        the local device count).  Shared implementation:
+        ``profiling.memory.device_memory_summary``."""
         try:
-            import jax
+            from ..profiling.memory import (device_memory_summary,
+                                            format_memory_summary)
 
-            devices = jax.local_devices()
-            alloc = peak = 0
-            reporting = 0
-            for dev in devices:
-                stats = dev.memory_stats() or {}
-                if stats:
-                    reporting += 1
-                alloc += stats.get("bytes_in_use", 0)
-                peak += stats.get("peak_bytes_in_use", 0)
-            gib = 1024.0 * 1024.0 * 1024.0
-            return (f"mem allocated {alloc / gib:.4f} GB peak "
-                    f"{peak / gib:.4f} GB across {reporting}/{len(devices)} "
-                    f"local device(s)")
+            return format_memory_summary(device_memory_summary())
         except Exception:
             return "mem stats unavailable"
 

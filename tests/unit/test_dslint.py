@@ -295,6 +295,51 @@ class TrainEngine:
     assert ids == ["DSH203"]
 
 
+def test_dsh204_memory_stats_in_driver(tmp_path):
+    # memory introspection on the per-step path: a host runtime query per
+    # device per call — the watermark cadence contract the memory ledger
+    # relies on (sample only at steps_per_print, via profiling.memory)
+    ids = lint_source(tmp_path, """
+import jax
+
+class TrainEngine:
+    def train_batch(self):
+        stats = jax.local_devices()[0].memory_stats()
+        return stats
+""")
+    assert ids == ["DSH204"]
+
+
+def test_dsh204_memory_analysis_reached_through_self_call(tmp_path):
+    ids = lint_source(tmp_path, """
+class TrainEngine:
+    def _probe(self):
+        return self._compiled.memory_analysis()
+
+    def step(self):
+        return self._probe()
+""")
+    assert ids == ["DSH204"]
+
+
+def test_dsh204_in_jit_and_clean_twin(tmp_path):
+    ids = lint_source(tmp_path, """
+import jax
+
+@jax.jit
+def step(x, dev):
+    dev.memory_stats()
+    return x
+""")
+    assert ids == ["DSH204"]
+    # build-time recording (no driver class, not jit-reachable) is clean
+    ids = lint_source(tmp_path, """
+def record(compiled):
+    return compiled.memory_analysis()
+""")
+    assert ids == []
+
+
 def test_non_engine_class_is_not_driver_scope(tmp_path):
     # benchmarks/profilers sync deliberately; only Engine/Scaler classes
     # carry step-cadence semantics

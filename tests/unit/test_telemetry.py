@@ -338,6 +338,15 @@ def test_engine_zero_added_host_syncs(cpu_devices, tmp_path, monkeypatch):
     assert tel == base, (f"telemetry added host syncs: {tel} device_get "
                          f"calls vs {base} baseline")
     assert base > 0
+    # memory observability on top (ledger + per-print watermark
+    # sampling): memory_analysis happens at compile time and
+    # memory_stats is a host runtime query — still ZERO added
+    # device_get calls over the same run
+    mem = count_gets(tel_config(
+        tmp_path / "m", trace=True, resilience=resilience,
+        profiling={"memory_ledger": True, "memory_watermarks": True}))
+    assert mem == base, (f"memory observability added host syncs: {mem} "
+                         f"device_get calls vs {base} baseline")
 
 
 def test_engine_step_metrics_and_monitor_preserved(cpu_devices, tmp_path):

@@ -1,0 +1,17 @@
+#!/usr/bin/env python
+"""Repo-level entry point for the bench regression gate.
+
+``python tools/bench_diff.py BENCH_r05.json BENCH_r06.json`` — see
+``deepspeed_tpu/tools/bench_diff.py`` (the implementation; also exposed
+as ``python -m deepspeed_tpu.telemetry report --diff OLD NEW``).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deepspeed_tpu.tools.bench_diff import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
