@@ -119,6 +119,27 @@ def memory_receipts(record, engine, prefix=None):
               file=sys.stderr)
 
 
+def comm_receipts(record, engine, prefix=None):
+    """Communication receipts for one bench row (fail-soft): the
+    compiled step program's collective count and predicted wire bytes
+    from the comm ledger's compile-time HLO walk
+    (``profiling/comm.py``).  A dp=1 single-chip row legitimately
+    records 0 collectives — the receipt proves it, instead of leaving
+    "no cross-chip traffic" as an assumption."""
+    try:
+        tag = (lambda f: f"{prefix}_{f}") if prefix else (lambda f: f)
+        receipt = engine.comm_receipt()
+        if receipt is not None:
+            record[tag("comm_collectives_per_step")] = int(
+                receipt["collectives"])
+        wire = engine.comm_wire_bytes_per_step()
+        if wire is not None:
+            record[tag("comm_wire_bytes_per_step")] = int(wire)
+    except Exception as e:  # pragma: no cover - receipts never gate rows
+        print(f"bench: comm receipts unavailable: {e!r:.200}",
+              file=sys.stderr)
+
+
 def main():
     import jax
 
@@ -175,9 +196,10 @@ def main():
         "steps_per_print": 10 ** 9,
         "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
         "bf16": {"enabled": True},
-        # compiled-program memory ledger: predicted_temp_bytes /
-        # peak_hbm_bytes receipts ride the bench JSON (zero step cost)
-        "profiling": {"memory_ledger": True},
+        # compiled-program memory + comm ledgers: predicted_temp_bytes /
+        # peak_hbm_bytes / comm_wire_bytes_per_step receipts ride the
+        # bench JSON (zero step cost — both record at compile time)
+        "profiling": {"memory_ledger": True, "comm_ledger": True},
     }
     # 20 = bing_bert's max_predictions_per_seq at seq 128; the MLM head
     # gathers these positions before the vocab projection (~8% of step
@@ -248,9 +270,13 @@ def main():
         "device": getattr(dev, "device_kind", str(dev)),
     }
 
-    # memory receipts for the primary row: predicted temp bytes from the
-    # compiled train step + the live peak watermark (profiling/memory)
+    # memory + comm receipts for the primary row: predicted temp bytes
+    # from the compiled train step + the live peak watermark
+    # (profiling/memory), and the step program's collective receipt
+    # (profiling/comm — 0 collectives on this dp=1 chip, proven not
+    # assumed)
     memory_receipts(record, engine)
+    comm_receipts(record, engine)
 
     # HBM discipline: each engine holds ~5 GB of master+optimizer state for
     # these model sizes; three co-resident engines exhaust a 16 GB chip.
@@ -400,7 +426,8 @@ def _measure_offload(record, deepspeed, mesh, rng):
             config={"train_batch_size": 4, "steps_per_print": 10 ** 9,
                     "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
                     "zero_optimization": zero,
-                    "profiling": {"memory_ledger": True},
+                    "profiling": {"memory_ledger": True,
+                                  "comm_ledger": True},
                     "bf16": {"enabled": True}})
         for _ in range(2):
             loss = engine.train_batch(iter([batch]))
@@ -417,6 +444,7 @@ def _measure_offload(record, deepspeed, mesh, rng):
             record[f"{prefix}_host_state_bytes_per_step"] = int(
                 engine.host_state_bytes_per_step())
             memory_receipts(record, engine, prefix=prefix)
+            comm_receipts(record, engine, prefix=prefix)
         else:
             record[f"{prefix}_error"] = f"non-finite loss {v}"
         del engine, model
@@ -470,7 +498,8 @@ def _measure_offload_xl(record, deepspeed, mesh, rng):
         config={"train_batch_size": 4, "steps_per_print": 10 ** 9,
                 "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
                 "zero_optimization": zero,
-                "profiling": {"memory_ledger": True},
+                "profiling": {"memory_ledger": True,
+                              "comm_ledger": True},
                 "bf16": {"enabled": True}})
     batch = {"input_ids": rng.integers(
         0, cfg.vocab_size, size=(4, 1024)).astype(np.int32)}
@@ -493,6 +522,7 @@ def _measure_offload_xl(record, deepspeed, mesh, rng):
         record["offload_gpt2_xl_host_groups"] = len(
             engine.flat.host_group_bounds or ((0, 0),))
         memory_receipts(record, engine, prefix="offload_gpt2_xl")
+        comm_receipts(record, engine, prefix="offload_gpt2_xl")
     else:
         record["offload_xl_error"] = f"non-finite loss {v}"
     del engine, model

@@ -134,6 +134,14 @@ def main(argv=None):
             # respawn of it) compiles into / loads from one shared cache
             env["JAX_COMPILATION_CACHE_DIR"] = os.path.abspath(
                 args.compile_cache_dir)
+        if args.telemetry_dir:
+            # every rank's engine defaults its telemetry run_dir here
+            # (telemetry/config.py reads DS_TELEMETRY_DIR), so the
+            # launcher's events-launcher.jsonl, the ranks' events/
+            # metrics, AND the per-rank latency-rank<k>.json skew
+            # exchange all share one directory — the report CLI merges
+            # one timeline and cross-rank skew needs no other channel
+            env["DS_TELEMETRY_DIR"] = os.path.abspath(args.telemetry_dir)
         env[ENV_COORDINATOR] = f"{args.master_addr}:{args.master_port}"
         env[ENV_NUM_PROCESSES] = str(total)
         env[ENV_PROCESS_ID] = str(first_id + local_rank)

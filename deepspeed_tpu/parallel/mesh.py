@@ -77,6 +77,18 @@ def get_current_mesh():
     return _CURRENT_MESH
 
 
+def mesh_axis_sizes(mesh, keep_trivial=False):
+    """{axis_name: size} for a Mesh — the communication context the comm
+    ledger stamps into every ``comm`` program event (a reader can tell a
+    dp=8 receipt from a dp=2 one without the engine config).  Size-1
+    axes are dropped unless ``keep_trivial``: they carry no
+    collectives."""
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    if keep_trivial:
+        return sizes
+    return {ax: n for ax, n in sizes.items() if n > 1}
+
+
 def available_devices(n_devices: Optional[int] = None, platform: Optional[str] = None):
     """Pick ``n_devices`` devices, preferring the default backend but falling
     back to the host-platform (virtual CPU) devices when the default backend

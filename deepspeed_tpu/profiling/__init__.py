@@ -1,3 +1,6 @@
+from .comm import (CommLedger, collective_summary, fleet_skew,
+                   parse_hlo_collectives, predicted_wire_bytes,
+                   publish_rank_latency, read_fleet_latencies)
 from .config import DeepSpeedFlopsProfilerConfig, DeepSpeedProfilingConfig
 from .flops_profiler import (FlopsProfiler, count_fn_flops, get_model_profile)
 from .memory import (HostBufferRegistry, MemoryLedger, device_memory_summary,
@@ -7,7 +10,10 @@ from .step_profiler import (model_scope_breakdown, timed_loop, timed_scan,
 from .utilization import (DEFAULT_PEAK_TFLOPS, PEAK_TFLOPS, chip_peak_tflops,
                           model_flops_utilization)
 
-__all__ = ["DeepSpeedFlopsProfilerConfig", "DeepSpeedProfilingConfig",
+__all__ = ["CommLedger", "collective_summary", "parse_hlo_collectives",
+           "predicted_wire_bytes", "publish_rank_latency",
+           "read_fleet_latencies", "fleet_skew",
+           "DeepSpeedFlopsProfilerConfig", "DeepSpeedProfilingConfig",
            "FlopsProfiler", "count_fn_flops", "get_model_profile",
            "wall_breakdown", "model_scope_breakdown", "timed_loop",
            "timed_scan", "MemoryLedger", "HostBufferRegistry",

@@ -1,0 +1,425 @@
+"""Communication observability: compiled-program collective ledger +
+per-rank step-latency skew.
+
+XLA makes the communication volume of a training step *statically
+knowable*, the same way :mod:`.memory` made HBM statically knowable:
+after GSPMD partitioning, every cross-chip exchange is an explicit
+collective op in the optimized HLO (``all-reduce`` / ``all-gather`` /
+``reduce-scatter`` / ``collective-permute`` / ``all-to-all``) with its
+payload shape and replica groups in the text.  This module turns that
+into run artifacts:
+
+- :class:`CommLedger` — rides the :class:`~.memory.MemoryLedger` AOT
+  hook (the one compile each program pays anyway): on first dispatch of
+  each engine program it walks ``compiled.as_text()`` for collective
+  ops and records per-program **collective count, payload bytes,
+  replica-group shape, and predicted wire bytes** as schema-versioned
+  ``comm`` telemetry events plus ``comm/program/*`` gauges.  Everything
+  happens at *compile* time: zero device syncs, nothing on the step
+  path.
+
+- **Wire-bytes model** (:func:`predicted_wire_bytes`): per participant,
+  ring-algorithm accounting over a replica group of size *g* —
+  all-gather moves ``(g-1)/g`` of its gathered output, reduce-scatter
+  ``(g-1)/g`` of its full input, all-reduce twice the all-gather
+  (reduce-scatter + all-gather phases), a permute exactly its payload,
+  all-to-all ``(g-1)/g`` of its payload.  These are the same formulas
+  the exactness test checks against a ZeRO-2 program's flat buffers.
+
+- **Per-rank skew exchange** (:func:`publish_rank_latency` /
+  :func:`read_fleet_latencies` / :func:`fleet_skew`) — each rank
+  publishes its :class:`~.step_profiler.StepLatencyRing` summary to
+  ``<run_dir>/latency-rank<k>.json`` (atomic tmp+replace) at the
+  ``steps_per_print`` cadence and reads the fleet's files back: a
+  slowest-vs-median straggler ratio computable at runtime from shared
+  run-dir artifacts, with no cross-rank collective and no device
+  access.  The resilience hook turns a ratio above
+  ``resilience.straggler_factor`` into a ``straggler`` anomaly event.
+
+Stdlib + regex only at record time; fail-soft by design (observability
+must never take training down).
+"""
+
+import json
+import os
+import re
+import threading
+import time
+
+from ..utils.logging import logger
+
+# the collective mnemonics walked out of optimized HLO (async forms
+# appear as <op>-start/<op>-done pairs; only -start carries the payload)
+COLLECTIVE_OPS = ("all-reduce", "all-gather", "reduce-scatter",
+                  "collective-permute", "all-to-all")
+
+# comm-event kinds (the ``kind`` data key of EVENT_COMM)
+KIND_PROGRAM = "program"
+KIND_LATENCY = "latency"
+KIND_SKEW = "skew"
+
+LATENCY_FILE_PREFIX = "latency-rank"
+LATENCY_FILE_SUFFIX = ".json"
+
+# HLO element-type byte widths (shapes print as e.g. ``bf16[4,1024]{1,0}``)
+_DTYPE_BYTES = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2,
+    "s32": 4, "u32": 4, "s64": 8, "u64": 8, "f16": 2, "bf16": 2,
+    "f32": 4, "f64": 8, "c64": 8, "c128": 16,
+    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3b11fnuz": 1, "f8e4m3fnuz": 1,
+    "f8e5m2fnuz": 1,
+}
+
+# one collective instruction:  ``%name = <result> <op>(...)`` where
+# <result> is a shape or a tuple of shapes.  ``-done`` halves of async
+# pairs deliberately do NOT match (their -start already counted).
+_OP_RE = re.compile(
+    r"=\s*(?P<outs>\([^)]*\)|[a-z0-9]+\[[0-9,]*\]\S*)\s+"
+    r"(?P<op>%s)(?P<async>-start)?\(" % "|".join(COLLECTIVE_OPS))
+_SHAPE_RE = re.compile(r"(?P<dt>[a-z0-9]+)\[(?P<dims>[0-9,]*)\]")
+# replica_groups={{0,1},{2,3}} (explicit) or [2,4]<=[8] (iota: shape
+# [groups, group_size] over a device permutation)
+_GROUPS_RE = re.compile(
+    r"replica_groups=(?:\{(?P<explicit>[^=]*?)\}(?:,|\s|$)"
+    r"|\[(?P<iota>[0-9,]+)\]<=\[[0-9,]+\])")
+_PAIRS_RE = re.compile(
+    r"source_target_pairs=\{(?P<pairs>(?:\{[0-9]+,[0-9]+\},?)+)\}")
+
+
+def _shape_bytes_list(text):
+    """Bytes of every typed shape literal in ``text``, in order (layout
+    suffixes like ``{1,0}`` carry no shape literal)."""
+    out = []
+    for m in _SHAPE_RE.finditer(text):
+        width = _DTYPE_BYTES.get(m.group("dt"))
+        if width is None:
+            continue
+        n = 1
+        for d in m.group("dims").split(","):
+            if d:
+                n *= int(d)
+        out.append(n * width)
+    return out
+
+
+def _result_bytes(outs_text, is_async):
+    """Collective result size from the instruction's result type.
+
+    Sync variadic forms (tuple all-to-all / all-reduce) list one shape
+    per logical output: SUM them.  Async ``-start`` results are
+    bookkeeping tuples — (operand alias, result, context scalars...) —
+    so summing would double-count the operand; the collective's real
+    payload is the LARGEST element."""
+    sizes = _shape_bytes_list(outs_text)
+    if not sizes:
+        return 0
+    return max(sizes) if is_async else sum(sizes)
+
+
+def _group_size(line, all_participants=1):
+    """Participant count of one collective instruction's replica group.
+
+    ``replica_groups={}`` is the standard HLO form for "ALL replicas in
+    one group" (cross-replica lowerings) — it resolves to
+    ``all_participants`` (the recording ledger passes its mesh's device
+    count; bare parses default to 1, degrading the wire prediction to
+    zero rather than crashing)."""
+    m = _GROUPS_RE.search(line)
+    if m:
+        if m.group("iota") is not None:
+            dims = [int(x) for x in m.group("iota").split(",") if x]
+            # iota shape is [num_groups, group_size, ...subgroup dims]
+            if len(dims) >= 2:
+                size = 1
+                for d in dims[1:]:
+                    size *= d
+                return max(size, 1)
+            return max(dims[0], 1) if dims else 1
+        first = m.group("explicit").split("}")[0].strip("{} ")
+        if not first:
+            return max(int(all_participants), 1)
+        return len([x for x in first.split(",") if x.strip()])
+    m = _PAIRS_RE.search(line)
+    if m:
+        # a permute's "group" is the set of participating sources
+        pairs = [p for p in m.group("pairs").split("}") if p.strip("{, ")]
+        return max(len(pairs), 1)
+    return 1
+
+
+def predicted_wire_bytes(op, out_bytes, group):
+    """Ring-algorithm wire bytes per participant for one collective.
+
+    ``out_bytes`` is the op's RESULT size (what the HLO line states);
+    reduce-scatter's logical payload is its full input
+    (``out_bytes * group``).  Integer math — exact when the payload
+    divides by the group, floor otherwise."""
+    g = max(int(group), 1)
+    if g == 1:
+        return 0
+    if op == "all-reduce":
+        return 2 * out_bytes * (g - 1) // g
+    if op == "all-gather":
+        return out_bytes * (g - 1) // g
+    if op == "reduce-scatter":
+        return out_bytes * (g - 1)
+    if op == "collective-permute":
+        return out_bytes
+    if op == "all-to-all":
+        return out_bytes * (g - 1) // g
+    return 0
+
+
+def parse_hlo_collectives(hlo_text, all_participants=1):
+    """List of ``{op, out_bytes, group, wire_bytes}`` dicts, one per
+    collective instruction in an optimized-HLO module dump.
+    ``all_participants`` resolves empty ``replica_groups={}`` (= every
+    replica in one group)."""
+    out = []
+    for line in hlo_text.splitlines():
+        m = _OP_RE.search(line)
+        if m is None:
+            continue
+        op = m.group("op")
+        out_bytes = _result_bytes(m.group("outs"),
+                                  m.group("async") is not None)
+        group = _group_size(line, all_participants)
+        out.append({"op": op, "out_bytes": out_bytes, "group": group,
+                    "wire_bytes": predicted_wire_bytes(op, out_bytes,
+                                                       group)})
+    return out
+
+
+def collective_summary(ops):
+    """Aggregate parsed collectives into one ledger entry::
+
+        {"collectives": N, "payload_bytes": ..., "wire_bytes": ...,
+         "ops": {op: {"count", "payload_bytes", "wire_bytes",
+                      "max_group"}}}
+
+    ``payload_bytes`` is the logical payload (full input for
+    reduce-scatter, the stated result for everything else)."""
+    entry = {"collectives": 0, "payload_bytes": 0, "wire_bytes": 0,
+             "ops": {}}
+    for rec in ops:
+        payload = rec["out_bytes"]
+        if rec["op"] == "reduce-scatter":
+            payload = rec["out_bytes"] * rec["group"]
+        bucket = entry["ops"].setdefault(
+            rec["op"], {"count": 0, "payload_bytes": 0, "wire_bytes": 0,
+                        "max_group": 0})
+        bucket["count"] += 1
+        bucket["payload_bytes"] += payload
+        bucket["wire_bytes"] += rec["wire_bytes"]
+        bucket["max_group"] = max(bucket["max_group"], rec["group"])
+        entry["collectives"] += 1
+        entry["payload_bytes"] += payload
+        entry["wire_bytes"] += rec["wire_bytes"]
+    return entry
+
+
+# ---------------------------------------------------------------------------
+# CommLedger: per-program compile-time collective accounting
+# ---------------------------------------------------------------------------
+
+class CommLedger:
+    """Per-engine ledger of compiled-program collective analyses.
+
+    Fed by :meth:`.memory.MemoryLedger.record` (the AOT hook every
+    engine jit entry point already passes through), so enabling it adds
+    no compile beyond the one jit would have paid and NOTHING on the
+    step path.  ``record`` is also callable directly with any
+    AOT-compiled object (the capacity planner, tests)."""
+
+    def __init__(self, enabled=True, telemetry=None, mesh_axes=None):
+        self.enabled = bool(enabled)
+        self.telemetry = telemetry
+        # {axis: size} context recorded into every program event so a
+        # reader can tell dp=8 apart from dp=2 without the engine config
+        self.mesh_axes = dict(mesh_axes or {})
+        self._lock = threading.Lock()
+        self._entries = {}
+
+    def record(self, name, compiled):
+        """Record one compiled executable's collectives (fail-soft)."""
+        if not self.enabled:
+            return None
+        try:
+            hlo = compiled.as_text()
+        except Exception as e:  # pragma: no cover - backend specific
+            logger.debug("comm ledger: HLO text unavailable for %r: %s",
+                         name, e)
+            with self._lock:
+                self._entries.setdefault(str(name), None)
+            return None
+        n_devices = 1
+        for size in self.mesh_axes.values():
+            n_devices *= size
+        entry = collective_summary(parse_hlo_collectives(
+            hlo, all_participants=n_devices))
+        with self._lock:
+            self._entries[str(name)] = json.loads(json.dumps(entry))
+            n_programs = len(self._entries)
+        tel = self.telemetry
+        if tel is not None and getattr(tel, "enabled", False):
+            from ..telemetry import events as TEL
+
+            tel.emit(TEL.EVENT_COMM, kind=KIND_PROGRAM, program=str(name),
+                     mesh=self.mesh_axes, **entry)
+            for field in ("collectives", "payload_bytes", "wire_bytes"):
+                tel.gauge(f"comm/program/{name}/{field}").set(
+                    float(entry[field]))
+            tel.gauge("comm/programs").set(float(n_programs))
+        return entry
+
+    def entry(self, name):
+        with self._lock:
+            e = self._entries.get(str(name))
+        return json.loads(json.dumps(e)) if e else None
+
+    def entries(self):
+        with self._lock:
+            names = list(self._entries)
+        return {n: self.entry(n) for n in names}
+
+    def wire_bytes(self, name):
+        e = self.entry(name)
+        return e["wire_bytes"] if e else None
+
+    def step_entry(self, grad_accumulation_steps=1, prefer=None):
+        """Aggregate ``{program, collectives, payload_bytes,
+        wire_bytes}`` for ONE optimizer step.
+
+        The fused program (``train_step`` / ``train_step_compressed``)
+        IS the step when present; ``prefer`` names the fused program the
+        engine is CURRENTLY dispatching (a 1-bit Adam run holds both,
+        and past freeze_step the compressed one is the live step).
+        Otherwise — the pipeline/step-wise path — the per-program
+        entries are summed WITH the micro-batch multiplicity
+        (``fwd_bwd``·acc + ``accum``·(acc-1) + ``apply_update`` +
+        ``cast_params``), so the receipt prices the whole step, not one
+        micro-batch.  None when nothing has compiled yet."""
+        fused_order = ("train_step", "train_step_compressed")
+        if prefer is not None:
+            fused_order = (prefer,) + tuple(f for f in fused_order
+                                            if f != prefer)
+        for fused in fused_order:
+            e = self.entry(fused)
+            if e is not None:
+                return {"program": fused,
+                        "collectives": e["collectives"],
+                        "payload_bytes": e["payload_bytes"],
+                        "wire_bytes": e["wire_bytes"]}
+        acc = max(int(grad_accumulation_steps), 1)
+        weights = {"fwd_bwd": acc, "accum": acc - 1, "apply_update": 1,
+                   "cast_params": 1}
+        totals = {"program": "stepwise", "collectives": 0,
+                  "payload_bytes": 0, "wire_bytes": 0}
+        seen = False
+        for name, mult in weights.items():
+            e = self.entry(name)
+            if e is not None and mult > 0:
+                seen = True
+                for field in ("collectives", "payload_bytes",
+                              "wire_bytes"):
+                    totals[field] += e[field] * mult
+        return totals if seen else None
+
+    def step_wire_bytes(self, grad_accumulation_steps=1, prefer=None):
+        """Predicted wire bytes of ONE optimizer step (see
+        :meth:`step_entry`); None when nothing has compiled yet."""
+        e = self.step_entry(grad_accumulation_steps, prefer=prefer)
+        return e["wire_bytes"] if e else None
+
+
+# ---------------------------------------------------------------------------
+# Per-rank latency exchange (file-based; print-cadence only)
+# ---------------------------------------------------------------------------
+
+def latency_filename(rank):
+    return f"{LATENCY_FILE_PREFIX}{rank}{LATENCY_FILE_SUFFIX}"
+
+
+def publish_rank_latency(run_dir, rank, snapshot, step=None):
+    """Atomically publish one rank's latency-ring snapshot to
+    ``<run_dir>/latency-rank<k>.json`` (tmp + ``os.replace``: readers
+    never see a torn file).  Returns the path, or None on failure
+    (fail-soft — a full disk must not take the step loop down)."""
+    path = os.path.join(str(run_dir), latency_filename(rank))
+    payload = dict(snapshot)
+    payload["rank"] = rank
+    payload["ts"] = time.time()
+    if step is not None:
+        payload["step"] = int(step)
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+    except OSError as e:
+        logger.debug("comm skew: latency publish to %s failed: %s", path, e)
+        return None
+    return path
+
+
+def read_fleet_latencies(run_dir, max_age_secs=None, world_size=None):
+    """{rank: snapshot} from every parseable ``latency-rank*.json``
+    under ``run_dir`` (torn/foreign files skipped).
+
+    Staleness guards — a fixed run dir accumulates files across runs
+    and an elastic fleet shrinks, so a dead rank's last publish must
+    not keep raising stragglers forever:
+
+    - ``max_age_secs``: drop snapshots whose publish ``ts`` is older
+      (snapshots without a ts pass — pre-round-8 writers);
+    - ``world_size``: drop integer ranks outside ``[0, world_size)`` —
+      definitionally not part of the current run."""
+    out = {}
+    try:
+        names = sorted(os.listdir(str(run_dir)))
+    except OSError:
+        return out
+    now = time.time()
+    for name in names:
+        if not (name.startswith(LATENCY_FILE_PREFIX)
+                and name.endswith(LATENCY_FILE_SUFFIX)):
+            continue
+        try:
+            with open(os.path.join(str(run_dir), name),
+                      encoding="utf-8") as f:
+                snap = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not (isinstance(snap, dict) and "p50" in snap):
+            continue
+        if (max_age_secs is not None and snap.get("ts") is not None
+                and now - float(snap["ts"]) > max_age_secs):
+            continue
+        rank = snap.get("rank", name[len(LATENCY_FILE_PREFIX):
+                                     -len(LATENCY_FILE_SUFFIX)])
+        if (world_size is not None and isinstance(rank, int)
+                and not 0 <= rank < world_size):
+            continue
+        out[rank] = snap
+    return out
+
+
+def fleet_skew(fleet):
+    """Slowest-vs-median straggler metric over per-rank p50 latencies.
+
+    Returns ``{"ranks", "slowest_rank", "slowest", "median", "ratio"}``
+    or None when no rank has published.  With one rank the ratio is 1.0
+    (no fleet to straggle behind)."""
+    rows = [(rank, float(snap["p50"])) for rank, snap in fleet.items()
+            if snap.get("p50") and float(snap["p50"]) > 0.0]
+    if not rows:
+        return None
+    rows.sort(key=lambda rv: rv[1])
+    vals = [v for _, v in rows]
+    mid = len(vals) // 2
+    median = (vals[mid] if len(vals) % 2
+              else 0.5 * (vals[mid - 1] + vals[mid]))
+    slowest_rank, slowest = rows[-1]
+    return {"ranks": len(rows), "slowest_rank": slowest_rank,
+            "slowest": slowest, "median": median,
+            "ratio": slowest / median if median > 0 else 1.0}

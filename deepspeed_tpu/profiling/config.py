@@ -52,6 +52,14 @@ class DeepSpeedProfilingConfig:
             prof, C.PROFILING_MEMORY_WATERMARKS,
             C.PROFILING_MEMORY_WATERMARKS_DEFAULT),
             C.PROFILING_MEMORY_WATERMARKS)
+        self.comm_ledger = _tristate(get_scalar_param(
+            prof, C.PROFILING_COMM_LEDGER,
+            C.PROFILING_COMM_LEDGER_DEFAULT), C.PROFILING_COMM_LEDGER)
+
+    def comm_ledger_enabled(self, telemetry_enabled):
+        if self.comm_ledger == "auto":
+            return bool(telemetry_enabled)
+        return bool(self.comm_ledger)
 
     def memory_ledger_enabled(self, telemetry_enabled):
         if self.memory_ledger == "auto":
@@ -68,4 +76,5 @@ class DeepSpeedProfilingConfig:
     def __repr__(self):
         return (f"DeepSpeedProfilingConfig(memory_ledger="
                 f"{self.memory_ledger!r}, memory_watermarks="
-                f"{self.memory_watermarks!r})")
+                f"{self.memory_watermarks!r}, comm_ledger="
+                f"{self.comm_ledger!r})")

@@ -292,9 +292,18 @@ class MemoryLedger:
     ``program``) and per-program gauges — all at compile time, never on
     the step path."""
 
-    def __init__(self, enabled=True, telemetry=None):
+    def __init__(self, enabled=True, telemetry=None, comm_ledger=None,
+                 record_memory=True):
         self.enabled = bool(enabled)
         self.telemetry = telemetry
+        # companion collective ledger (profiling/comm.CommLedger): rides
+        # this ledger's one AOT hook so each program is compiled once and
+        # accounted twice (memory AND communication).  record_memory
+        # False = hook kept alive purely for the comm ledger (the user
+        # explicitly disabled memory events); entries still accumulate
+        # for direct queries (bench receipts, planner)
+        self.comm_ledger = comm_ledger
+        self.record_memory = bool(record_memory)
         self.host_buffers = HostBufferRegistry()
         self._lock = threading.Lock()
         self._entries = {}
@@ -308,6 +317,8 @@ class MemoryLedger:
     def record(self, name, compiled):
         """Record one compiled executable (fail-soft; also callable
         directly with an AOT-compiled object, e.g. by the planner)."""
+        if self.comm_ledger is not None:
+            self.comm_ledger.record(name, compiled)
         entry = compiled_memory_entry(compiled)
         if entry is None:
             with self._lock:
@@ -316,7 +327,8 @@ class MemoryLedger:
         with self._lock:
             self._entries[str(name)] = dict(entry)
         tel = self.telemetry
-        if tel is not None and getattr(tel, "enabled", False):
+        if (self.record_memory and tel is not None
+                and getattr(tel, "enabled", False)):
             from ..telemetry import events as TEL
 
             tel.emit(TEL.EVENT_MEMORY, kind=KIND_PROGRAM, program=str(name),

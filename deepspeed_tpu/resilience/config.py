@@ -57,6 +57,14 @@ class DeepSpeedResilienceConfig:
         self.checkpoint_dir = get_scalar_param(
             res, C.RESILIENCE_CHECKPOINT_DIR,
             C.RESILIENCE_CHECKPOINT_DIR_DEFAULT)
+        self.straggler_factor = float(get_scalar_param(
+            res, C.RESILIENCE_STRAGGLER_FACTOR,
+            C.RESILIENCE_STRAGGLER_FACTOR_DEFAULT))
+        assert self.straggler_factor == 0 or self.straggler_factor >= 1, (
+            "resilience.straggler_factor must be 0 (disabled) or >= 1: "
+            "it multiplies the fleet-median p50, and slowest/median is "
+            ">= 1 by construction — a factor in (0,1) would flag every "
+            "healthy fleet at every print cadence")
 
     def __repr__(self):
         return (f"DeepSpeedResilienceConfig(enabled={self.enabled}, "

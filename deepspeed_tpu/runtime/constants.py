@@ -344,6 +344,12 @@ RESILIENCE_FLOOR_SCALE_PATIENCE_DEFAULT = 8
 # default: the last directory this engine saved to or loaded from
 RESILIENCE_CHECKPOINT_DIR = "checkpoint_dir"
 RESILIENCE_CHECKPOINT_DIR_DEFAULT = None
+# straggler detection: a rank whose p50 step latency exceeds this
+# multiple of the fleet median (per-rank latency exchange, sampled at
+# the steps_per_print cadence) raises a "straggler" anomaly event.
+# 0 disables; needs telemetry (the run dir is the exchange medium)
+RESILIENCE_STRAGGLER_FACTOR = "straggler_factor"
+RESILIENCE_STRAGGLER_FACTOR_DEFAULT = 0.0
 
 #############################################
 # Telemetry subsystem (deepspeed_tpu/telemetry; new — the reference's
@@ -392,6 +398,15 @@ PROFILING_MEMORY_LEDGER_DEFAULT = "auto"
 # "auto" follows telemetry.enabled
 PROFILING_MEMORY_WATERMARKS = "memory_watermarks"
 PROFILING_MEMORY_WATERMARKS_DEFAULT = "auto"
+# compiled-program collective ledger (profiling/comm.CommLedger):
+# walks each program's optimized HLO for collectives at compile time
+# and records count/payload/replica-group/predicted-wire-bytes as
+# telemetry events/gauges.  "auto" follows telemetry.enabled; true
+# forces it on even without telemetry (entries still queryable via
+# engine.comm_ledger, e.g. for bench/multichip receipts); false
+# disables
+PROFILING_COMM_LEDGER = "comm_ledger"
+PROFILING_COMM_LEDGER_DEFAULT = "auto"
 
 #############################################
 # Compilation subsystem (deepspeed_tpu/runtime/compilation; new — the

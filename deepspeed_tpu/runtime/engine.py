@@ -49,7 +49,8 @@ from ..checkpoint.writer import CheckpointCorruptionError, CheckpointError
 from ..ops.adam.fused_adam import FusedAdam
 from ..ops.lamb.fused_lamb import FusedLamb
 from ..ops.op_common import LANES
-from ..parallel.mesh import DATA_AXIS, MeshGrid, make_mesh, set_current_mesh
+from ..parallel.mesh import (DATA_AXIS, MeshGrid, make_mesh,
+                             mesh_axis_sizes, set_current_mesh)
 from ..telemetry import events as TEL
 from ..utils.distributed import init_distributed
 from ..utils.logging import log_dist, logger
@@ -636,19 +637,34 @@ class DeepSpeedEngine:
 
             install_compile_telemetry(self.telemetry)
 
-        # -- memory observability (deepspeed_tpu/profiling/memory): the
-        # compiled-program ledger wraps every jit entry point built in
-        # _build_step_functions (memory_analysis recorded at compile
-        # time); HBM watermarks + the host-buffer registry are sampled
-        # ONLY at the steps_per_print cadence — zero new per-step syncs
+        # -- memory + communication observability (deepspeed_tpu/
+        # profiling): the compiled-program ledgers wrap every jit entry
+        # point built in _build_step_functions (memory_analysis AND the
+        # optimized HLO's collectives recorded at compile time); HBM
+        # watermarks, the host-buffer registry, and the per-rank
+        # step-latency/skew exchange are sampled ONLY at the
+        # steps_per_print cadence — zero new per-step syncs
+        from ..profiling.comm import CommLedger
         from ..profiling.memory import MemoryLedger
 
         self.profiling_config = self._config.profiling_config
         self._aot_plan = bool(aot_plan)
+        self.comm_ledger = CommLedger(
+            enabled=self.profiling_config.comm_ledger_enabled(
+                self.telemetry.enabled),
+            telemetry=self.telemetry,
+            mesh_axes=mesh_axis_sizes(self.mesh))
+        # the comm ledger rides the memory ledger's AOT hook, so comm-on
+        # forces the shared hook on even with the memory ledger off
+        # (memory events stay gated on the memory ledger's own knob)
+        mem_on = (self.profiling_config.memory_ledger_enabled(
+            self.telemetry.enabled) or self._aot_plan)
         self.memory_ledger = MemoryLedger(
-            enabled=(self.profiling_config.memory_ledger_enabled(
-                self.telemetry.enabled) or self._aot_plan),
-            telemetry=self.telemetry)
+            enabled=mem_on or self.comm_ledger.enabled,
+            telemetry=self.telemetry,
+            comm_ledger=(self.comm_ledger if self.comm_ledger.enabled
+                         else None),
+            record_memory=mem_on)
         self._memory_watermarks = (
             self.profiling_config.memory_watermarks_enabled(
                 self.telemetry.enabled))
@@ -727,6 +743,13 @@ class DeepSpeedEngine:
                         f"micro_steps={self.micro_steps}"),
                     on_fire=self._telemetry_watchdog_fire).start()
             log_dist(f"resilience enabled: {rcfg}", ranks=[0])
+        if self._step_latencies is None and self.telemetry.enabled:
+            # no watchdog armed, but telemetry wants the per-rank
+            # step-latency/skew export: the ring self-tracks beats
+            # (watchdog.beat feeds it otherwise — see _step_beat)
+            from ..profiling.step_profiler import StepLatencyRing
+
+            self._step_latencies = StepLatencyRing()
 
         if self._config.dump_state:
             self._config.print("DeepSpeedEngine configuration")
@@ -838,6 +861,110 @@ class DeepSpeedEngine:
             stalled_secs=float(stalled_secs),
             timeout_secs=float(self.resilience_config.hang_timeout_secs))
         self.telemetry.flush(reason="watchdog_hang")
+
+    def _step_beat(self):
+        """One completed step: feeds the step-latency ring (through the
+        watchdog's heartbeat when it is armed — it owns the interval
+        tracking then).  O(1) host work, no device access."""
+        if self._watchdog is not None:
+            self._watchdog.beat()
+        elif self._step_latencies is not None:
+            self._step_latencies.beat()
+
+    def _step_beat_pause(self):
+        """Forget the last beat across a known-long gap (rollback
+        restore, synchronous final save) so it neither trips the
+        watchdog nor records as a step latency."""
+        if self._watchdog is not None:
+            self._watchdog.pause()
+        if self._step_latencies is not None:
+            self._step_latencies.pause()
+
+    # ------------------------------------------------------------------
+    # communication observability (deepspeed_tpu/profiling/comm)
+    # ------------------------------------------------------------------
+    def _active_step_program(self):
+        """Name of the fused step program the NEXT dispatch runs: a
+        1-bit Adam engine switches to its compressed program at
+        freeze_step, and the comm receipt must follow (quoting warmup
+        wire bytes forever would mask exactly the reduction 1-bit
+        compression exists to deliver)."""
+        if (self._train_step_compressed_fn is not None
+                and self.global_steps >= self.optimizer.freeze_step):
+            return "train_step_compressed"
+        return "train_step"
+
+    def comm_wire_bytes_per_step(self):
+        """Predicted collective wire bytes one optimizer step moves
+        (from the comm ledger's compile-time HLO walk); None until the
+        step program has compiled or with the ledger off."""
+        return self.comm_ledger.step_wire_bytes(
+            self.gradient_accumulation_steps(),
+            prefer=self._active_step_program())
+
+    def comm_receipt(self):
+        """{program, collectives, payload_bytes, wire_bytes} for ONE
+        optimizer step of the program(s) currently dispatched — the
+        fused step when it exists, else the step-wise programs summed
+        with the micro-batch multiplicity (bench/multichip rows quote
+        this next to the memory receipts); None when unrecorded."""
+        return self.comm_ledger.step_entry(
+            self.gradient_accumulation_steps(),
+            prefer=self._active_step_program())
+
+    def _sample_comm_skew(self):
+        """Per-rank step-latency export + cross-rank skew at the
+        steps_per_print cadence.  Everything here is host arithmetic on
+        already-recorded floats plus one tiny atomic file write/read of
+        run-dir artifacts — no device access, ZERO added per-step syncs
+        (the device_get-counting telemetry test covers a comm-enabled
+        run; dslint DSH205 pins this to the print cadence statically)."""
+        if self._step_latencies is None or not self.telemetry.enabled:
+            return
+        from ..profiling import comm as comm_prof
+
+        snap = self._step_latencies.latency_snapshot()
+        if not snap["n"]:
+            return
+        for key in ("last", "mean", "p50", "p95", "max"):
+            self.telemetry.gauge(f"comm/latency/{key}_secs").set(snap[key])
+        wire = self.comm_wire_bytes_per_step()
+        if wire is not None:
+            self.telemetry.gauge("comm/step_wire_bytes").set(float(wire))
+        self.telemetry.emit(TEL.EVENT_COMM, step=self.global_steps,
+                            kind=comm_prof.KIND_LATENCY, **snap)
+        rank = self.telemetry.rank
+        comm_prof.publish_rank_latency(self.telemetry.run_dir, rank, snap,
+                                       step=self.global_steps)
+        # staleness guards: a sibling is "live" if it published within
+        # ~20 of OUR publish intervals (generous for slow cadences,
+        # floor 10 min), and its rank must fit this run's world size —
+        # files left by a previous/larger run in the same dir must not
+        # raise stragglers for ranks that no longer exist
+        publish_interval = max(self.steps_per_print(), 1) * snap["p50"]
+        skew = comm_prof.fleet_skew(comm_prof.read_fleet_latencies(
+            self.telemetry.run_dir,
+            max_age_secs=max(600.0, 20.0 * publish_interval),
+            world_size=self.world_size))
+        if skew is None:
+            return
+        self.telemetry.gauge("comm/skew/slowest_over_median").set(
+            float(skew["ratio"]))
+        self.telemetry.gauge("comm/skew/ranks").set(float(skew["ranks"]))
+        self.telemetry.emit(TEL.EVENT_COMM, step=self.global_steps,
+                            kind=comm_prof.KIND_SKEW, **skew)
+        factor = self.resilience_config.straggler_factor
+        if (factor > 0 and skew["ranks"] >= 2
+                and skew["ratio"] >= factor):
+            # the resilience hook: a sick rank becomes a structured
+            # anomaly event (and the resilience/anomalies counter), the
+            # same stream rollback/divergence verdicts land in
+            self._telemetry_anomaly(
+                self.global_steps, "straggler",
+                f"rank {skew['slowest_rank']} p50 "
+                f"{skew['slowest']:.4f}s vs fleet median "
+                f"{skew['median']:.4f}s (x{skew['ratio']:.2f} >= "
+                f"straggler_factor {factor:g})")
 
     # ------------------------------------------------------------------
     # memory observability (deepspeed_tpu/profiling/memory)
@@ -2389,8 +2516,7 @@ class DeepSpeedEngine:
             self._losses = []
             if self.wall_clock_breakdown():
                 self.timers("step").stop(sync=False)
-            if self._watchdog is not None:
-                self._watchdog.beat()
+            self._step_beat()
             return
 
         if self.lr_scheduler is not None and not self._overflow:
@@ -2424,6 +2550,7 @@ class DeepSpeedEngine:
                 "Train/Samples/loss_scale": scale,
             }, skipped=int(stats["skipped"]))
             self._sample_memory_watermarks()
+            self._sample_comm_skew()
         self._losses = []
         if self._config.memory_breakdown:
             from .utils import see_memory_usage
@@ -2432,8 +2559,7 @@ class DeepSpeedEngine:
         if self.wall_clock_breakdown():
             self.timers("step").stop(sync=False)
             self.timers.log(["forward", "step"])
-        if self._watchdog is not None:
-            self._watchdog.beat()
+        self._step_beat()
 
     def _apply_guard_action(self, action):
         """Escalate an anomaly-guard verdict.  Returns True when a
@@ -2445,11 +2571,11 @@ class DeepSpeedEngine:
         from ..resilience.guard import ACTION_ABORT, ACTION_ROLLBACK
 
         if action == ACTION_ROLLBACK:
-            if self._watchdog is not None:
-                # a checkpoint restore (drain + verify + device_put of the
-                # full state) can legitimately outlast the hang timeout;
-                # disarm until the caller's post-rollback beat re-arms
-                self._watchdog.pause()
+            # a checkpoint restore (drain + verify + device_put of the
+            # full state) can legitimately outlast the hang timeout;
+            # disarm the watchdog AND the latency ring until the
+            # caller's post-rollback beat re-arms
+            self._step_beat_pause()
             reason = (f"{self._guard.consecutive_anomalies} consecutive "
                       f"anomalous step(s)")
             diverged_at = self.global_steps
@@ -2609,8 +2735,7 @@ class DeepSpeedEngine:
             if self.wall_clock_breakdown():
                 self.timers("train_batch").stop(sync=False)
             self.tput_timer.stop()
-            if self._watchdog is not None:
-                self._watchdog.beat()
+            self._step_beat()
             return loss
         if self.lr_scheduler is not None and not self._overflow:
             self.lr_scheduler.step()
@@ -2653,6 +2778,7 @@ class DeepSpeedEngine:
                 "Train/Samples/loss_scale": scale,
             }, skipped=int(stats["skipped"]))
             self._sample_memory_watermarks()
+            self._sample_comm_skew()
         if self.wall_clock_breakdown():
             # the fused program has no forward/step boundary to time
             # separately; report the whole fused step
@@ -2672,8 +2798,7 @@ class DeepSpeedEngine:
             self.telemetry.histogram("train/host_step_secs").observe(
                 time.perf_counter() - t_host0)
             self.telemetry.poll_device_trace(self.global_steps)
-        if self._watchdog is not None:
-            self._watchdog.beat()
+        self._step_beat()
         return loss
 
     def _train_batch_stepwise(self, micro_batches):

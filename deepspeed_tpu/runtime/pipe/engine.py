@@ -40,6 +40,8 @@ stage boundaries; a ``loss_fn`` is required when ``pipe > 1``.  With
 so stored activations are only the in-flight boundary carries.
 """
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -370,6 +372,7 @@ class PipelineEngine(DeepSpeedEngine):
                 self._train_iter = iter(RepeatingLoader(self.training_dataloader))
             data_iter = self._train_iter
         self.tput_timer.start()
+        t_host0 = time.perf_counter()
         batch = self._stack_micro_batches(data_iter)
         loss = self.forward(batch)
         self.backward(loss)
@@ -379,6 +382,17 @@ class PipelineEngine(DeepSpeedEngine):
                                 * self.dp_world_size * (self.micro_batches - 1))
         self.step()
         self.tput_timer.stop()
+        if self.telemetry.enabled:
+            # same per-step telemetry surface as the fused train_batch
+            # path (host-only bookkeeping on the already-run step): the
+            # pipelined schedule's ppermute ring traffic lands in the
+            # comm ledger via the fwd_bwd program it compiles through
+            self.telemetry.counter("train/steps").inc()
+            self.telemetry.counter("train/samples").inc(
+                self.train_batch_size())
+            self.telemetry.histogram("train/host_step_secs").observe(
+                time.perf_counter() - t_host0)
+            self.telemetry.poll_device_trace(self.global_steps)
         self.log_batch_step_id += 1
         return loss
 

@@ -290,8 +290,42 @@ class FlatParamCoordinator:
         whole-tree flatten materializing ~8 bytes/param of HBM — see
         PERF.md "ZeRO-Offload capacity").  Callers with host-initialized
         (numpy) leaves never touch HBM at all."""
+        # Multi-axis meshes ALSO take the host-side path: the jitted
+        # flatten miscompiles when the mesh has a second >1 axis the
+        # master's P("data") spec does not reference — GSPMD combines
+        # the concat's per-partition DUS writes with one all-reduce
+        # over ALL mesh axes, so the model/pipe/seq/expert-axis
+        # replicas (full copies, not zero-elsewhere partials) get
+        # SUMMED and every parameter arrives multiplied by those axes'
+        # product (observed: exactly 2x on a data:2 x model:2 mesh,
+        # jax 0.4.37 CPU — caught by the multichip dryrun's dp=1
+        # loss-parity assert; the old finiteness-only check sailed
+        # past it since the scaled model's loss stays finite near
+        # ln(vocab)).  The host-side flatten is layout-exact by
+        # construction and init-only.
+        from ...parallel.mesh import DATA_AXIS, mesh_axis_sizes
+
+        multi_axis = any(ax != DATA_AXIS
+                         for ax in mesh_axis_sizes(self.mesh))
         if self.cpu_offload:
             return self._flatten_to_master_host(params)
+        if multi_axis:
+            master = self._flatten_to_master_host(params)
+            # Donation provenance: the engine's step programs DONATE the
+            # master, and on CPU a device_put of a numpy staging buffer
+            # can alias the numpy memory — donating that alias corrupts
+            # the heap (observed: flaky glibc "corrupted size vs.
+            # prev_size" aborts on the 2nd train step, dp4 x tp2 CPU
+            # mesh).  A jitted copy re-homes the buffer in the XLA
+            # allocator, same provenance the jitted flatten always had.
+            # (The offload path above keeps its device_put provenance
+            # unchanged — a jitted copy would round-trip pinned-host
+            # state through device memory, re-imposing the init HBM
+            # ceiling the host-side flatten removed.)
+            with self.mesh:
+                return jax.jit(
+                    lambda m: m + jnp.zeros((), m.dtype),
+                    out_shardings=self.master_device_sharding)(master)
         with self.mesh:
             return jax.jit(self._flatten_traced,
                            out_shardings=self.master_device_sharding)(params)

@@ -22,6 +22,12 @@ class DeepSpeedTelemetryConfig:
             tel, C.TELEMETRY_ENABLED, C.TELEMETRY_ENABLED_DEFAULT))
         run_dir = get_scalar_param(
             tel, C.TELEMETRY_RUN_DIR, C.TELEMETRY_RUN_DIR_DEFAULT)
+        if not run_dir:
+            # launcher plumbing: `deepspeed ... --telemetry-dir D`
+            # exports DS_TELEMETRY_DIR to every rank, so all ranks (and
+            # the launcher's own event stream) share one run dir without
+            # each training script hard-coding it
+            run_dir = os.environ.get("DS_TELEMETRY_DIR", "")
         self.run_dir = str(run_dir) if run_dir else os.path.join(
             "runs", "telemetry")
         self.events = bool(get_scalar_param(

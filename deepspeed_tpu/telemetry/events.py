@@ -58,6 +58,13 @@ EVENT_COMPILE = "compile"
 # only at the steps_per_print cadence), "host_buffers" (the pinned-host
 # offload buffer registry)
 EVENT_MEMORY = "memory"
+# communication observability (profiling/comm): ``kind`` selects the
+# payload shape — "program" (one per compiled program: collective
+# count/payload/replica groups/predicted wire bytes walked out of the
+# optimized HLO at compile time), "latency" (this rank's step-latency
+# ring summary, exported only at the steps_per_print cadence), "skew"
+# (the fleet slowest-vs-median straggler snapshot)
+EVENT_COMM = "comm"
 
 # type -> required data keys.  The report CLI and the golden-schema test
 # validate against this table; emitting an unknown type or dropping a
@@ -82,6 +89,7 @@ EVENT_TYPES = {
     EVENT_PROC_RESPAWN: ("proc_rank", "restart", "backoff_secs"),
     EVENT_COMPILE: ("duration_secs",),
     EVENT_MEMORY: ("kind",),
+    EVENT_COMM: ("kind",),
 }
 
 

@@ -7,9 +7,18 @@ per-rank event streams (``events-rank*.jsonl``) and metric snapshots
 
 - a **timeline**: every lifecycle event (run start/resume/end, anomalies,
   rollbacks, watchdog trips, checkpoint queue/commit/failure, loss-scale
-  moves, launcher spawns/respawns/exits) with its step and rank;
+  moves, launcher spawns/respawns/exits) with its step and rank.  Ranks
+  are **clock-aligned**: each stream's clock anchors on its own first
+  spawn/step event, so a rank the launcher respawned minutes later
+  interleaves with its siblings by run-relative time instead of sorting
+  after everything (the raw-wall-clock ordering is still available via
+  ``--json``);
 - **metric summaries**: counters, gauges, and histogram percentiles per
   rank;
+- with ``--comm``, the communication section: the per-program collective
+  table (count / payload bytes / predicted wire bytes from the comm
+  ledger's compile-time HLO walk), a per-step cross-rank latency table
+  with a slowest-vs-median skew column, and the straggler verdicts;
 - with ``--prometheus``, a Prometheus text-exposition dump of the merged
   metric snapshots (for scraping a finished or running job's artifacts);
 - with ``--json``, the merged event list as JSON (for tooling);
@@ -68,24 +77,64 @@ def _fmt_data(data):
                     if k != "scalars")
 
 
-def format_event(record, t0):
+# stream-anchor event types, in anchor priority: a stream's clock zero is
+# its first spawn/(re)start event — NOT the merged run's first event —
+# so ranks whose runs started at different wall times (the launcher
+# respawn case) compare by run-relative time
+_ANCHOR_TYPES = (ev.EVENT_RUN_START, ev.EVENT_RUN_RESUME,
+                 ev.EVENT_PROC_SPAWN, ev.EVENT_STEP_METRICS)
+
+
+def rank_time_anchors(records):
+    """{stream_name: anchor_ts}: each stream's first spawn/step event's
+    wall time (first event at all when none match)."""
+    anchors = {}
+    fallback = {}
+    for rec in records:                       # records are ts-sorted
+        stream = rec.get("_stream")
+        fallback.setdefault(stream, rec.get("ts", 0.0))
+        if stream not in anchors and rec.get("type") in _ANCHOR_TYPES:
+            anchors[stream] = rec.get("ts", 0.0)
+    for stream, ts in fallback.items():
+        anchors.setdefault(stream, ts)
+    return anchors
+
+
+def align_records(records):
+    """Attach ``_rel`` (seconds since the stream's own anchor) to every
+    record and return a new list sorted by it — the clock-aligned
+    cross-rank ordering the timeline and skew tables print."""
+    anchors = rank_time_anchors(records)
+    out = []
+    for rec in records:
+        rec = dict(rec)
+        rec["_rel"] = rec.get("ts", 0.0) - anchors.get(
+            rec.get("_stream"), 0.0)
+        out.append(rec)
+    out.sort(key=lambda r: (r.get("_rel", 0.0), str(r.get("_stream")),
+                            r.get("seq", 0)))
+    return out
+
+
+def format_event(record):
     step = record.get("step")
     step_s = f"step={step}" if step is not None else "step=-"
-    ts = record.get("ts", 0.0) - t0
-    return (f"  t=+{ts:9.3f}s {step_s:<12} rank={record.get('rank')} "
+    rel = record.get("_rel", record.get("ts", 0.0))
+    return (f"  t=+{rel:9.3f}s {step_s:<12} rank={record.get('rank')} "
             f"{record.get('type'):<16} {_fmt_data(record.get('data', {}))}")
 
 
 def format_timeline(records):
-    """Lifecycle timeline lines (one per event, rank- and step-tagged)."""
+    """Clock-aligned lifecycle timeline lines (one per event, rank- and
+    step-tagged; ``t=+`` is seconds since each rank's OWN first
+    spawn/step event)."""
     if not records:
         return ["  (no events)"]
-    t0 = records[0].get("ts", 0.0)
     lines = []
-    for rec in records:
+    for rec in align_records(records):
         if rec.get("type") in _TIMELINE_SKIP:
             continue
-        lines.append(format_event(rec, t0))
+        lines.append(format_event(rec))
     return lines or ["  (no lifecycle events)"]
 
 
@@ -130,7 +179,124 @@ def format_metrics(metrics_by_stream):
     return lines or ["  (no metric snapshots)"]
 
 
-def generate_report(run_dir, strict=False):
+def _fmt_bytes(n):
+    if n is None:
+        return "-"
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024.0 or unit == "GiB":
+            return (f"{n:.0f}{unit}" if unit == "B"
+                    else f"{n:.2f}{unit}")
+        n /= 1024.0
+
+
+def comm_program_table(records):
+    """Per-program collective table from ``comm``/``program`` events
+    (latest event wins per (stream, program))."""
+    progs = {}
+    for rec in records:
+        data = rec.get("data", {})
+        if rec.get("type") == ev.EVENT_COMM and data.get("kind") == "program":
+            progs[(str(rec.get("_stream")), str(data.get("program")))] = data
+    if not progs:
+        return ["  (no comm program events — enable profiling.comm_ledger)"]
+    lines = [f"  {'program':<24} {'rank':<10} {'colls':>5} "
+             f"{'payload':>10} {'wire/step':>10}  ops"]
+    for (stream, program) in sorted(progs):
+        d = progs[(stream, program)]
+        ops = d.get("ops", {}) or {}
+        ops_s = " ".join(f"{op}:{ops[op].get('count', 0)}"
+                         f"(g{ops[op].get('max_group', 1)})"
+                         for op in sorted(ops)) or "-"
+        lines.append(
+            f"  {program:<24} {stream:<10} "
+            f"{d.get('collectives', 0):>5} "
+            f"{_fmt_bytes(d.get('payload_bytes')):>10} "
+            f"{_fmt_bytes(d.get('wire_bytes')):>10}  {ops_s}")
+    return lines
+
+
+def comm_skew_table(records):
+    """Per-step cross-rank latency table with a slowest-vs-median skew
+    column, from ``comm``/``latency`` events (per-rank ring snapshots at
+    the steps_per_print cadence)."""
+    by_step = {}
+    streams = set()
+    for rec in records:
+        data = rec.get("data", {})
+        if (rec.get("type") == ev.EVENT_COMM
+                and data.get("kind") == "latency"
+                and rec.get("step") is not None
+                and data.get("p50")):
+            stream = str(rec.get("_stream"))
+            streams.add(stream)
+            by_step.setdefault(int(rec["step"]), {})[stream] = float(
+                data["p50"])
+    if not by_step:
+        return ["  (no comm latency events)"]
+    streams = sorted(streams)
+    head = "  " + f"{'step':>6} " + " ".join(
+        f"{('p50[' + s + ']'):>14}" for s in streams) + f" {'skew':>6}"
+    lines = [head]
+    for step in sorted(by_step):
+        row = by_step[step]
+        vals = sorted(row.values())
+        mid = len(vals) // 2
+        median = (vals[mid] if len(vals) % 2
+                  else 0.5 * (vals[mid - 1] + vals[mid]))
+        skew = (vals[-1] / median) if median > 0 else 1.0
+        cells = " ".join(
+            (f"{row[s]*1e3:>12.2f}ms" if s in row else f"{'-':>14}")
+            for s in streams)
+        lines.append(f"  {step:>6} {cells} {skew:>5.2f}x")
+    return lines
+
+
+def comm_summary(records):
+    """Predicted-vs-measured closing lines: the step program's predicted
+    wire bytes next to each rank's measured p50 step latency, plus any
+    straggler verdicts."""
+    lines = []
+    wire = {}
+    measured = {}
+    for rec in records:
+        data = rec.get("data", {})
+        if rec.get("type") != ev.EVENT_COMM:
+            if (rec.get("type") == ev.EVENT_ANOMALY
+                    and data.get("kind") == "straggler"):
+                lines.append(f"  STRAGGLER step={rec.get('step')} "
+                             f"rank={rec.get('rank')}: "
+                             f"{data.get('detail')}")
+            continue
+        stream = str(rec.get("_stream"))
+        if (data.get("kind") == "program"
+                and data.get("program") in ("train_step",
+                                            "train_step_compressed")):
+            wire[stream] = data.get("wire_bytes")
+        elif data.get("kind") == "latency" and data.get("p50"):
+            measured[stream] = float(data["p50"])   # last snapshot wins
+    for stream in sorted(set(wire) | set(measured)):
+        w, m = wire.get(stream), measured.get(stream)
+        lines.append(
+            f"  [{stream}] predicted step wire {_fmt_bytes(w)}"
+            + (f", measured step p50 {m*1e3:.2f}ms" if m else
+               ", no measured steps"))
+    return lines or ["  (no step program / latency events)"]
+
+
+def format_comm_section(records):
+    out = ["comm programs (compile-time collective receipts):"]
+    out.extend(comm_program_table(records))
+    out.append("")
+    out.append("per-step cross-rank latency (skew = slowest/median):")
+    out.extend(comm_skew_table(records))
+    out.append("")
+    out.append("comm summary:")
+    out.extend(comm_summary(records))
+    return out
+
+
+def generate_report(run_dir, strict=False, comm=False):
     """Full text report for ``run_dir``; returns (text, events)."""
     records = ev.read_events(run_dir, strict=strict)
     problems = []
@@ -146,6 +312,9 @@ def generate_report(run_dir, strict=False):
     out.append("")
     out.append("step metrics:")
     out.extend(summarize_step_metrics(records))
+    if comm:
+        out.append("")
+        out.extend(format_comm_section(records))
     out.append("")
     out.append("metrics:")
     out.extend(format_metrics(load_metrics(run_dir)))
@@ -179,6 +348,10 @@ def main(argv=None):
                      help="emit the merged event list as JSON")
     rep.add_argument("--strict", action="store_true",
                      help="fail on undecodable event lines")
+    rep.add_argument("--comm", action="store_true",
+                     help="include the communication section: per-program "
+                          "collective-bytes table, per-step cross-rank "
+                          "skew, straggler verdicts")
     rep.add_argument("--diff", nargs=2, metavar=("OLD", "NEW"),
                      help="diff two BENCH_r*.json driver artifacts with "
                           "the bench_schema regression thresholds")
@@ -222,7 +395,8 @@ def main(argv=None):
         json.dump(records, sys.stdout, indent=1)
         sys.stdout.write("\n")
         return 0
-    text, records = generate_report(args.run_dir, strict=args.strict)
+    text, records = generate_report(args.run_dir, strict=args.strict,
+                                    comm=args.comm)
     sys.stdout.write(text)
     # a regressed --diff gates the combined form too (CI relies on it)
     return 1 if (diff_regressed or not records) else 0

@@ -36,14 +36,36 @@ STATUS_ORDER = ("regressed", "improved", "changed", "added", "removed",
 
 
 def load_bench_record(path):
-    """A bench record from ``path`` — either the raw one-line record or
-    the driver wrapper ``{"parsed": {...}, ...}``."""
+    """A bench record from ``path`` — the raw one-line record, the
+    driver wrapper ``{"parsed": {...}, ...}``, or a MULTICHIP driver
+    blob ``{"n_devices", "rc", "ok", "skipped", "tail"}``.
+
+    MULTICHIP blobs: since round 8 ``dryrun_multichip`` prints one
+    structured JSON record (``multichip_schema_version`` + per-leg
+    ``leg_*`` fields) as its last line, which the driver captures inside
+    ``tail`` — extract it so the diff gates legs, not log prose.  Legacy
+    blobs (rounds ≤7) degrade to their scalar fields with the prose
+    dropped."""
     with open(path, encoding="utf-8") as f:
         data = json.load(f)
     if isinstance(data, dict) and isinstance(data.get("parsed"), dict):
         return data["parsed"]
     if not isinstance(data, dict):
         raise ValueError(f"{path}: not a JSON object")
+    if "tail" in data:
+        for line in reversed(str(data["tail"]).splitlines()):
+            line = line.strip()
+            if not (line.startswith("{") and line.endswith("}")):
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and "multichip_schema_version" in rec:
+                if data.get("n_devices") is not None:
+                    rec.setdefault("n_devices", data["n_devices"])
+                return rec
+        return {k: v for k, v in data.items() if k != "tail"}
     return data
 
 

@@ -1,19 +1,27 @@
-"""Schema of the driver-bench JSON record (``bench.py``'s one line).
+"""Schema of the driver-bench JSON records (``bench.py``'s one line and
+``__graft_entry__.dryrun_multichip``'s one line).
 
 The standing measurement rule (ROADMAP) is that every README/PERF
 headline quotes a driver artifact — which only works if the artifact's
 fields are stable and auditable.  This module is the registry: every
-field ``bench.py`` may emit, its type, and its unit, plus
-:func:`validate_record` which the bench runs over its record before
+field the drivers may emit, its type, and its unit, plus
+:func:`validate_record` which the drivers run over their records before
 printing (fail-soft: schema drift is reported to stderr, never allowed
 to lose a measured record).
 
-Two field families are pattern-based rather than enumerated:
+Three field families are pattern-based rather than enumerated:
 
 - ``offload_<row>_*`` — one group per offload bench row (``gpt2_large``,
   ``gpt2_large_bf16``, ``gpt2_xl``, ...).  Since round 6 every row
   carries ``host_state_dtype`` and ``host_state_bytes_per_step`` so the
-  reduced-precision wire-bytes claim is checkable from the JSON alone.
+  reduced-precision wire-bytes claim is checkable from the JSON alone;
+  since round 8 each adds ``comm_wire_bytes_per_step``.
+- ``leg_<name>_*`` — one group per multichip-dryrun leg (``zero2``,
+  ``pipe``, ``pipe_3d``, ...): per-leg status, losses, the dp=1
+  parity-reference loss, and the compile-time comm receipts — the
+  structured replacement for the old ``{n_devices, rc, ok, tail}``
+  MULTICHIP blob (``tools/bench_diff.py --self-check`` gates the
+  ``MULTICHIP_r0*.json`` history with these).
 - ``*_exc`` / ``*_error`` — per-row failure strings (a secondary row
   failure must never lose the validated primary metric).
 """
@@ -64,7 +72,42 @@ FIELDS = {
                        "peak_bytes_in_use summed over local devices"),
     "predicted_temp_bytes": (numbers.Integral,
                              "train_step memory_analysis temp bytes"),
+    # communication receipts (round 8, profiling/comm): the compiled
+    # step program's collective count and predicted wire bytes from the
+    # compile-time HLO walk — the static comm receipt next to the
+    # memory one (dp=1 single-chip rows legitimately read 0)
+    "comm_collectives_per_step": (numbers.Integral,
+                                  "collective ops in the step program"),
+    "comm_wire_bytes_per_step": (numbers.Integral,
+                                 "predicted wire bytes per step"),
+    # multichip-dryrun record envelope (dryrun_multichip's one line;
+    # legacy blobs keep n_devices/rc/ok/skipped readable)
+    "multichip_schema_version": (numbers.Integral, ""),
+    "n_devices": (numbers.Integral, "virtual device count"),
+    "axes": (str, "mesh axes exercised"),
+    "legs_ok": (numbers.Integral, "legs that passed"),
+    "legs_failed": (numbers.Integral, "legs that failed"),
+    "legs_skipped": (numbers.Integral, ""),
+    "rc": (numbers.Integral, "legacy driver wrapper exit code"),
+    "ok": (bool, "legacy driver wrapper flag"),
+    "skipped": (bool, "legacy driver wrapper flag"),
 }
+
+# multichip leg fields: leg_<name>_<field>
+_LEG_FIELDS = {
+    "status": str,                       # ok | failed | skipped
+    "loss": numbers.Real,                # first-step loss
+    "loss2": numbers.Real,               # post-update second-step loss
+    "parity_ref_loss": numbers.Real,     # dp=1 reference, same batches
+    "comm_collectives": numbers.Integral,
+    "comm_payload_bytes": numbers.Integral,
+    "comm_wire_bytes": numbers.Integral,
+    "error": str,
+    "note": str,
+}
+_LEG_RE = re.compile(
+    r"^leg_(?P<leg>[a-z0-9_]+?)_(?P<field>%s)$"
+    % "|".join(sorted(_LEG_FIELDS, key=len, reverse=True)))
 
 # offload row fields: offload_<row>_<field>
 _OFFLOAD_ROW_FIELDS = {
@@ -81,6 +124,9 @@ _OFFLOAD_ROW_FIELDS = {
     "peak_hbm_bytes": numbers.Integral,
     "predicted_temp_bytes": numbers.Integral,
     "host_buffer_bytes": numbers.Integral,
+    # comm receipts (round 8)
+    "comm_collectives_per_step": numbers.Integral,
+    "comm_wire_bytes_per_step": numbers.Integral,
     "error": str,
     "note": str,
 }
@@ -119,6 +165,19 @@ THRESHOLDS = {
     "compile_seconds_warm": ("lower", 0.50),
     "peak_hbm_bytes": ("lower", 0.10),
     "predicted_temp_bytes": ("lower", 0.10),
+    # a step program that starts moving substantially more wire bytes
+    # is a sharding/collective regression even before it shows up in
+    # step time (generous tol: XLA is free to re-split collectives)
+    "comm_wire_bytes_per_step": ("lower", 0.25),
+    # multichip: device-count or passing-leg shrinkage must show
+    "n_devices": ("higher", 0.0),
+    "legs_ok": ("higher", 0.0),
+    "legs_failed": ("lower", 0.0),
+}
+
+# thresholds for the pattern-based leg_<name>_<field> family
+_LEG_FIELD_THRESHOLDS = {
+    "comm_wire_bytes": ("lower", 0.25),
 }
 
 # thresholds for the pattern-based offload_<row>_<field> family
@@ -128,6 +187,7 @@ _OFFLOAD_FIELD_THRESHOLDS = {
     "peak_hbm_bytes": ("lower", 0.10),
     "predicted_temp_bytes": ("lower", 0.10),
     "host_buffer_bytes": ("lower", 0.10),
+    "comm_wire_bytes_per_step": ("lower", 0.25),
 }
 
 
@@ -140,6 +200,9 @@ def threshold_for(key):
     if m:
         return _OFFLOAD_FIELD_THRESHOLDS.get(m.group("field"),
                                              (None, None))
+    m = _LEG_RE.match(key)
+    if m:
+        return _LEG_FIELD_THRESHOLDS.get(m.group("field"), (None, None))
     return (None, None)
 
 
@@ -150,6 +213,9 @@ def field_type(key):
     m = _OFFLOAD_RE.match(key)
     if m:
         return _OFFLOAD_ROW_FIELDS[m.group("field")]
+    m = _LEG_RE.match(key)
+    if m:
+        return _LEG_FIELDS[m.group("field")]
     if _EXC_RE.match(key):
         return str
     return None
@@ -159,7 +225,8 @@ def validate_record(record):
     """Return a list of problem strings (empty = schema-clean).
 
     Booleans are rejected where numbers are expected (bool is an int
-    subclass — a True smuggled into a metric field is a bug)."""
+    subclass — a True smuggled into a metric field is a bug; the two
+    declared-bool legacy wrapper flags are the only exception)."""
     problems = []
     for key, value in record.items():
         want = field_type(key)
@@ -167,7 +234,7 @@ def validate_record(record):
             problems.append(f"unknown bench field {key!r}")
             continue
         ok = isinstance(value, want) and not (
-            want is not str and isinstance(value, bool))
+            want not in (str, bool) and isinstance(value, bool))
         if not ok:
             problems.append(
                 f"bench field {key!r} expected {want.__name__}, got "

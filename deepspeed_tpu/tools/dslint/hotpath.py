@@ -111,6 +111,21 @@ register_rule(Rule(
                  "MemoryLedger.record at program-build time."))
 
 register_rule(Rule(
+    id="DSH205", name="driver-skew-export", severity="warning",
+    summary="latency/skew telemetry export outside the steps_per_print "
+            "cadence in driver code",
+    rationale="Per-rank skew export (latency-ring snapshots, the "
+              "latency-rank*.json publish/read exchange) does host "
+              "arithmetic plus run-dir file I/O: cheap at print cadence, "
+              "a per-step cost multiplier on the hot path.  The comm-"
+              "telemetry contract is that it rides the existing batched "
+              "steps_per_print fetch, adding zero per-step work.",
+    autofix_hint="Call latency_snapshot/publish_rank_latency/"
+                 "read_fleet_latencies only from code reached through an "
+                 "`if ... steps_per_print ...:` guard (e.g. the engine's "
+                 "_sample_comm_skew)."))
+
+register_rule(Rule(
     id="DSH203", name="driver-unbatched-sync", severity="warning",
     summary="multiple separate host-sync sites in one driver function",
     rationale="Each device_get/.item()/sync-property read is an "
@@ -256,24 +271,83 @@ def _driver_roots(index: ModuleIndex):
     return roots
 
 
+def _mentions_steps_per_print(node) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr == "steps_per_print":
+            return True
+        if isinstance(sub, ast.Name) and sub.id == "steps_per_print":
+            return True
+    return False
+
+
+def _guarded_call_ids(fn, node_map):
+    """ids of Call nodes in ``fn``'s own body that are lexically inside
+    an ``if`` whose test mentions ``steps_per_print`` — the print-cadence
+    guard the DSH205 skew-export contract keys on."""
+    guarded = set()
+
+    def walk(node, in_guard):
+        if id(node) in node_map:
+            return  # nested def: its body is its own FuncNode
+        if isinstance(node, ast.If):
+            walk_children(node.test, in_guard)
+            body_guard = in_guard or _mentions_steps_per_print(node.test)
+            for child in node.body:
+                walk_children(child, body_guard, top=True)
+            for child in node.orelse:
+                walk_children(child, in_guard, top=True)
+            return
+        if isinstance(node, ast.Call) and in_guard:
+            guarded.add(id(node))
+        walk_children(node, in_guard)
+
+    def walk_children(node, in_guard, top=False):
+        if top:
+            walk(node, in_guard)
+            return
+        for child in ast.iter_child_nodes(node):
+            walk(child, in_guard)
+
+    root = fn.node
+    if isinstance(root, ast.Lambda):
+        walk(root.body, False)
+    else:
+        for stmt in root.body:
+            walk(stmt, False)
+    return guarded
+
+
 def _driver_closure(index: ModuleIndex, roots):
-    """Roots + same-class methods reached through self-calls (jit-hot
-    functions are covered by the DSH1xx walk instead)."""
+    """(closure, unguarded) — roots + same-class methods reached through
+    self-calls (jit-hot functions are covered by the DSH1xx walk
+    instead).  ``unguarded`` is the subset reachable from a root through
+    a call chain with NO ``steps_per_print`` guard on any edge: per-step
+    code.  Members of the closure absent from ``unguarded`` run only at
+    the print cadence (the DSH205 skew-export contract)."""
     seen = set(roots)
+    unguarded = set(roots)
     frontier = list(roots)
     while frontier:
         fn = frontier.pop()
+        guarded_ids = _guarded_call_ids(fn, index.node_map)
         for node, _ in body_nodes(fn, index.node_map):
             if (isinstance(node, ast.Call)
                     and isinstance(node.func, ast.Attribute)
                     and isinstance(node.func.value, ast.Name)
                     and node.func.value.id == "self"):
                 target = index.resolve_self_attr(node.func.attr, fn)
-                if (target is not None and target not in seen
-                        and target not in index.hot):
+                if target is None or target in index.hot:
+                    continue
+                edge_unguarded = (fn in unguarded
+                                  and id(node) not in guarded_ids)
+                if target not in seen:
                     seen.add(target)
                     frontier.append(target)
-    return seen - index.hot
+                if edge_unguarded and target not in unguarded:
+                    # re-walk: its own edges now propagate unguarded
+                    unguarded.add(target)
+                    frontier.append(target)
+    return seen - index.hot, unguarded - index.hot
 
 
 def _sync_properties(index: ModuleIndex, cls_name: str):
@@ -291,13 +365,36 @@ def _sync_properties(index: ModuleIndex, cls_name: str):
     return out
 
 
-def _check_driver_function(pf: ParsedFile, index: ModuleIndex, fn) -> List:
+# latency/skew export surface (profiling/step_profiler.StepLatencyRing
+# + profiling/comm's per-rank exchange): print-cadence-only by contract
+_SKEW_EXPORT_CALLS = {"latency_snapshot", "publish_rank_latency",
+                      "read_fleet_latencies"}
+
+
+def _is_skew_export(node: ast.Call) -> bool:
+    return call_name(node).rsplit(".", 1)[-1] in _SKEW_EXPORT_CALLS
+
+
+def _check_driver_function(pf: ParsedFile, index: ModuleIndex, fn,
+                           cadence_only=False) -> List:
     out = []
     sync_props = (_sync_properties(index, fn.class_name)
                   if fn.class_name else set())
+    guarded_ids = (_guarded_call_ids(fn, index.node_map)
+                   if not cadence_only else None)
     sites = []  # (node, kind, in_loop)
     for node, in_loop in body_nodes(fn, index.node_map):
         if isinstance(node, ast.Call):
+            if (not cadence_only and _is_skew_export(node)
+                    and id(node) not in guarded_ids):
+                # reachable per step AND not under a local
+                # steps_per_print guard: the skew export would run on
+                # the hot path
+                out.append(diag(
+                    pf, node, "DSH205",
+                    f"{call_name(node)}(...) in driver '{fn.qualname}': "
+                    "latency/skew export on the per-step path; move it "
+                    "under the steps_per_print cadence guard"))
             if _is_item_call(node):
                 sites.append((node, f".{node.func.attr}()", in_loop))
                 out.append(diag(pf, node, "DSH201",
@@ -348,7 +445,10 @@ def check_hotpath(pf: ParsedFile) -> List:
     out = []
     for fn in sorted(index.hot, key=lambda f: f.node.lineno):
         out.extend(_check_hot_function(pf, index, fn))
-    for fn in sorted(_driver_closure(index, _driver_roots(index)),
-                     key=lambda f: f.node.lineno):
-        out.extend(_check_driver_function(pf, index, fn))
+    closure, unguarded = _driver_closure(index, _driver_roots(index))
+    for fn in sorted(closure, key=lambda f: f.node.lineno):
+        # cadence_only: every path from a driver root to fn crosses a
+        # steps_per_print guard — skew export is in-contract there
+        out.extend(_check_driver_function(pf, index, fn,
+                                          cadence_only=fn not in unguarded))
     return out

@@ -340,6 +340,70 @@ def record(compiled):
     assert ids == []
 
 
+def test_dsh205_skew_export_on_step_path(tmp_path):
+    # latency/skew export called per step, no cadence guard anywhere
+    ids = lint_source(tmp_path, """
+from profiling.comm import publish_rank_latency
+
+class TrainEngine:
+    def train_batch(self, it):
+        snap = self._ring.latency_snapshot()
+        publish_rank_latency(self._run_dir, 0, snap)
+""")
+    assert ids == ["DSH205", "DSH205"] or ids == ["DSH205"]
+
+
+def test_dsh205_guarded_export_is_clean(tmp_path):
+    # the contract form: export lexically under the steps_per_print guard
+    ids = lint_source(tmp_path, """
+from profiling.comm import publish_rank_latency, read_fleet_latencies
+
+class TrainEngine:
+    def train_batch(self, it):
+        self.global_steps += 1
+        if self.global_steps % self.steps_per_print() == 0:
+            snap = self._ring.latency_snapshot()
+            publish_rank_latency(self._run_dir, 0, snap)
+            read_fleet_latencies(self._run_dir)
+""")
+    assert ids == []
+
+
+def test_dsh205_export_helper_reached_only_through_guard(tmp_path):
+    # the engine shape: a _sample_* helper holding the export calls,
+    # reachable ONLY through a steps_per_print-guarded call site
+    ids = lint_source(tmp_path, """
+from profiling.comm import publish_rank_latency
+
+class TrainEngine:
+    def _sample_comm_skew(self):
+        snap = self._ring.latency_snapshot()
+        publish_rank_latency(self._run_dir, 0, snap)
+
+    def train_batch(self, it):
+        if self.global_steps % self.steps_per_print() == 0:
+            self._sample_comm_skew()
+""")
+    assert ids == []
+
+
+def test_dsh205_helper_also_reachable_unguarded_is_flagged(tmp_path):
+    # one unguarded path into the helper poisons it: per-step export
+    ids = lint_source(tmp_path, """
+from profiling.comm import publish_rank_latency
+
+class TrainEngine:
+    def _sample_comm_skew(self):
+        publish_rank_latency(self._run_dir, 0, {})
+
+    def train_batch(self, it):
+        self._sample_comm_skew()
+        if self.global_steps % self.steps_per_print() == 0:
+            self._sample_comm_skew()
+""")
+    assert ids == ["DSH205"]
+
+
 def test_non_engine_class_is_not_driver_scope(tmp_path):
     # benchmarks/profilers sync deliberately; only Engine/Scaler classes
     # carry step-cadence semantics

@@ -347,6 +347,18 @@ def test_engine_zero_added_host_syncs(cpu_devices, tmp_path, monkeypatch):
         profiling={"memory_ledger": True, "memory_watermarks": True}))
     assert mem == base, (f"memory observability added host syncs: {mem} "
                          f"device_get calls vs {base} baseline")
+    # comm observability on top, on the multi-device (virtual CPU) mesh
+    # this test already runs: the collective ledger walks HLO text at
+    # compile time and the per-rank latency/skew export is host floats
+    # + run-dir file I/O at the steps_per_print cadence — still ZERO
+    # added device_get calls, even with the straggler hook armed
+    comm = count_gets(tel_config(
+        tmp_path / "c", trace=True,
+        resilience=dict(resilience, straggler_factor=2.0),
+        profiling={"memory_ledger": True, "memory_watermarks": True,
+                   "comm_ledger": True}))
+    assert comm == base, (f"comm observability added host syncs: {comm} "
+                          f"device_get calls vs {base} baseline")
 
 
 def test_engine_step_metrics_and_monitor_preserved(cpu_devices, tmp_path):
