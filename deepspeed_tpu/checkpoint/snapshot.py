@@ -196,6 +196,14 @@ def capture_engine_snapshot(engine, tag, client_state=None, save_latest=True):
         "param_count": int(sum(engine.segments.sizes)),
         "model_dtypes": model_dtypes,
     }
+    # dataloader/sampler cursor: a resumed run — possibly at a DIFFERENT
+    # dp degree on the elastic schedule — must consume the exact next
+    # global batches (no replay, no skip).  Saves happen at optimizer-
+    # step boundaries, so the position is a multiple of the fixed global
+    # batch and re-factors over any valid micro x dp geometry.
+    loader = getattr(engine, "training_dataloader", None)
+    if loader is not None and hasattr(loader, "state_dict"):
+        meta["data_state"] = loader.state_dict()
     if state_dtype_meta is not None:
         # which storage layout wrote this checkpoint: loads into the
         # SAME layout restore raw buffers bit-exactly; any other layout

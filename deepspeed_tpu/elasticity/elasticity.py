@@ -100,17 +100,51 @@ def elasticity_enabled(ds_config: dict):
     return ds_config[EC.ELASTICITY].get(EC.ENABLED, EC.ENABLED_DEFAULT)
 
 
+def parse_version(version) -> tuple:
+    """``"0.3.11"`` / ``0.1`` / ``"0"`` -> a comparable numeric tuple,
+    zero-padded to three components so ``"0" == "0.0.0"`` (this repo's
+    versions are plain dotted numerics; anything else raises)."""
+    parts = str(version).strip().split(".")
+    try:
+        nums = tuple(int(p) for p in parts)
+    except ValueError as e:
+        raise ElasticityConfigError(
+            f"cannot parse version {version!r} as a dotted numeric") from e
+    return nums + (0,) * (3 - len(nums)) if len(nums) < 3 else nums
+
+
+def _normalize_field(field, value):
+    """Canonical form of one immutability-checked field, so a respawned
+    process comparing its runtime config against the
+    ``DEEPSPEED_ELASTICITY_CONFIG`` json the launcher exported never
+    rejects a SAME-config resume over representation drift: version
+    compares as a zero-padded numeric tuple (``0.1`` vs ``"0.1"`` vs
+    ``"0.1.0"``), micro-batch lists as sorted int tuples (json
+    round-trips tuples into lists)."""
+    if field == "version":
+        return parse_version(value)
+    if field == "micro_batches":
+        return tuple(sorted(int(m) for m in value))
+    return value
+
+
 def ensure_immutable_elastic_config(runtime_elastic_config_dict: dict):
     """Fail if the resource scheduler planned with a different elastic config
     than the runtime sees (reference ``elasticity.py:206-237``); the plan is
-    carried in the ``DEEPSPEED_ELASTICITY_CONFIG`` env var."""
+    carried in the ``DEEPSPEED_ELASTICITY_CONFIG`` env var.
+
+    Comparisons are value-based, not representation-based: a launcher
+    respawn re-exports the same config through json, and ``0.1 != "0.1"``
+    must not kill an elastic resume (the resize-on-failure loop re-enters
+    here on every respawn)."""
     if EC.DEEPSPEED_ELASTICITY_CONFIG in os.environ:
         scheduler_config = ElasticityConfig(
             json.loads(os.environ[EC.DEEPSPEED_ELASTICITY_CONFIG]))
         runtime_config = ElasticityConfig(runtime_elastic_config_dict)
         for field in ("max_acceptable_batch_size", "micro_batches", "version"):
-            sched_val = getattr(scheduler_config, field)
-            run_val = getattr(runtime_config, field)
+            sched_val = _normalize_field(field,
+                                         getattr(scheduler_config, field))
+            run_val = _normalize_field(field, getattr(runtime_config, field))
             if sched_val != run_val:
                 raise ElasticityConfigError(
                     f"Elastic config {field}={sched_val} seen by resource scheduler does "
@@ -121,12 +155,27 @@ def ensure_immutable_elastic_config(runtime_elastic_config_dict: dict):
             "guarantee resource scheduler will scale this job using compatible device counts.")
 
 
-def compute_elastic_config(ds_config: dict, target_deepspeed_version: str, world_size=0):
+def compute_elastic_config(ds_config: dict, target_deepspeed_version=None,
+                           world_size=0):
     """Compute (final_batch_size, valid_device_counts[, micro_batch]) for an
-    elastic job (reference ``elasticity.py:240-334``)."""
+    elastic job (reference ``elasticity.py:240-334``).
+
+    ``target_deepspeed_version`` defaults to this package's own version;
+    passing one checks it against :data:`EC.MINIMUM_DEEPSPEED_VERSION`
+    under THIS repo's versioning (plain dotted numerics, zero-padded, so
+    the historical ``"0"`` sentinel still means ``0.0.0``, not a parse
+    error — the reference compared version strings lexically)."""
     if not isinstance(ds_config, dict):
         raise ValueError(
             f"Expected ds_config dict, got {type(ds_config)}: {ds_config}")
+    if target_deepspeed_version is None:
+        from .. import __version__ as target_deepspeed_version
+    if (parse_version(target_deepspeed_version)
+            < parse_version(EC.MINIMUM_DEEPSPEED_VERSION)):
+        raise ElasticityConfigError(
+            f"target deepspeed version {target_deepspeed_version} is older "
+            f"than the minimum elasticity-capable version "
+            f"{EC.MINIMUM_DEEPSPEED_VERSION}")
     if EC.ELASTICITY not in ds_config:
         raise ElasticityConfigError(
             f"'{EC.ELASTICITY}' is missing from config json, please add it if "
@@ -138,12 +187,15 @@ def compute_elastic_config(ds_config: dict, target_deepspeed_version: str, world
             "running an elastic training job.")
 
     elastic_config = ElasticityConfig(elastic_config_dict)
-    if float(elastic_config.version) > EC.LATEST_ELASTICITY_VERSION:
+    # algorithm-version comparisons go through parse_version too, so
+    # "0.1.0" means v0.1 instead of crashing float()
+    if (parse_version(elastic_config.version)
+            > parse_version(EC.LATEST_ELASTICITY_VERSION)):
         raise ElasticityConfigError(
             f"Attempting to run elasticity version {elastic_config.version} but "
             f"runtime only supports up to {EC.LATEST_ELASTICITY_VERSION}")
 
-    if float(elastic_config.version) == 0.1:
+    if parse_version(elastic_config.version) == parse_version("0.1"):
         final_batch_size, valid_gpus = _get_compatible_gpus_v01(
             micro_batches=elastic_config.micro_batches,
             max_acceptable_batch_size=elastic_config.max_acceptable_batch_size,

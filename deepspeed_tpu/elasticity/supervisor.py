@@ -1,0 +1,109 @@
+"""Elastic fleet supervisor: the re-planning half of resize-on-failure.
+
+The HCN planner (:func:`~deepspeed_tpu.elasticity.compute_elastic_config`)
+is ahead-of-time: it fixes ONE global batch size and the set of device
+counts that batch can re-factor over without changing convergence.  This
+module turns that plan into the launcher's runtime decision: given the
+devices still alive after a failure or preemption notice, pick the
+largest valid world size that fits, re-derive micro-batch x grad-accum
+so the global batch stays on the pre-declared schedule, and hand the
+launcher the env contract its respawned children resume under.
+
+Env contract (consumed by training scripts and ``DeepSpeedConfig``):
+
+- ``DS_ELASTIC_TARGET_WORLD_SIZE`` — the data-parallel world size the
+  supervisor planned for this (re)spawn; scripts size their mesh from it
+  (:func:`elastic_world_size`).
+- ``DEEPSPEED_ELASTICITY_CONFIG`` — the normalized elastic config json,
+  so ``ensure_immutable_elastic_config`` proves every respawn still
+  trains on the same schedule (a drifted config fails loudly instead of
+  silently changing convergence).
+
+Jax-free on purpose: the launcher imports this next to its other
+stdlib-only collaborators.
+"""
+
+import json
+import os
+from collections import namedtuple
+
+from ..utils.logging import logger
+from . import constants as EC
+from .config import ElasticityIncompatibleWorldSize
+from .elasticity import compute_elastic_config
+
+#: env var carrying the supervisor's planned data-parallel world size
+DS_ELASTIC_TARGET_WORLD_SIZE = "DS_ELASTIC_TARGET_WORLD_SIZE"
+
+ElasticPlan = namedtuple(
+    "ElasticPlan",
+    ["world_size",        # planned data-parallel device count
+     "micro_batch",       # per-device micro batch at that world size
+     "grad_accum",        # accumulation steps keeping the global batch
+     "global_batch",      # the schedule's fixed global batch size
+     "valid_world_sizes"  # every device count the schedule admits
+     ])
+
+
+def elastic_world_size(default=None):
+    """The supervisor-planned world size for THIS process (or
+    ``default`` when launched outside an elastic supervisor)."""
+    val = os.environ.get(DS_ELASTIC_TARGET_WORLD_SIZE, "")
+    return int(val) if val else default
+
+
+def normalized_elastic_config(elastic_config_dict: dict) -> dict:
+    """Canonical, json-stable form of an ``elasticity`` config block —
+    what the supervisor exports as ``DEEPSPEED_ELASTICITY_CONFIG``.
+    Micro-batch lists sort into one representation; the version rides
+    through untouched (the immutability check compares versions as
+    parsed numeric tuples, so ``0.1`` / ``"0.1"`` / ``"0.1.0"`` already
+    agree without lossy coercion here)."""
+    out = dict(elastic_config_dict)
+    if EC.MICRO_BATCHES in out:
+        out[EC.MICRO_BATCHES] = sorted(int(m) for m in out[EC.MICRO_BATCHES])
+    return out
+
+
+def plan_world_size(elastic_config_dict: dict, device_budget: int,
+                    target_deepspeed_version=None) -> ElasticPlan:
+    """Largest planner-valid world size not exceeding ``device_budget``,
+    with the micro-batch x grad-accum factorization that keeps the
+    global batch on the elastic schedule.
+
+    Raises :class:`ElasticityIncompatibleWorldSize` when no valid device
+    count fits the budget (fleet shrunk below the schedule's floor) —
+    the launcher treats that as a terminal, non-respawnable condition.
+    """
+    ds_config = {EC.ELASTICITY: dict(elastic_config_dict)}
+    final_batch, valid = compute_elastic_config(
+        ds_config, target_deepspeed_version=target_deepspeed_version)
+    fits = [w for w in valid if w <= int(device_budget)]
+    if not fits:
+        raise ElasticityIncompatibleWorldSize(
+            f"no valid elastic world size fits {device_budget} surviving "
+            f"device(s); the schedule admits {valid}")
+    world = max(fits)
+    _, _, micro = compute_elastic_config(
+        ds_config, target_deepspeed_version=target_deepspeed_version,
+        world_size=world)
+    accum = final_batch // (micro * world)
+    plan = ElasticPlan(world_size=world, micro_batch=micro,
+                       grad_accum=accum, global_batch=final_batch,
+                       valid_world_sizes=tuple(valid))
+    logger.info(
+        "elastic plan: %d surviving device(s) -> world_size=%d "
+        "(micro=%d x accum=%d x dp=%d = global %d)", device_budget,
+        world, micro, accum, world, final_batch)
+    return plan
+
+
+def export_plan_env(env: dict, elastic_config_dict: dict,
+                    plan: ElasticPlan) -> dict:
+    """Write the elastic env contract for one child spawn into ``env``
+    (mutated and returned): the planned world size plus the normalized
+    schedule for the immutability check on resume."""
+    env[DS_ELASTIC_TARGET_WORLD_SIZE] = str(plan.world_size)
+    env[EC.DEEPSPEED_ELASTICITY_CONFIG] = json.dumps(
+        normalized_elastic_config(elastic_config_dict), sort_keys=True)
+    return env

@@ -14,25 +14,49 @@ Resilience contract (``deepspeed_tpu/resilience``):
   ``poll()`` code would wrap to a meaningless 24x value;
 - ``--max-restarts N`` respawns a failed child up to N times with
   exponential backoff (``DS_RESTART_BACKOFF_SECS``, default 2s, doubling
-  per restart of that slot) — pair with ``deepspeed.initialize(...,
-  auto_resume=True)`` so respawns land on the last committed checkpoint;
+  per restart of that slot, jittered by ``DS_RESTART_BACKOFF_JITTER`` so
+  a fleet of launchers does not re-dial the coordinator in lockstep) —
+  pair with ``deepspeed.initialize(..., auto_resume=True)`` so respawns
+  land on the last committed checkpoint;
 - **poison** exit codes (:data:`POISON_EXIT_CODES`, e.g. a divergence
   abort) never respawn: restarting would replay the same data into the
   same divergence.
+
+Elastic resize-on-failure (``--elastic-config``, ROADMAP item 5): with
+an elastic schedule armed, a *respawnable* child death — watchdog exit
+85, a signal death, or a SIGTERM preemption notice the child drained its
+final save under — no longer respawns the fleet at the same world size.
+The supervisor (``elasticity/supervisor.py``) subtracts the failed
+capacity from the device budget, asks the HCN planner for the largest
+valid world size that still fits, re-derives micro-batch x grad-accum so
+the global batch stays on the pre-declared schedule, and respawns the
+whole fleet at the new size — sharing the compile cache so the resume is
+warm, exporting ``DS_ELASTIC_TARGET_WORLD_SIZE`` so scripts size their
+mesh, and ``DEEPSPEED_ELASTICITY_CONFIG`` so the runtime's immutability
+check proves every life trains the same schedule.  Poison codes still
+tear the node down: a divergence is never "resized around".
 """
 
 import argparse
+import json
 import os
+import random
 import signal
 import socket
 import subprocess
 import sys
 import time
 
+from ..elasticity.config import (ElasticityError,
+                                 ElasticityIncompatibleWorldSize)
+from ..elasticity.constants import ELASTICITY
+from ..elasticity.supervisor import export_plan_env, plan_world_size
 from ..resilience.constants import POISON_EXIT_CODES
 # stdlib-only import chain on purpose: the launcher must not need jax
-from ..telemetry.events import (EVENT_PROC_EXIT, EVENT_PROC_RESPAWN,
-                                EVENT_PROC_SPAWN, EVENT_RUN_END, EventLog)
+# (the elasticity planner/supervisor above are plain-python too)
+from ..telemetry.events import (EVENT_ELASTIC, EVENT_PROC_EXIT,
+                                EVENT_PROC_RESPAWN, EVENT_PROC_SPAWN,
+                                EVENT_RUN_END, EventLog)
 from ..utils.logging import logger
 from .constants import (ENV_COORDINATOR, ENV_LOCAL_RANK, ENV_NUM_PROCESSES,
                         ENV_PROCESS_ID)
@@ -67,6 +91,24 @@ def parse_args(args=None):
                              "programs from here instead of recompiling — "
                              "stdlib-only on this side, jax reads the env "
                              "var natively in the child")
+    parser.add_argument("--elastic-config", "--elastic_config", type=str,
+                        default=os.environ.get("DS_ELASTIC_CONFIG", ""),
+                        dest="elastic_config",
+                        help="json file (a ds_config with an 'elasticity' "
+                             "block, or a bare elasticity block) arming "
+                             "elastic resize-on-failure: respawnable child "
+                             "deaths re-plan the world size via the HCN "
+                             "planner instead of respawning at the same "
+                             "size")
+    parser.add_argument("--elastic-devices", "--elastic_devices", type=int,
+                        default=int(os.environ.get("DS_ELASTIC_DEVICES",
+                                                   "0")),
+                        dest="elastic_devices",
+                        help="initial accelerator budget for the elastic "
+                             "supervisor (default: one device per slot); "
+                             "each respawnable failure subtracts "
+                             "DS_ELASTIC_DEVICES_PER_FAILURE (default: "
+                             "devices/processes) before re-planning")
     parser.add_argument("training_script", type=str)
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     ns = parser.parse_args(args)
@@ -89,6 +131,32 @@ def map_exit_code(ret):
     except ValueError:
         name = f"signal {signum}"
     return 128 + signum, name
+
+
+def load_elastic_config(path):
+    """Read the ``elasticity`` block from ``path`` — a full ds_config
+    json or a bare elasticity block — and require it enabled (an armed
+    supervisor with a disabled schedule is a config error, not a silent
+    no-op)."""
+    with open(path) as f:
+        cfg = json.load(f)
+    block = cfg.get(ELASTICITY, cfg) if isinstance(cfg, dict) else None
+    if not isinstance(block, dict):
+        raise ValueError(f"--elastic-config {path}: expected a json object")
+    if not block.get("enabled", False):
+        raise ValueError(
+            f"--elastic-config {path}: elasticity block is not enabled "
+            "('enabled': true required to arm resize-on-failure)")
+    return block
+
+
+def backoff_jitter():
+    """Multiplicative backoff jitter factor in [1, 1+DS_RESTART_BACKOFF_
+    JITTER] (default 0.25): desynchronizes a fleet of launchers that all
+    lost children to the same event, so the coordinator is not re-dialed
+    in lockstep."""
+    jitter = float(os.environ.get("DS_RESTART_BACKOFF_JITTER", "0.25"))
+    return 1.0 + max(0.0, jitter) * random.random()
 
 
 def resolve_node_rank(node_rank, world):
@@ -126,8 +194,41 @@ def main(argv=None):
         if tel is not None:
             tel.emit(event_type, **data)
 
-    children = []   # [{proc, cmd, env, rank, restarts}]
-    for local_rank, slot in enumerate(local_slots):
+    # -- elastic supervisor state (resize-on-failure; tentpole of the
+    # preemptible-fleet story).  Armed by --elastic-config; the initial
+    # world size ALSO comes from the planner so the first life and every
+    # resized life share one derivation path.
+    elastic = None
+    if args.elastic_config:
+        if len(hosts) > 1:
+            raise RuntimeError(
+                "--elastic-config: elastic resize-on-failure currently "
+                "supervises a single-node fleet (one spawner owns the "
+                "whole respawn decision); multi-node resize needs a "
+                "cross-node supervisor")
+        elastic_dict = load_elastic_config(args.elastic_config)
+        budget = args.elastic_devices or len(local_slots)
+        per_failure = int(os.environ.get(
+            "DS_ELASTIC_DEVICES_PER_FAILURE",
+            str(max(1, budget // max(1, len(local_slots))))))
+        plan = plan_world_size(elastic_dict, budget)
+        elastic = {"dict": elastic_dict, "budget": budget,
+                   "per_failure": per_failure, "plan": plan, "resizes": 0}
+        # the FIRST life is also sized by the planner: processes scale
+        # with the planned world size exactly as resizes do (a schedule
+        # whose largest valid world is below the slot count must not
+        # spawn extra ranks that own no mesh slice)
+        n0 = min(len(local_slots),
+                 max(1, round(len(local_slots) * plan.world_size
+                              / max(1, budget))))
+        local_slots = local_slots[:n0]
+        total = n0
+        logger.info(
+            f"elastic supervisor armed: budget {budget} device(s), "
+            f"world_size {plan.world_size} over {n0} process(es), "
+            f"{per_failure} device(s) charged per failure")
+
+    def spawn_env(local_rank, slot, n_procs):
         env = os.environ.copy()
         if args.compile_cache_dir:
             # warm-start contract for respawns: the child (and every
@@ -143,21 +244,39 @@ def main(argv=None):
             # one timeline and cross-rank skew needs no other channel
             env["DS_TELEMETRY_DIR"] = os.path.abspath(args.telemetry_dir)
         env[ENV_COORDINATOR] = f"{args.master_addr}:{args.master_port}"
-        env[ENV_NUM_PROCESSES] = str(total)
+        env[ENV_NUM_PROCESSES] = str(n_procs)
         env[ENV_PROCESS_ID] = str(first_id + local_rank)
         # the SLOT id from the (include/exclude-filtered) hostfile, so slot
         # filtering reaches the process; device binding from it is
         # platform-specific (e.g. TPU_VISIBLE_CHIPS), left to the script
         env[ENV_LOCAL_RANK] = str(slot)
-        cmd = [sys.executable, "-u", args.training_script, *args.script_args]
-        logger.info(f"launching process {first_id + local_rank}/{total}: "
-                    f"{' '.join(cmd)}")
-        children.append({"proc": subprocess.Popen(cmd, env=env),
-                         "cmd": cmd, "env": env,
-                         "rank": first_id + local_rank, "restarts": 0,
-                         "respawn_at": None})
-        tel_emit(EVENT_PROC_SPAWN, proc_rank=first_id + local_rank,
-                 pid=children[-1]["proc"].pid)
+        if elastic is not None:
+            # the planned world size + normalized schedule travel to the
+            # child: scripts size their mesh from the former, the
+            # runtime's ensure_immutable_elastic_config proves the
+            # latter never drifted across respawns
+            export_plan_env(env, elastic["dict"], elastic["plan"])
+        return env
+
+    def spawn_fleet(slots, n_procs, restart=None):
+        fleet = []
+        for local_rank, slot in enumerate(slots):
+            env = spawn_env(local_rank, slot, n_procs)
+            cmd = [sys.executable, "-u", args.training_script,
+                   *args.script_args]
+            logger.info(
+                f"launching process {first_id + local_rank}/{n_procs}: "
+                f"{' '.join(cmd)}")
+            fleet.append({"proc": subprocess.Popen(cmd, env=env),
+                          "cmd": cmd, "env": env,
+                          "rank": first_id + local_rank, "restarts": 0,
+                          "respawn_at": None})
+            tel_emit(EVENT_PROC_SPAWN, proc_rank=first_id + local_rank,
+                     pid=fleet[-1]["proc"].pid,
+                     **({} if restart is None else {"restart": restart}))
+        return fleet
+
+    children = spawn_fleet(local_slots, total)   # [{proc, cmd, env, ...}]
 
     # Children may install a preemption checkpoint hook (checkpoint
     # subsystem, "save_on_preemption") that drains one final synchronous
@@ -210,9 +329,68 @@ def main(argv=None):
     signal.signal(signal.SIGINT, forward_signal)
     signal.signal(signal.SIGTERM, forward_signal)
 
+    def elastic_resize(child, code, signame):
+        """One resize cycle: charge the failed capacity, re-plan, drain
+        the survivors (SIGTERM grace — their preemption saves land),
+        respawn the whole fleet at the planned size.  Returns the new
+        children list, or None when no valid world size is left."""
+        elastic["resizes"] += 1
+        elastic["budget"] -= elastic["per_failure"]
+        prev = elastic["plan"]
+        try:
+            plan = plan_world_size(elastic["dict"], elastic["budget"])
+        except ElasticityIncompatibleWorldSize as e:
+            logger.error(f"elastic resize: {e}; tearing the node down")
+            return None
+        # a SIGTERM death is read as a preemption notice: the child's
+        # grace-window save (checkpoint.save_on_preemption) already
+        # landed, so the resized fleet resumes from it warm
+        trigger = (f"preemption notice ({signame})"
+                   if signame == "SIGTERM" else
+                   f"signal death ({signame})" if signame else
+                   f"exit code {code}")
+        tel_emit(EVENT_ELASTIC, phase="plan",
+                 surviving_devices=elastic["budget"],
+                 prev_world_size=prev.world_size,
+                 planned_world_size=plan.world_size,
+                 micro_batch=plan.micro_batch,
+                 grad_accum=plan.grad_accum,
+                 global_batch=plan.global_batch,
+                 trigger=trigger, exit_code=code)
+        delay = (backoff_base * (2 ** (elastic["resizes"] - 1))
+                 * backoff_jitter())
+        # the respawn event carries the PLANNED world size: a reader of
+        # the launcher stream alone can see the fleet shrank, without
+        # joining against the engines' streams
+        tel_emit(EVENT_PROC_RESPAWN, proc_rank=child["rank"],
+                 restart=elastic["resizes"], backoff_secs=delay,
+                 exit_code=code, planned_world_size=plan.world_size)
+        logger.warning(
+            f"elastic resize {elastic['resizes']}/{args.max_restarts}: "
+            f"{trigger} -> world {prev.world_size} -> {plan.world_size} "
+            f"(micro={plan.micro_batch} x accum={plan.grad_accum}), "
+            f"respawning after {delay:.1f}s backoff")
+        # drain survivors under the SIGTERM grace before respawning: the
+        # fleet must not straddle two world sizes, and in-flight saves
+        # must commit before their writers die
+        terminate_all()
+        time.sleep(delay)
+        n_prev = max(1, len(children))
+        n_procs = max(1, round(n_prev * plan.world_size
+                               / max(1, prev.world_size)))
+        n_procs = min(n_procs, len(local_slots))
+        elastic["plan"] = plan
+        fleet = spawn_fleet(local_slots[:n_procs], n_procs,
+                            restart=elastic["resizes"])
+        tel_emit(EVENT_ELASTIC, phase="resize", procs=n_procs,
+                 world_size=plan.world_size, restart=elastic["resizes"])
+        return fleet
+
     # monitor: a failed child is respawned (up to --max-restarts, with
-    # exponential backoff) unless its exit code is poison; anything past
-    # the budget tears down the node (reference :151-167)
+    # jittered exponential backoff) unless its exit code is poison;
+    # with the elastic supervisor armed the respawn becomes a fleet
+    # RESIZE; anything past the budget tears down the node (reference
+    # :151-167)
     backoff_base = float(os.environ.get("DS_RESTART_BACKOFF_SECS", "2"))
     alive = list(children)
     rc = 0
@@ -248,14 +426,25 @@ def main(argv=None):
                 logger.error(f"{where} killed by {signame}; exit code "
                              f"mapped to {code}")
             if code in POISON_EXIT_CODES:
+                # a divergence abort is never "resized around": replaying
+                # the same data on a smaller fleet reaches the same
+                # divergence with less capacity
                 logger.error(
                     f"{where} exited with poison code {code} (e.g. "
                     "divergence abort): never respawning — terminating "
                     "the node")
-            elif (not tearing_down
+            elif (elastic is not None and not tearing_down
+                    and elastic["resizes"] < args.max_restarts):
+                fleet = elastic_resize(child, code, signame)
+                if fleet is not None:
+                    children = fleet
+                    alive = list(children)
+                    break   # the fleet was replaced wholesale
+            elif (elastic is None and not tearing_down
                     and child["restarts"] < args.max_restarts):
                 child["restarts"] += 1
-                delay = backoff_base * (2 ** (child["restarts"] - 1))
+                delay = (backoff_base * (2 ** (child["restarts"] - 1))
+                         * backoff_jitter())
                 logger.warning(
                     f"{where} exited with code {code}; respawning "
                     f"(restart {child['restarts']}/{args.max_restarts}) "

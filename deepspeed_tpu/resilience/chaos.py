@@ -9,8 +9,18 @@ Faults mirror the real-world menagerie:
   record / overflowed activation burst: non-finite loss AND gradients);
 - ``sigterm_steps`` — synthetic preemption notice, delivered to this
   process right before the step runs;
+- ``kill_steps`` — a host loss: the process dies mid-step (default
+  SIGKILL — no handler runs, exactly like a yanked preemptible VM);
+  the launcher's elastic supervisor reads the signal death as lost
+  capacity and resizes the fleet;
 - ``hang_steps`` — the step wedges (stuck collective / dead remote
   attachment): blocks on an event (test-controlled) or sleeps;
+
+Process-killing faults (``kill_steps``/``sigterm_steps``) can target a
+SPECIFIC rank: pass ``rank=<this process's rank>`` and
+``target_rank=<victim>`` and only the victim injects — the chaos
+schedule stays identical across the fleet (same seed everywhere), so
+"kill rank 3 at step k" reproduces exactly.
 - :meth:`corrupt_checkpoint` — flip bytes in a committed payload file
   (bit rot / torn storage);
 - :meth:`torn_tmp_dir` — fabricate a half-written ``<tag>.tmp`` dir (a
@@ -69,18 +79,34 @@ class ChaosMonkey:
         return poison(batch)
 
     def wrap_iter(self, data_iter, nan_steps=(), sigterm_steps=(),
-                  hang_steps=(), hang_event=None, hang_secs=None):
+                  hang_steps=(), hang_event=None, hang_secs=None,
+                  kill_steps=(), kill_signal=None, rank=0,
+                  target_rank=None):
         """Wrap a batch iterator, injecting faults at the given PULL
         indices (0-based; with gradient accumulation one optimizer step
         pulls ``acc`` batches).  ``hang_steps`` blocks on ``hang_event``
-        when given (the test releases it), else sleeps ``hang_secs``."""
+        when given (the test releases it), else sleeps ``hang_secs``.
+
+        ``kill_steps`` kills THIS process with ``kill_signal`` (default
+        SIGKILL: unhandleable, the preempted-host failure mode — the
+        elastic supervisor's respawn trigger).  The process-killing
+        faults (kill + sigterm) honor ``target_rank``: when set, only
+        the process whose ``rank`` matches injects them, so a fleet
+        sharing one seeded schedule kills exactly one rank mid-step."""
         nan_steps = frozenset(nan_steps)
         sigterm_steps = frozenset(sigterm_steps)
         hang_steps = frozenset(hang_steps)
+        kill_steps = frozenset(kill_steps)
+        if kill_signal is None:
+            kill_signal = signal.SIGKILL
+        targeted = target_rank is None or int(rank) == int(target_rank)
 
         def gen():
             for i, batch in enumerate(data_iter):
-                if i in sigterm_steps:
+                if i in kill_steps and targeted:
+                    self.log.append((i, "kill"))
+                    os.kill(os.getpid(), kill_signal)
+                if i in sigterm_steps and targeted:
                     self.log.append((i, "sigterm"))
                     signal.raise_signal(signal.SIGTERM)
                 if i in hang_steps:

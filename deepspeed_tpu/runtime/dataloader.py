@@ -80,6 +80,11 @@ class DeepSpeedDataLoader:
         self.world = max(data_parallel_world_size, 1)
         self.rank = data_parallel_rank
         self.local_batch = batch_size // self.world
+        # sampler-state tracking for elastic/checkpoint resume: the
+        # (epoch, samples-into-epoch) pair pins the exact position in the
+        # deterministic seeded sample stream (see state_dict)
+        self.samples_yielded = 0
+        self._pending_state = None
         try:
             n = len(dataset)
             self.len = n // batch_size if drop_last else -(-n // batch_size)
@@ -90,6 +95,34 @@ class DeepSpeedDataLoader:
         if self.len is None:
             raise TypeError("underlying dataset has no length")
         return self.len
+
+    # -- sampler state (elastic resume: "no replay, no skip") -----------
+    def state_dict(self):
+        """Position in the deterministic sample stream: the live epoch
+        and how many samples this epoch has yielded into global batches.
+        Captured into checkpoint meta (``data_state``) so a resumed run
+        — possibly at a DIFFERENT dp degree/micro-batch geometry on the
+        elastic schedule — consumes the exact next samples: epoch order
+        is a pure function of (seed, epoch), so (epoch, samples) is the
+        whole cursor."""
+        return {"epoch": int(self.epoch),
+                "samples_yielded": int(self.samples_yielded)}
+
+    def load_state_dict(self, state):
+        """Arm a resume: the next ``__iter__`` re-enters ``state``'s
+        epoch (same seeded order) and fast-forwards past the samples the
+        checkpointed run already consumed, instead of starting a fresh
+        epoch (replay) or jumping one (skip).
+
+        The skip count need not divide the CURRENT yield size: an
+        elastic resume changes micro x dp while the checkpoint position
+        sits at an optimizer-step boundary — a multiple of the fixed
+        global batch, which every valid geometry divides."""
+        if not state:
+            return
+        self._pending_state = {
+            "epoch": int(state.get("epoch", 0)),
+            "samples_yielded": int(state.get("samples_yielded", 0))}
 
     def _sample_iter(self):
         try:
@@ -172,13 +205,30 @@ class DeepSpeedDataLoader:
         return samples[self.rank * per:(self.rank + 1) * per]
 
     def __iter__(self):
-        self.epoch += 1
+        resume = self._pending_state
+        self._pending_state = None
+        skip = 0
+        if resume is not None and resume["epoch"] >= 1:
+            # resumed mid-stream: re-enter the checkpointed epoch (same
+            # seeded order) and fast-forward past the consumed samples
+            self.epoch = resume["epoch"]
+            skip = resume["samples_yielded"]
+        else:
+            self.epoch += 1
+        self.samples_yielded = skip
         samples = []
         if self.tput_timer:
             self.tput_timer.start()
-        for s in self._sample_iter():
+        it = self._sample_iter()
+        for _ in range(skip):
+            try:
+                next(it)
+            except StopIteration:
+                break
+        for s in it:
             samples.append(s)
             if len(samples) == self.batch_size:
+                self.samples_yielded += self.batch_size
                 yield self.collate_fn(self._process_slice(samples))
                 samples = []
         if samples and not self.drop_last:
@@ -196,4 +246,5 @@ class DeepSpeedDataLoader:
                     f"final partial batch trimmed {len(samples)} -> {keep} "
                     f"samples to split across {self.world} processes")
                 samples = samples[:keep]
+            self.samples_yielded += len(samples)
             yield self.collate_fn(self._process_slice(samples))

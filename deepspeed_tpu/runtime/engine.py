@@ -3090,6 +3090,17 @@ class DeepSpeedEngine:
                 "lr_scheduler"):
             self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
 
+        # dataloader/sampler cursor (elastic resume contract: no replay,
+        # no skip): re-arm the engine-owned loader at the checkpointed
+        # stream position and drop any live iterator so the next
+        # train_batch() pulls the fast-forwarded stream
+        data_state = meta.get("data_state")
+        if (data_state and self.training_dataloader is not None
+                and hasattr(self.training_dataloader, "load_state_dict")):
+            self.training_dataloader.load_state_dict(data_state)
+            if hasattr(self, "_train_iter"):
+                del self._train_iter
+
         client_state = None
         cs_path = os.path.join(ckpt_dir, CLIENT_STATE_PKL)
         if os.path.isfile(cs_path):
@@ -3100,6 +3111,18 @@ class DeepSpeedEngine:
         self._last_ckpt_dir = load_dir
         self.telemetry.emit(TEL.EVENT_RUN_RESUME, step=self.global_steps,
                             checkpoint=ckpt_dir)
+        ck_dp = meta.get("dp_world_size")
+        if ck_dp is not None and int(ck_dp) != self.dp_world_size:
+            # DP-elastic restore onto a different mesh shape: the
+            # unpadded flat master re-partitioned over the new dp degree
+            # — the resize timeline's "restore" leg
+            self.telemetry.emit(TEL.EVENT_ELASTIC, step=self.global_steps,
+                                phase="restore", from_dp=int(ck_dp),
+                                to_dp=self.dp_world_size,
+                                checkpoint=ckpt_dir)
+            log_dist(
+                f"elastic restore: checkpoint written at dp={ck_dp} "
+                f"re-partitioned onto dp={self.dp_world_size}", ranks=[0])
         log_dist(f"loaded checkpoint {ckpt_dir}", ranks=[0])
         return ckpt_dir, client_state
 

@@ -65,6 +65,14 @@ EVENT_MEMORY = "memory"
 # ring summary, exported only at the steps_per_print cadence), "skew"
 # (the fleet slowest-vs-median straggler snapshot)
 EVENT_COMM = "comm"
+# elastic resize-on-failure loop (launcher/launch.py elastic supervisor
+# + engine elastic restore): ``phase`` selects the payload shape —
+# "plan" (the HCN planner's re-plan after a failure: surviving device
+# budget, planned world size + micro x accum factorization), "resize"
+# (the fleet respawn at the planned size), "restore" (a checkpoint
+# restored onto a DIFFERENT dp degree than wrote it).  Together they
+# are the resize timeline ``telemetry report`` prints.
+EVENT_ELASTIC = "elastic"
 
 # type -> required data keys.  The report CLI and the golden-schema test
 # validate against this table; emitting an unknown type or dropping a
@@ -90,6 +98,7 @@ EVENT_TYPES = {
     EVENT_COMPILE: ("duration_secs",),
     EVENT_MEMORY: ("kind",),
     EVENT_COMM: ("kind",),
+    EVENT_ELASTIC: ("phase",),
 }
 
 

@@ -190,6 +190,41 @@ def _fmt_bytes(n):
         n /= 1024.0
 
 
+def elastic_timeline(records):
+    """The resize story in one block: every ``elastic`` event
+    (plan / resize / restore) in clock-aligned order, with the world-size
+    transition spelled out per line.  Returned empty when the run never
+    resized — the section only prints for elastic runs."""
+    elastic = [r for r in align_records(records)
+               if r.get("type") == ev.EVENT_ELASTIC]
+    if not elastic:
+        return []
+    lines = []
+    for rec in elastic:
+        d = rec.get("data", {})
+        phase = d.get("phase", "?")
+        if phase == "plan":
+            detail = (f"surviving={d.get('surviving_devices')} -> "
+                      f"world {d.get('prev_world_size')}->"
+                      f"{d.get('planned_world_size')} "
+                      f"(micro={d.get('micro_batch')} x "
+                      f"accum={d.get('grad_accum')}, "
+                      f"global={d.get('global_batch')})")
+        elif phase == "resize":
+            detail = (f"respawned {d.get('procs')} proc(s) at world "
+                      f"{d.get('world_size')} (restart "
+                      f"{d.get('restart')})")
+        elif phase == "restore":
+            detail = (f"checkpoint dp={d.get('from_dp')} restored onto "
+                      f"dp={d.get('to_dp')} ({d.get('checkpoint')})")
+        else:
+            detail = _fmt_data(d)
+        rel = rec.get("_rel", rec.get("ts", 0.0))
+        lines.append(f"  t=+{rel:9.3f}s rank={rec.get('rank')} "
+                     f"{phase:<8} {detail}")
+    return lines
+
+
 def comm_program_table(records):
     """Per-program collective table from ``comm``/``program`` events
     (latest event wins per (stream, program))."""
@@ -309,6 +344,11 @@ def generate_report(run_dir, strict=False, comm=False):
     out.append("")
     out.append("timeline:")
     out.extend(format_timeline(records))
+    elastic_lines = elastic_timeline(records)
+    if elastic_lines:
+        out.append("")
+        out.append("elastic resize timeline:")
+        out.extend(elastic_lines)
     out.append("")
     out.append("step metrics:")
     out.extend(summarize_step_metrics(records))

@@ -102,6 +102,12 @@ _LEG_FIELDS = {
     "comm_collectives": numbers.Integral,
     "comm_payload_bytes": numbers.Integral,
     "comm_wire_bytes": numbers.Integral,
+    # elastic leg (round 9): the kill-and-resize transition the leg
+    # proved — world size before/after and the step the resized fleet
+    # resumed from
+    "resized_from": numbers.Integral,
+    "resized_to": numbers.Integral,
+    "resume_step": numbers.Integral,
     "error": str,
     "note": str,
 }

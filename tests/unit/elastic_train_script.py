@@ -1,0 +1,111 @@
+"""Elastic-fleet child script for the chaos e2e test.
+
+Driven by ``deepspeed_tpu.launcher.launch`` with the elastic supervisor
+armed: every life reads its planned world size from
+``DS_ELASTIC_TARGET_WORLD_SIZE``, builds a data mesh of that many
+virtual CPU devices (out of 8), and trains a tiny model on the elastic
+schedule (global batch fixed at 16) with per-step synchronous
+checkpoints and ``auto_resume``.
+
+Chaos: when ``DS_CHAOS_KILL_STEP`` is set and this life started FRESH
+(no checkpoint to resume — i.e. the first life), the seeded chaos
+injector SIGKILLs the process mid-stream at that optimizer step, exactly
+like a preempted host.  The respawned life resumes from the last
+committed checkpoint onto the resized mesh and continues the same
+sample stream (loader state rides the checkpoint: no replay, no skip).
+
+argv: <ckpt_dir> <out_dir>   (telemetry dir rides DS_TELEMETRY_DIR)
+"""
+
+import json
+import os
+import sys
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import deepspeed_tpu as deepspeed  # noqa: E402
+from deepspeed_tpu.elasticity import elastic_world_size  # noqa: E402
+from deepspeed_tpu.parallel import make_mesh  # noqa: E402
+from deepspeed_tpu.resilience.chaos import ChaosMonkey  # noqa: E402
+from deepspeed_tpu.runtime.dataloader import RepeatingLoader  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from simple_model import SimpleModel, random_dataset  # noqa: E402
+
+HIDDEN = 16
+GLOBAL_BATCH = 16
+TOTAL_STEPS = 10
+DATASET_SAMPLES = 80          # 5 optimizer steps per epoch: step 6
+                              # crosses an epoch boundary, so the resume
+                              # cursor proves (epoch, offset) carriage
+
+ELASTIC = {"enabled": True, "max_train_batch_size": GLOBAL_BATCH,
+           "micro_batch_sizes": [2, 4], "min_gpus": 1, "max_gpus": 8,
+           "version": 0.1}
+
+
+def main():
+    ckpt_dir, out_dir = sys.argv[1], sys.argv[2]
+    world = elastic_world_size(default=8)
+    devices = jax.devices("cpu")
+    assert len(devices) >= world, (len(devices), world)
+    mesh = make_mesh({"data": world}, devices=devices[:world])
+
+    config = {
+        "elasticity": dict(ELASTIC),
+        "steps_per_print": 4,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 2},
+        "resilience": {"enabled": True, "checkpoint_dir": ckpt_dir},
+        "telemetry": {"enabled": True},
+    }
+    dataset = random_dataset(DATASET_SAMPLES, HIDDEN, seed=7)
+    engine, _, loader, _ = deepspeed.initialize(
+        model=SimpleModel(HIDDEN, nlayers=1), config=config, mesh=mesh,
+        training_data=dataset, auto_resume=True)
+    fresh = engine.global_steps == 0
+
+    kill_step = int(os.environ.get("DS_CHAOS_KILL_STEP", "0") or 0)
+    monkey = ChaosMonkey(seed=int(os.environ.get("DS_CHAOS_SEED", "0")))
+    acc = engine.gradient_accumulation_steps()
+    # pull index -> optimizer step: the kill lands on the FIRST pull of
+    # step kill_step+1, i.e. strictly after step kill_step committed
+    kill_pulls = [kill_step * acc] if (kill_step and fresh) else []
+    it = monkey.wrap_iter(iter(RepeatingLoader(loader)),
+                          kill_steps=kill_pulls,
+                          rank=int(os.environ.get("DS_PROCESS_ID", "0")),
+                          target_rank=0)
+
+    os.makedirs(out_dir, exist_ok=True)
+    life = "fresh" if fresh else f"resumed@{engine.global_steps}"
+    log_path = os.path.join(out_dir, f"steps-world{world}-{life}.jsonl")
+    with open(log_path, "a") as f:
+        while engine.global_steps < TOTAL_STEPS:
+            loss = engine.train_batch(it)
+            engine.save_checkpoint(ckpt_dir, sync=True)
+            f.write(json.dumps({
+                "step": engine.global_steps,
+                "loss": float(jax.device_get(loss)),
+                "world": world,
+                "samples": engine.global_samples}) + "\n")
+            f.flush()
+
+    with open(os.path.join(out_dir, "final.json"), "w") as f:
+        json.dump({"final_loss": float(jax.device_get(loss)),
+                   "steps": engine.global_steps,
+                   "samples": engine.global_samples,
+                   "world": world}, f)
+    engine.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -119,3 +119,147 @@ def test_config_batch_conflict_ignored():
     ds_config["elasticity"]["ignore_non_elastic_batch_info"] = True
     cfg = DeepSpeedConfig(ds_config, world_size=64)
     assert cfg.train_batch_size == 9792
+
+
+# ---------------------------------------------------------------------------
+# version handling under THIS repo's versioning (satellite: same-config
+# respawn must never be rejected)
+# ---------------------------------------------------------------------------
+
+def test_parse_version_pads_and_compares():
+    from deepspeed_tpu.elasticity.elasticity import parse_version
+
+    assert parse_version("0") == parse_version("0.0.0")
+    assert parse_version("0.1") == (0, 1, 0)
+    assert parse_version("0.3.11") > parse_version("0.3.9")
+    with pytest.raises(deepspeed.elasticity.ElasticityConfigError):
+        parse_version("0.3.11rc1")
+
+
+def test_compute_elastic_config_defaults_to_repo_version():
+    # no target version argument: the package's own version is used and
+    # satisfies the minimum, so the call behaves exactly as before
+    final_batch_size, valid_gpus = deepspeed.elasticity.compute_elastic_config(
+        ds_config=copy_config())
+    assert final_batch_size == 9792
+    assert len(valid_gpus) == 23
+
+
+def test_elastic_algorithm_version_accepts_dotted_forms():
+    """v0.1 spelled 0.1 / "0.1" / "0.1.0" all select the v0.1 algorithm
+    (numeric-tuple comparison), and "0.2.0" still raises as future."""
+    for version in (0.1, "0.1", "0.1.0"):
+        cfg = copy_config()
+        cfg["elasticity"]["version"] = version
+        final, _ = deepspeed.elasticity.compute_elastic_config(ds_config=cfg)
+        assert final == 9792, version
+    cfg = copy_config()
+    cfg["elasticity"]["version"] = "0.2.0"
+    with pytest.raises(deepspeed.elasticity.ElasticityError):
+        deepspeed.elasticity.compute_elastic_config(ds_config=cfg)
+
+
+def test_ensure_immutable_accepts_same_config_respawn(monkeypatch):
+    """The launcher re-exports the schedule through json on every
+    respawn; value-identical configs with drifted representations
+    (float vs str version, list order) must pass the immutability
+    check — rejecting them would kill every elastic resume."""
+    import json as _json
+
+    from deepspeed_tpu.elasticity import normalized_elastic_config
+
+    block = copy_config()["elasticity"]
+    exported = normalized_elastic_config(dict(block, version="0.1"))
+    monkeypatch.setenv("DEEPSPEED_ELASTICITY_CONFIG",
+                       _json.dumps(exported))
+    # runtime sees version as float, env var carried it normalized
+    deepspeed.elasticity.ensure_immutable_elastic_config(block)
+    # micro-batch order is representation too
+    reordered = dict(block,
+                     micro_batch_sizes=list(block["micro_batch_sizes"])[::-1])
+    deepspeed.elasticity.ensure_immutable_elastic_config(reordered)
+    # a REAL schedule drift still fails loudly
+    with pytest.raises(deepspeed.elasticity.ElasticityConfigError):
+        deepspeed.elasticity.ensure_immutable_elastic_config(
+            dict(block, max_train_batch_size=4096))
+
+
+def test_elasticity_block_validated_by_config_schema():
+    """The elasticity block rides DSC4xx key validation like every
+    other config section (unknown keys warn with a did-you-mean)."""
+    from deepspeed_tpu.tools.dslint.schema import validate_config_dict
+
+    issues = validate_config_dict(
+        {"elasticity": dict(copy_config()["elasticity"], bogus_key=1)})
+    assert any("bogus_key" in i.message for i in issues)
+    assert not validate_config_dict(copy_config())
+
+
+# ---------------------------------------------------------------------------
+# elastic supervisor: the resize-on-failure planner half
+# ---------------------------------------------------------------------------
+
+SUPERVISOR_BLOCK = {"enabled": True, "max_train_batch_size": 16,
+                    "micro_batch_sizes": [2, 4], "min_gpus": 1,
+                    "max_gpus": 8, "version": 0.1}
+
+
+def test_plan_world_size_picks_largest_fit():
+    from deepspeed_tpu.elasticity import plan_world_size
+
+    plan = plan_world_size(SUPERVISOR_BLOCK, 8)
+    assert plan.world_size == 8 and plan.global_batch == 16
+    assert plan.valid_world_sizes == (1, 2, 4, 8)
+    # 7 survivors: largest valid count that fits is 4 — the 8->4 resize
+    plan = plan_world_size(SUPERVISOR_BLOCK, 7)
+    assert plan.world_size == 4
+
+
+def test_plan_world_size_keeps_global_batch_on_schedule():
+    from deepspeed_tpu.elasticity import plan_world_size
+
+    for budget in (8, 6, 4, 2, 1):
+        plan = plan_world_size(SUPERVISOR_BLOCK, budget)
+        assert (plan.micro_batch * plan.grad_accum * plan.world_size
+                == plan.global_batch == 16)
+        assert plan.micro_batch in SUPERVISOR_BLOCK["micro_batch_sizes"]
+
+
+def test_plan_world_size_raises_below_schedule_floor():
+    from deepspeed_tpu.elasticity import plan_world_size
+
+    with pytest.raises(deepspeed.elasticity.ElasticityIncompatibleWorldSize):
+        plan_world_size(SUPERVISOR_BLOCK, 0)
+    with pytest.raises(deepspeed.elasticity.ElasticityIncompatibleWorldSize):
+        plan_world_size(dict(SUPERVISOR_BLOCK, min_gpus=4), 2)
+
+
+def test_export_plan_env_contract(monkeypatch):
+    """export_plan_env writes exactly what a respawned child needs: the
+    planned world size (elastic_world_size reads it back) and the
+    normalized schedule (ensure_immutable accepts it verbatim)."""
+    import json as _json
+
+    from deepspeed_tpu.elasticity import (elastic_world_size,
+                                          export_plan_env, plan_world_size)
+
+    plan = plan_world_size(SUPERVISOR_BLOCK, 5)
+    env = export_plan_env({}, SUPERVISOR_BLOCK, plan)
+    assert env["DS_ELASTIC_TARGET_WORLD_SIZE"] == str(plan.world_size) == "4"
+    monkeypatch.setenv("DS_ELASTIC_TARGET_WORLD_SIZE",
+                       env["DS_ELASTIC_TARGET_WORLD_SIZE"])
+    assert elastic_world_size() == 4
+    monkeypatch.setenv("DEEPSPEED_ELASTICITY_CONFIG",
+                       env["DEEPSPEED_ELASTICITY_CONFIG"])
+    deepspeed.elasticity.ensure_immutable_elastic_config(SUPERVISOR_BLOCK)
+    # and the exported json is valid input to the planner again
+    reparsed = _json.loads(env["DEEPSPEED_ELASTICITY_CONFIG"])
+    assert plan_world_size(reparsed, 5).world_size == 4
+
+
+def test_elastic_world_size_default(monkeypatch):
+    from deepspeed_tpu.elasticity import elastic_world_size
+
+    monkeypatch.delenv("DS_ELASTIC_TARGET_WORLD_SIZE", raising=False)
+    assert elastic_world_size() is None
+    assert elastic_world_size(default=8) == 8

@@ -1,0 +1,426 @@
+"""Elastic preemptible-fleet training, proven end-to-end.
+
+The tentpole chaos harness: a REAL launcher (``launch.main``) supervises
+a training child on the 8-device CPU mesh; the seeded chaos injector
+SIGKILLs it mid-stream at step k; the elastic supervisor charges the
+lost capacity, re-plans 8 -> 4 via the HCN planner, and respawns the
+fleet at the new world size; the child elastic-restores the latest
+committed checkpoint onto the dp=4 mesh (loader cursor included) and
+trains to completion.  Loss continuity is asserted against an
+UNINTERRUPTED reference run consuming the same global batches, and the
+telemetry report must show the plan -> resize -> restore timeline.
+
+Cheaper companions: launcher-level resize/poison/jitter semantics with
+stdlib children, dataloader cursor unit tests, and chaos rank-targeting
+unit tests.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+
+ELASTIC_BLOCK = {"enabled": True, "max_train_batch_size": 16,
+                 "micro_batch_sizes": [2, 4], "min_gpus": 1,
+                 "max_gpus": 8, "version": 0.1}
+
+
+# ---------------------------------------------------------------------------
+# launcher-level elastic semantics (stdlib children: no jax in the kids)
+# ---------------------------------------------------------------------------
+
+def _launch_main(tmp_path, script_body=None, script_args=(), max_restarts=0,
+                 extra_argv=(), script_path=None):
+    from deepspeed_tpu.launcher import launch
+    from deepspeed_tpu.launcher.runner import encode_world_info
+
+    if script_path is None:
+        script_path = tmp_path / "child.py"
+        script_path.write_text(script_body)
+    wi = encode_world_info({socket.gethostname(): [0]})
+    argv = ["--world_info", wi, "--node_rank", "0",
+            "--master_addr", "127.0.0.1", "--master_port", "29999",
+            "--max-restarts", str(max_restarts), *extra_argv,
+            str(script_path), *script_args]
+    old_int = signal.getsignal(signal.SIGINT)
+    old_term = signal.getsignal(signal.SIGTERM)
+    try:
+        with pytest.raises(SystemExit) as exc:
+            launch.main(argv)
+        return exc.value.code
+    finally:
+        signal.signal(signal.SIGINT, old_int)
+        signal.signal(signal.SIGTERM, old_term)
+
+
+def _elastic_argv(tmp_path, devices=8, telemetry=True):
+    cfg = tmp_path / "elastic.json"
+    cfg.write_text(json.dumps({"elasticity": ELASTIC_BLOCK}))
+    argv = ["--elastic-config", str(cfg), "--elastic-devices", str(devices)]
+    if telemetry:
+        argv += ["--telemetry-dir", str(tmp_path / "tel")]
+    return argv
+
+
+def _launcher_events(tmp_path, event_type=None):
+    path = tmp_path / "tel" / "events-launcher.jsonl"
+    if not path.exists():
+        return []
+    recs = [json.loads(line) for line in path.read_text().splitlines()]
+    if event_type is not None:
+        recs = [r for r in recs if r["type"] == event_type]
+    return recs
+
+
+def test_launcher_resize_replans_and_reexports_world(tmp_path, monkeypatch):
+    """A respawnable signal death with the supervisor armed respawns the
+    fleet at the PLANNED smaller world size: the child's second life
+    sees DS_ELASTIC_TARGET_WORLD_SIZE=4 + the normalized schedule, and
+    the launcher stream carries plan + resize events plus a respawn
+    event naming the planned world size."""
+    monkeypatch.setenv("DS_MONITOR_POLL_SECS", "0.05")
+    monkeypatch.setenv("DS_RESTART_BACKOFF_SECS", "0.05")
+    monkeypatch.setenv("DS_ELASTIC_DEVICES_PER_FAILURE", "4")
+    out = tmp_path / "lives.jsonl"
+    code = _launch_main(
+        tmp_path,
+        "import json, os, sys\n"
+        "out = sys.argv[1]\n"
+        "rec = {'world': os.environ.get('DS_ELASTIC_TARGET_WORLD_SIZE'),\n"
+        "       'sched': os.environ.get('DEEPSPEED_ELASTICITY_CONFIG')}\n"
+        "open(out, 'a').write(json.dumps(rec) + '\\n')\n"
+        "if len(open(out).readlines()) == 1:\n"
+        "    os.kill(os.getpid(), 9)\n",
+        script_args=(str(out),), max_restarts=2,
+        extra_argv=_elastic_argv(tmp_path))
+    assert code == 0
+    lives = [json.loads(line) for line in out.read_text().splitlines()]
+    assert [l["world"] for l in lives] == ["8", "4"]
+    sched = json.loads(lives[1]["sched"])
+    assert sched["max_train_batch_size"] == 16
+    plans = _launcher_events(tmp_path, "elastic")
+    assert [p["data"]["phase"] for p in plans] == ["plan", "resize"]
+    assert plans[0]["data"]["prev_world_size"] == 8
+    assert plans[0]["data"]["planned_world_size"] == 4
+    assert plans[0]["data"]["global_batch"] == 16
+    (respawn,) = _launcher_events(tmp_path, "proc_respawn")
+    assert respawn["data"]["planned_world_size"] == 4
+
+
+def test_launcher_poison_exit_is_never_resized_around(tmp_path,
+                                                      monkeypatch):
+    """Exit 86 (divergence abort) must tear the node down even with an
+    armed elastic supervisor and restart budget left: resizing around a
+    divergence replays the same data into the same divergence with less
+    capacity."""
+    from deepspeed_tpu.resilience import EXIT_DIVERGENCE_ABORT
+
+    monkeypatch.setenv("DS_MONITOR_POLL_SECS", "0.05")
+    monkeypatch.setenv("DS_RESTART_BACKOFF_SECS", "0.05")
+    counter = tmp_path / "runs"
+    code = _launch_main(
+        tmp_path,
+        "import sys\n"
+        "with open(sys.argv[1], 'a') as f:\n"
+        "    f.write('x')\n"
+        f"sys.exit({EXIT_DIVERGENCE_ABORT})\n",
+        script_args=(str(counter),), max_restarts=3,
+        extra_argv=_elastic_argv(tmp_path))
+    assert code == EXIT_DIVERGENCE_ABORT
+    assert counter.read_text() == "x"          # ran exactly once
+    assert _launcher_events(tmp_path, "elastic") == []
+
+
+def test_launcher_tears_down_below_schedule_floor(tmp_path, monkeypatch):
+    """When the surviving budget admits NO valid world size the resize
+    is terminal: the launcher reports the original failure instead of
+    thrashing respawns that can never train."""
+    monkeypatch.setenv("DS_MONITOR_POLL_SECS", "0.05")
+    monkeypatch.setenv("DS_RESTART_BACKOFF_SECS", "0.05")
+    monkeypatch.setenv("DS_ELASTIC_DEVICES_PER_FAILURE", "8")
+    counter = tmp_path / "runs"
+    code = _launch_main(
+        tmp_path,
+        "import os, sys\n"
+        "with open(sys.argv[1], 'a') as f:\n"
+        "    f.write('x')\n"
+        "os.kill(os.getpid(), 9)\n",
+        script_args=(str(counter),), max_restarts=3,
+        extra_argv=_elastic_argv(tmp_path))
+    assert code == 137
+    assert counter.read_text() == "x"
+    phases = [p["data"]["phase"]
+              for p in _launcher_events(tmp_path, "elastic")]
+    assert phases == []    # the failed plan never emits a resize
+
+
+def test_launcher_resize_budget_bounds_total_restarts(tmp_path,
+                                                      monkeypatch):
+    """--max-restarts bounds RESIZES when the supervisor is armed: a
+    child that keeps dying gets exactly that many resized lives, never a
+    same-size per-child respawn on top (which would double the budget
+    behind the supervisor's back)."""
+    monkeypatch.setenv("DS_MONITOR_POLL_SECS", "0.05")
+    monkeypatch.setenv("DS_RESTART_BACKOFF_SECS", "0.05")
+    monkeypatch.setenv("DS_ELASTIC_DEVICES_PER_FAILURE", "2")
+    counter = tmp_path / "runs"
+    code = _launch_main(
+        tmp_path,
+        "import os, sys\n"
+        "with open(sys.argv[1], 'a') as f:\n"
+        "    f.write('x')\n"
+        "os.kill(os.getpid(), 9)\n",
+        script_args=(str(counter),), max_restarts=1,
+        extra_argv=_elastic_argv(tmp_path))
+    assert code == 137
+    assert counter.read_text() == "xx"      # first life + ONE resize
+    phases = [p["data"]["phase"]
+              for p in _launcher_events(tmp_path, "elastic")]
+    assert phases == ["plan", "resize"]
+
+
+def test_respawn_backoff_is_jittered_within_bounds(tmp_path, monkeypatch):
+    """Non-elastic respawns keep exponential backoff but gain a bounded
+    multiplicative jitter: base*2^(r-1) <= delay <= that * (1+jitter)."""
+    monkeypatch.setenv("DS_MONITOR_POLL_SECS", "0.05")
+    monkeypatch.setenv("DS_RESTART_BACKOFF_SECS", "0.05")
+    monkeypatch.setenv("DS_RESTART_BACKOFF_JITTER", "0.5")
+    marker = tmp_path / "count"
+    code = _launch_main(
+        tmp_path,
+        "import os, sys\n"
+        "with open(sys.argv[1], 'a') as f:\n"
+        "    f.write('x')\n"
+        "sys.exit(0 if len(open(sys.argv[1]).read()) >= 3 else 1)\n",
+        script_args=(str(marker),), max_restarts=2,
+        extra_argv=["--telemetry-dir", str(tmp_path / "tel")])
+    assert code == 0
+    respawns = _launcher_events(tmp_path, "proc_respawn")
+    assert len(respawns) == 2
+    for rec in respawns:
+        r = rec["data"]["restart"]
+        base = 0.05 * (2 ** (r - 1))
+        assert base <= rec["data"]["backoff_secs"] <= base * 1.5 + 1e-9
+
+
+# ---------------------------------------------------------------------------
+# dataloader cursor: no replay, no skip — across geometry changes
+# ---------------------------------------------------------------------------
+
+def _loader(batch_size, seed=5):
+    from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+
+    data = [np.full((2,), i, np.float32) for i in range(64)]
+    return DeepSpeedDataLoader(data, batch_size=batch_size, shuffle=True,
+                               seed=seed)
+
+
+def test_loader_state_roundtrip_resumes_exact_stream():
+    a = _loader(8)
+    it = iter(a)
+    consumed = [next(it) for _ in range(3)]        # 24 samples into epoch 1
+    state = a.state_dict()
+    assert state == {"epoch": 1, "samples_yielded": 24}
+
+    b = _loader(8)
+    b.load_state_dict(state)
+    resumed = list(iter(b))
+    rest = [next(it) for _ in range(5)]            # the uninterrupted tail
+    assert len(resumed) == len(rest) == 5
+    for x, y in zip(resumed, rest):
+        np.testing.assert_array_equal(x, y)
+    del consumed
+
+
+def test_loader_state_survives_geometry_change():
+    """An elastic resume changes micro x dp (the per-pull batch size)
+    while the optimizer-boundary cursor is a multiple of the fixed
+    global batch: the resumed loader must continue the SAME sample
+    stream in its new chunking."""
+    a = _loader(16)                    # old geometry: 16-sample pulls
+    it = iter(a)
+    for _ in range(2):                 # 32 samples consumed
+        next(it)
+    state = a.state_dict()
+
+    b = _loader(8)                     # new geometry: 8-sample pulls
+    b.load_state_dict(state)
+    resumed = np.concatenate([x.reshape(-1) for x in iter(b)])
+    want = np.concatenate([x.reshape(-1) for x in it])
+    np.testing.assert_array_equal(resumed, want)
+
+
+def test_loader_state_next_epoch_rolls_fresh():
+    """A cursor at the exact epoch end yields nothing more from that
+    epoch; the next __iter__ (RepeatingLoader's restart) begins the
+    following epoch with a fresh cursor."""
+    a = _loader(16)
+    list(iter(a))                      # consume epoch 1 fully (4 batches)
+    state = a.state_dict()
+    assert state == {"epoch": 1, "samples_yielded": 64}
+    b = _loader(16)
+    b.load_state_dict(state)
+    assert list(iter(b)) == []         # epoch 1 exhausted — no replay
+    nxt = list(iter(b))                # epoch 2, fresh order
+    assert len(nxt) == 4 and b.epoch == 2
+
+
+# ---------------------------------------------------------------------------
+# chaos rank targeting
+# ---------------------------------------------------------------------------
+
+def test_chaos_kill_and_sigterm_target_a_specific_rank():
+    from deepspeed_tpu.resilience.chaos import ChaosMonkey
+
+    # non-victim ranks iterate straight through the same seeded schedule
+    monkey = ChaosMonkey(seed=3)
+    it = monkey.wrap_iter(iter(range(6)), kill_steps=[2],
+                          sigterm_steps=[4], rank=1, target_rank=0)
+    assert list(it) == list(range(6))
+    assert monkey.log == []
+
+    # the victim rank injects; prove it with the survivable fault
+    fired = []
+    old = signal.signal(signal.SIGTERM, lambda s, f: fired.append(s))
+    try:
+        monkey2 = ChaosMonkey(seed=3)
+        it2 = monkey2.wrap_iter(iter(range(6)), sigterm_steps=[4],
+                                rank=0, target_rank=0)
+        assert list(it2) == list(range(6))
+    finally:
+        signal.signal(signal.SIGTERM, old)
+    assert fired == [signal.SIGTERM]
+    assert monkey2.log == [(4, "sigterm")]
+
+
+def test_chaos_kill_dies_like_a_preempted_host():
+    """kill_steps delivers an unhandleable SIGKILL to the process —
+    proven in a subprocess, the same shape the launcher supervises."""
+    code = (
+        "import sys\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "from deepspeed_tpu.resilience.chaos import ChaosMonkey\n"
+        "it = ChaosMonkey(0).wrap_iter(iter(range(4)), kill_steps=[1])\n"
+        "for _ in it: pass\n"
+        "print('survived')\n")
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == -signal.SIGKILL
+    assert "survived" not in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# THE chaos e2e: kill at step k -> re-plan 8->4 -> elastic restore ->
+# loss continuity vs an uninterrupted same-batch reference
+# ---------------------------------------------------------------------------
+
+def _read_final(out_dir):
+    with open(os.path.join(out_dir, "final.json")) as f:
+        return json.load(f)
+
+
+def _run_reference(tmp_path, env):
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "elastic_train_script.py")
+    ref_env = dict(env)
+    ref_env.pop("DS_CHAOS_KILL_STEP", None)
+    ref_env["DS_ELASTIC_TARGET_WORLD_SIZE"] = "8"
+    ref_env["DS_TELEMETRY_DIR"] = str(tmp_path / "tel-ref")
+    proc = subprocess.run(
+        [sys.executable, script, str(tmp_path / "ckpt-ref"),
+         str(tmp_path / "out-ref")],
+        cwd=REPO, env=ref_env, capture_output=True, text=True, timeout=280)
+    assert proc.returncode == 0, (
+        f"reference run failed\nstdout:\n{proc.stdout[-3000:]}\n"
+        f"stderr:\n{proc.stderr[-3000:]}")
+    return _read_final(tmp_path / "out-ref")
+
+
+def test_chaos_elastic_resize_end_to_end(tmp_path, monkeypatch):
+    from deepspeed_tpu.resilience.chaos import ChaosMonkey
+    from deepspeed_tpu.telemetry.report import generate_report
+
+    # seeded kill step in [3, 6]: late enough that committed checkpoints
+    # exist, early enough that the resized fleet trains several steps
+    kill_step = 3 + ChaosMonkey(seed=11).schedule_steps(4, 1)[0]
+
+    monkeypatch.setenv("DS_MONITOR_POLL_SECS", "0.05")
+    monkeypatch.setenv("DS_RESTART_BACKOFF_SECS", "0.05")
+    monkeypatch.setenv("DS_ELASTIC_DEVICES_PER_FAILURE", "4")
+    monkeypatch.setenv("DS_CHAOS_KILL_STEP", str(kill_step))
+    monkeypatch.setenv("DS_CHAOS_SEED", "11")
+    # children force their own 8-device CPU topology
+    monkeypatch.setenv("PYTHONPATH",
+                       REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "elastic_train_script.py")
+    run_dir = tmp_path / "tel"
+    code = _launch_main(
+        tmp_path, script_path=script,
+        script_args=(str(tmp_path / "ckpt"), str(tmp_path / "out")),
+        max_restarts=2,
+        extra_argv=_elastic_argv(tmp_path) + [
+            "--compile-cache-dir", str(tmp_path / "xla-cache")])
+    assert code == 0
+
+    # the interrupted run finished all 10 steps at the resized world
+    final = _read_final(tmp_path / "out")
+    assert final["steps"] == 10 and final["world"] == 4
+    assert final["samples"] == 10 * 16
+
+    # step accounting: each optimizer step 1..10 appears EXACTLY once
+    # across the two lives (no replay, no skip), world 8 before the kill
+    # and 4 after
+    steps = {}
+    out_dir = tmp_path / "out"
+    for name in os.listdir(out_dir):
+        if not name.startswith("steps-"):
+            continue
+        for line in open(out_dir / name):
+            rec = json.loads(line)
+            assert rec["step"] not in steps, f"step {rec['step']} replayed"
+            steps[rec["step"]] = rec
+    assert sorted(steps) == list(range(1, 11))
+    for s, rec in steps.items():
+        assert rec["world"] == (8 if s <= kill_step else 4), (s, rec)
+        assert rec["samples"] == s * 16
+
+    # loss continuity vs the uninterrupted same-batch reference run
+    ref = _run_reference(tmp_path, dict(os.environ))
+    assert ref["steps"] == 10 and ref["world"] == 8
+    np.testing.assert_allclose(final["final_loss"], ref["final_loss"],
+                               rtol=1e-3)
+    ref_steps = {}
+    for name in os.listdir(tmp_path / "out-ref"):
+        if name.startswith("steps-"):
+            for line in open(tmp_path / "out-ref" / name):
+                rec = json.loads(line)
+                ref_steps[rec["step"]] = rec["loss"]
+    for s in range(1, 11):
+        np.testing.assert_allclose(
+            steps[s]["loss"], ref_steps[s], rtol=1e-3,
+            err_msg=f"loss diverged from uninterrupted reference at "
+                    f"step {s} (kill was at {kill_step})")
+
+    # telemetry: the merged report shows the plan -> resize -> restore
+    # resize timeline
+    text, records = generate_report(str(run_dir))
+    assert "elastic resize timeline:" in text
+    phases = [r["data"]["phase"] for r in records
+              if r["type"] == "elastic"]
+    assert phases.count("plan") == 1 and phases.count("resize") == 1
+    assert "restore" in phases, "engine never emitted the elastic restore"
+    restore = next(r for r in records
+                   if r["type"] == "elastic"
+                   and r["data"]["phase"] == "restore")
+    assert restore["data"]["from_dp"] == 8
+    assert restore["data"]["to_dp"] == 4
+    assert "world 8->4" in text

@@ -1,0 +1,163 @@
+"""DP-elastic restore matrix: checkpoints written at dp in {2, 4, 8}
+must restore onto dp in {1, 2, 4} — a DIFFERENT mesh shape — across the
+offload layouts:
+
+- ``plain``         ZeRO-2, state on device (flat rows padded per dp);
+- ``offload``       in-jit streamed ZeRO-Offload with the host-buffer
+                    GROUP layout forced (several row groups), so the
+                    load path re-derives the pinned-host layout under
+                    the new dp;
+- ``offload_bf16``  reduced-precision host state with persistent
+                    error-feedback residuals (``qres``) riding the
+                    checkpoint.
+
+Parity contract (``offload-state-dtype`` rules, docs/config.md): a
+SAME-layout restore is bit-exact — master, flat optimizer leaves, and
+residuals — regardless of the dp transition, because checkpoints store
+the flat space unpadded in canonical fp32.  A cross-layout load (bf16+EF
+checkpoint into an fp32 engine) folds residuals into the values; that
+documented fold is asserted separately.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu as deepspeed
+from deepspeed_tpu.checkpoint.snapshot import capture_engine_snapshot
+from deepspeed_tpu.parallel import make_mesh
+
+from .simple_model import SimpleModel, random_batches
+
+HIDDEN = 128
+NLAYERS = 4          # ~66k params -> 68 content rows -> padded 128: the
+                     # forced small group size below yields MULTIPLE host
+                     # row groups, the layout re-derivation under test
+GLOBAL_BATCH = 16
+
+MODES = {
+    "plain": {"stage": 2},
+    "offload": {"stage": 2, "cpu_offload": True},
+    "offload_bf16": {"stage": 2, "cpu_offload": True,
+                     "offload_state_dtype": {"master": "bf16",
+                                             "momentum": "bf16",
+                                             "variance": "bf16",
+                                             "error_feedback": True}},
+}
+
+SAVE_DPS = (2, 4, 8)
+LOAD_DPS = (1, 2, 4)
+
+
+@pytest.fixture
+def force_injit(monkeypatch):
+    """Run the REAL in-jit streamed offload paths on CPU, with the host
+    group size shrunk so this tiny model still splits into several row
+    groups (the grouped-layout re-derivation is the point)."""
+    from deepspeed_tpu.runtime.zero import coordinator as coord
+
+    monkeypatch.setenv("DS_OFFLOAD_FORCE_INJIT", "1")
+    monkeypatch.setattr(coord, "HOST_GROUP_BYTES", 1 << 18)
+    monkeypatch.setattr(coord, "MAX_HOST_BUFFERS", 64)
+
+
+def _build_engine(cpu_devices, dp, mode, steps=0, seed=0):
+    mesh = make_mesh({"data": dp}, devices=cpu_devices[:dp])
+    config = {
+        "train_batch_size": GLOBAL_BATCH,
+        "steps_per_print": 10 ** 9,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": dict(MODES[mode]),
+    }
+    engine, *_ = deepspeed.initialize(
+        model=SimpleModel(HIDDEN, nlayers=NLAYERS), config=config,
+        mesh=mesh)
+    for i, batch in enumerate(
+            random_batches(steps, GLOBAL_BATCH, HIDDEN, seed=seed)):
+        engine.train_batch(iter([batch]))
+    return engine
+
+
+def _host_states(engine):
+    """Everything the checkpoint persists, gathered host-side in
+    canonical form: {name: fp32 unpadded array} + the meta block."""
+    snap = capture_engine_snapshot(engine, tag="probe")
+    return snap.optim_states, snap.meta
+
+
+def _grouped(engine):
+    bounds, per_family = engine.flat.host_buffer_layout()
+    return per_family
+
+
+@pytest.mark.parametrize("mode", sorted(MODES))
+def test_dp_elastic_restore_matrix(cpu_devices, tmp_path, mode,
+                                   force_injit, request):
+    """save at dp in {2,4,8} -> load at dp in {1,2,4}: every persisted
+    state buffer restores BIT-EXACTLY onto the new mesh shape."""
+    if mode == "plain":
+        # plain mode must not depend on the offload test lever
+        request.getfixturevalue("monkeypatch").delenv(
+            "DS_OFFLOAD_FORCE_INJIT", raising=False)
+
+    saved = {}
+    for dp in SAVE_DPS:
+        engine = _build_engine(cpu_devices, dp, mode, steps=1, seed=dp)
+        save_dir = tmp_path / f"{mode}-dp{dp}"
+        engine.save_checkpoint(str(save_dir), tag="m", sync=True)
+        states, meta = _host_states(engine)
+        if mode != "plain":
+            assert _grouped(engine) > 1, (
+                "grouped host layout did not engage; the matrix must "
+                "exercise group re-derivation")
+        saved[dp] = (str(save_dir), states, meta)
+        engine.close()
+
+    for load_dp in LOAD_DPS:
+        engine = _build_engine(cpu_devices, load_dp, mode, steps=0)
+        for save_dp in SAVE_DPS:
+            save_dir, want_states, want_meta = saved[save_dp]
+            path, _ = engine.load_checkpoint(save_dir, tag="m")
+            assert path is not None, (mode, save_dp, load_dp)
+            got_states, got_meta = _host_states(engine)
+            assert set(got_states) == set(want_states)
+            for name in sorted(want_states):
+                np.testing.assert_array_equal(
+                    got_states[name], want_states[name],
+                    err_msg=f"{mode}: {name} not bit-exact across "
+                            f"dp{save_dp}->dp{load_dp}")
+            assert got_meta["global_steps"] == want_meta["global_steps"]
+            assert got_meta["scale_state"] == want_meta["scale_state"]
+            if mode == "offload_bf16":
+                assert any(n.startswith("qres/") for n in got_states), (
+                    "bf16+error_feedback checkpoint must carry residuals")
+        engine.close()
+
+
+def test_cross_layout_load_folds_residuals(cpu_devices, tmp_path,
+                                           force_injit):
+    """The documented non-bit-exact leg: a bf16+error-feedback
+    checkpoint loaded into a PLAIN fp32 engine at a different dp folds
+    each residual into its value (value = stored + qres, exact fp32
+    add), so the fp32 engine resumes from the checkpoint's TRUE state,
+    not its rounded storage."""
+    engine = _build_engine(cpu_devices, 4, "offload_bf16", steps=2, seed=3)
+    save_dir = tmp_path / "xlayout"
+    engine.save_checkpoint(str(save_dir), tag="m", sync=True)
+    states, _ = _host_states(engine)
+    engine.close()
+
+    engine2 = _build_engine(cpu_devices, 2, "plain", steps=0)
+    path, _ = engine2.load_checkpoint(str(save_dir), tag="m")
+    assert path is not None
+    got, _ = _host_states(engine2)
+    for name in ("master", "opt/.exp_avg", "opt/.exp_avg_sq"):
+        res = states.get("qres/" + name.split("/")[-1].lstrip("."))
+        want = states[name].astype(np.float32)
+        if res is not None:
+            want = want + res.astype(np.float32)
+        np.testing.assert_array_equal(
+            got[name], want,
+            err_msg=f"cross-layout fold drifted for {name}")
+    engine2.close()

@@ -127,7 +127,7 @@ def _sample_data(event_type):
         "tag": "global_step7", "queue_depth": 1, "latency_secs": 0.2,
         "bytes": 4096, "retries": 1, "error": "disk full", "signum": 15,
         "proc_rank": 0, "pid": 4242, "code": 85, "restart": 1,
-        "backoff_secs": 2.0, "duration_secs": 12.75,
+        "backoff_secs": 2.0, "duration_secs": 12.75, "phase": "plan",
     }
     return {k: samples[k] for k in EVENT_TYPES[event_type]}
 
