@@ -140,6 +140,37 @@ def comm_receipts(record, engine, prefix=None):
               file=sys.stderr)
 
 
+def dsp_receipts(record, engine, prefix=None):
+    """Program-verification receipt for one bench row (fail-soft): the
+    unsuppressed DSP6xx violation count over every compiled engine
+    program (donation aliases materialized, collectives on the right
+    mesh axes — ``tools/dslint/programs.py``).  Pinned at 0; the
+    ``bench_diff`` gate treats any increase as a regression."""
+    try:
+        tag = (lambda f: f"{prefix}_{f}") if prefix else (lambda f: f)
+        report = engine.verify_programs()
+        if report is None:
+            return
+        # the gated field carries ERROR-severity findings only: the
+        # heuristic DSP warnings (psum-for-pmean suspects, ledger
+        # drift) have no ratchet on the bench surface, so they report
+        # via the ungated dsp_warnings field + stderr instead of
+        # hard-failing bench_diff (same rationale as the planner's
+        # exit code)
+        record[tag("dsp_violations")] = int(report["errors"])
+        warnings = int(report["violations"]) - int(report["errors"])
+        if not prefix and warnings:
+            record["dsp_warnings"] = warnings
+        if not prefix and report["downgraded"]:
+            record["dsp_downgraded"] = int(report["downgraded"])
+        for d in report["diagnostics"]:
+            if not d.suppressed:
+                print(f"bench: dsp finding: {d.format()}", file=sys.stderr)
+    except Exception as e:  # pragma: no cover - receipts never gate rows
+        print(f"bench: dsp receipts unavailable: {e!r:.200}",
+              file=sys.stderr)
+
+
 def main():
     import jax
 
@@ -277,6 +308,7 @@ def main():
     # assumed)
     memory_receipts(record, engine)
     comm_receipts(record, engine)
+    dsp_receipts(record, engine)
 
     # HBM discipline: each engine holds ~5 GB of master+optimizer state for
     # these model sizes; three co-resident engines exhaust a 16 GB chip.
@@ -445,6 +477,7 @@ def _measure_offload(record, deepspeed, mesh, rng):
                 engine.host_state_bytes_per_step())
             memory_receipts(record, engine, prefix=prefix)
             comm_receipts(record, engine, prefix=prefix)
+            dsp_receipts(record, engine, prefix=prefix)
         else:
             record[f"{prefix}_error"] = f"non-finite loss {v}"
         del engine, model
@@ -523,6 +556,7 @@ def _measure_offload_xl(record, deepspeed, mesh, rng):
             engine.flat.host_group_bounds or ((0, 0),))
         memory_receipts(record, engine, prefix="offload_gpt2_xl")
         comm_receipts(record, engine, prefix="offload_gpt2_xl")
+        dsp_receipts(record, engine, prefix="offload_gpt2_xl")
     else:
         record["offload_xl_error"] = f"non-finite loss {v}"
     del engine, model

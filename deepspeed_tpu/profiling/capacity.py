@@ -16,8 +16,13 @@ device's ``memory_stats()['bytes_limit']`` (or ``--capacity-gb``)
 scaled by ``--headroom``.  ``--bisect-layers LO HI`` bisects the layer
 count to estimate the largest fitting model of the family.
 
-Exit codes: 0 fit, 1 no-fit, 2 usage error, 3 unknown (the backend
-lacks ``memory_analysis`` or no device capacity is known — fail-soft by
+Exit codes: 0 fit (and the compiled programs carry no error-severity
+DSP6xx findings), 1 no-fit OR error-severity DSP6xx program-verifier
+findings (a plan whose step program drops its donation aliases or sums
+parameters over the wrong mesh axis is a failed plan even when the
+bytes fit; heuristic DSP warnings print but do not gate — the planner
+has no ratchet), 2 usage error, 3 unknown (the backend lacks
+``memory_analysis`` or no device capacity is known — fail-soft by
 design, the planner must degrade to "unknown", never crash).
 """
 
@@ -120,8 +125,21 @@ def plan(config, model, sample_batch, mesh=None, capacity_bytes=None,
                                       mesh=mesh, aot_plan=True)
     try:
         _, entry = engine.aot_compile_train_step(sample_batch)
+        # program-level semantic verification (DSP6xx) at plan time:
+        # the compiled step is already in the ledger, so a donation or
+        # collective-semantics bug fails the PLAN, not the 2-AM run
+        verify = engine.verify_programs()
         out = {
             "analysis_available": entry is not None,
+            "dsp_violations": (verify["violations"]
+                               if verify is not None else None),
+            "dsp_errors": (verify["errors"]
+                           if verify is not None else None),
+            "dsp_downgraded": (verify["downgraded"]
+                               if verify is not None else None),
+            "dsp_findings": ([d.format() for d in verify["diagnostics"]
+                              if not d.suppressed]
+                             if verify is not None else []),
             "predicted_peak_hbm_bytes": predicted_peak_bytes(entry),
             "predicted_temp_bytes": (entry or {}).get("temp_size_in_bytes"),
             "argument_bytes": (entry or {}).get("argument_size_in_bytes"),
@@ -275,6 +293,16 @@ def main(argv=None):
         print(json.dumps(result))
     else:
         _print_report(result)
+    if result.get("dsp_errors"):
+        # a step program that fails semantic verification with an
+        # ERROR-severity finding (donation aliases dropped, parameter
+        # sum on the wrong mesh axis) is a failed plan even when the
+        # bytes fit — DSP601's own rationale is that dropped aliases
+        # make the capacity math wrong.  Heuristic WARNINGS
+        # (psum-for-pmean suspects, ledger drift) print in the report
+        # but do not gate: the planner has no --baseline ratchet to
+        # absolve an intentional psum
+        return 1
     if result["fit"] is True:
         return 0
     if result["fit"] is False:
@@ -310,6 +338,18 @@ def _print_report(r):
     if r.get("host_state_wire_bytes_per_step"):
         print(f"  state wire bytes/step  "
               f"{_fmt_bytes(r['host_state_wire_bytes_per_step'])}")
+    if r.get("dsp_violations") is not None:
+        verdict = ("clean" if r["dsp_violations"] == 0
+                   else f"{r['dsp_violations']} VIOLATION(S)")
+        # DSP602 covers several downgrade causes (warm-cache alias=0,
+        # absent byte data, partial-alias drop) — the finding lines
+        # below carry the specific diagnosis
+        extra = (f", {r['dsp_downgraded']} downgraded verdict(s) "
+                 "(DSP602 — see findings)"
+                 if r.get("dsp_downgraded") else "")
+        print(f"  program verify ....... {verdict}{extra}")
+        for line in r.get("dsp_findings") or []:
+            print(f"    {line}")
     print(f"  device capacity ...... {_fmt_bytes(r['capacity_bytes'])} "
           f"(headroom {r['headroom']:.2f})")
     if r["fit"] is None:

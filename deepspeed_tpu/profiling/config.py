@@ -55,6 +55,9 @@ class DeepSpeedProfilingConfig:
         self.comm_ledger = _tristate(get_scalar_param(
             prof, C.PROFILING_COMM_LEDGER,
             C.PROFILING_COMM_LEDGER_DEFAULT), C.PROFILING_COMM_LEDGER)
+        self.program_dump = _tristate(get_scalar_param(
+            prof, C.PROFILING_PROGRAM_DUMP,
+            C.PROFILING_PROGRAM_DUMP_DEFAULT), C.PROFILING_PROGRAM_DUMP)
 
     def comm_ledger_enabled(self, telemetry_enabled):
         if self.comm_ledger == "auto":
@@ -65,6 +68,14 @@ class DeepSpeedProfilingConfig:
         if self.memory_ledger == "auto":
             return bool(telemetry_enabled)
         return bool(self.memory_ledger)
+
+    def program_dump_enabled(self, comm_ledger_enabled):
+        """Whether per-program verification artifacts (HLO + sidecar)
+        should land under the run dir.  "auto" follows the comm ledger:
+        the dump consumes exactly what that hook already captures."""
+        if self.program_dump == "auto":
+            return bool(comm_ledger_enabled)
+        return bool(self.program_dump)
 
     def memory_watermarks_enabled(self, telemetry_enabled):
         # watermark output is gauges/events — without telemetry there is
@@ -77,4 +88,5 @@ class DeepSpeedProfilingConfig:
         return (f"DeepSpeedProfilingConfig(memory_ledger="
                 f"{self.memory_ledger!r}, memory_watermarks="
                 f"{self.memory_watermarks!r}, comm_ledger="
-                f"{self.comm_ledger!r})")
+                f"{self.comm_ledger!r}, program_dump="
+                f"{self.program_dump!r})")

@@ -305,8 +305,16 @@ class MemoryLedger:
         self.comm_ledger = comm_ledger
         self.record_memory = bool(record_memory)
         self.host_buffers = HostBufferRegistry()
+        # optional profiling.verify.ProgramDumper: each recording also
+        # lands <run_dir>/programs/<name>.{hlo,json} for the offline
+        # dslint --programs verifier (compile time only, rank 0)
+        self.dumper = None
         self._lock = threading.Lock()
         self._entries = {}
+        # compiled executables kept for engine.verify_programs(): same
+        # lifetime the _LedgeredJit wrappers already pin, plus the
+        # AOT-plan path (which records without a wrapper)
+        self._compiled = {}
 
     # -- program accounting -------------------------------------------
     def wrap(self, name, fn, static_argnums=()):
@@ -317,9 +325,15 @@ class MemoryLedger:
     def record(self, name, compiled):
         """Record one compiled executable (fail-soft; also callable
         directly with an AOT-compiled object, e.g. by the planner)."""
+        comm_entry = None
         if self.comm_ledger is not None:
-            self.comm_ledger.record(name, compiled)
+            comm_entry = self.comm_ledger.record(name, compiled)
         entry = compiled_memory_entry(compiled)
+        with self._lock:
+            self._compiled[str(name)] = compiled
+        if self.dumper is not None:
+            self.dumper.dump(name, compiled, memory_entry=entry,
+                             comm_entry=comm_entry)
         if entry is None:
             with self._lock:
                 self._entries.setdefault(str(name), None)
@@ -352,6 +366,12 @@ class MemoryLedger:
         with self._lock:
             return {k: (dict(v) if v else None)
                     for k, v in self._entries.items()}
+
+    def compiled_programs(self):
+        """{name: compiled executable} of every program recorded so far
+        (the engine.verify_programs() input)."""
+        with self._lock:
+            return dict(self._compiled)
 
     def predicted_peak_bytes(self, name):
         return predicted_peak_bytes(self.entry(name))

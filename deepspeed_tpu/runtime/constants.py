@@ -407,6 +407,15 @@ PROFILING_MEMORY_WATERMARKS_DEFAULT = "auto"
 # disables
 PROFILING_COMM_LEDGER = "comm_ledger"
 PROFILING_COMM_LEDGER_DEFAULT = "auto"
+# per-program verification artifacts (profiling/verify.ProgramDumper):
+# each compiled engine program's optimized HLO + a donation/mesh/comm
+# sidecar land under <telemetry run_dir>/programs/ at compile time
+# (rank 0 only), the input of the offline DSP6xx verifier
+# `python -m deepspeed_tpu.tools.dslint --programs <run_dir>`.  "auto"
+# follows the comm ledger (itself following telemetry.enabled); true
+# forces the dump whenever a run dir exists; false disables
+PROFILING_PROGRAM_DUMP = "program_dump"
+PROFILING_PROGRAM_DUMP_DEFAULT = "auto"
 
 #############################################
 # Compilation subsystem (deepspeed_tpu/runtime/compilation; new — the

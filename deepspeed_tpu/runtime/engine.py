@@ -654,13 +654,19 @@ class DeepSpeedEngine:
                 self.telemetry.enabled),
             telemetry=self.telemetry,
             mesh_axes=mesh_axis_sizes(self.mesh))
-        # the comm ledger rides the memory ledger's AOT hook, so comm-on
-        # forces the shared hook on even with the memory ledger off
-        # (memory events stay gated on the memory ledger's own knob)
+        # the comm ledger and the program dumper both ride the memory
+        # ledger's AOT hook, so either being on forces the shared hook
+        # on even with the memory ledger off (memory events stay gated
+        # on the memory ledger's own knob).  An explicit
+        # program_dump=true with both ledgers off must still dump —
+        # record() is the only dump site, so the hook must be live
         mem_on = (self.profiling_config.memory_ledger_enabled(
             self.telemetry.enabled) or self._aot_plan)
+        dump_on = (self.profiling_config.program_dump_enabled(
+            self.comm_ledger.enabled)
+            and bool(getattr(self.telemetry, "run_dir", None)))
         self.memory_ledger = MemoryLedger(
-            enabled=mem_on or self.comm_ledger.enabled,
+            enabled=mem_on or self.comm_ledger.enabled or dump_on,
             telemetry=self.telemetry,
             comm_ledger=(self.comm_ledger if self.comm_ledger.enabled
                          else None),
@@ -668,6 +674,22 @@ class DeepSpeedEngine:
         self._memory_watermarks = (
             self.profiling_config.memory_watermarks_enabled(
                 self.telemetry.enabled))
+        # per-program verification artifacts (profiling/verify): the
+        # ledger's one compile-time recording also lands HLO + sidecar
+        # under <run_dir>/programs/ for `dslint --programs` — the
+        # DSP6xx program verifier's offline input.  Rank 0 only;
+        # donation/mesh context resolves lazily (specs are final only
+        # after _build_step_functions, programs record on first
+        # dispatch)
+        if dump_on:
+            from ..profiling.verify import ProgramDumper
+
+            self.memory_ledger.dumper = ProgramDumper(
+                self.telemetry.run_dir, rank=jax.process_index(),
+                context_fn=self.program_verify_context,
+                donation_fn=lambda name: (
+                    getattr(self, "_donation_specs", {}).get(name)
+                    or None))
         self.telemetry.emit(
             TEL.EVENT_RUN_START, step=0, world_size=self.world_size,
             dp=self.dp_world_size,
@@ -911,6 +933,38 @@ class DeepSpeedEngine:
         return self.comm_ledger.step_entry(
             self.gradient_accumulation_steps(),
             prefer=self._active_step_program())
+
+    # ------------------------------------------------------------------
+    # program verification (deepspeed_tpu/profiling/verify, DSP6xx)
+    # ------------------------------------------------------------------
+    def program_verify_context(self):
+        """Mesh/parameter/donation context the DSP6xx program verifier
+        resolves collectives against (also serialized into the
+        ``<run_dir>/programs/`` sidecars)."""
+        return {
+            "mesh_axes": mesh_axis_sizes(self.mesh),
+            "data_axis": DATA_AXIS,
+            # the flat fp32 master's footprint: the DSP611 "parameter-
+            # sized payload" floor (reduced storage dtypes only shrink
+            # host buffers; the flatten path stages fp32)
+            "param_bytes": int(np.prod(self.segments.shape)) * 4,
+            "master_provenance": getattr(self.flat, "master_provenance",
+                                         None),
+        }
+
+    def verify_programs(self):
+        """Run the DSP6xx program-level verifier (donation/aliasing +
+        collective semantics, ``tools/dslint/programs.py``) over every
+        program the ledger has compiled so far.  Compile-time artifacts
+        only — zero device syncs, nothing on the step path.  Returns
+        ``{programs_checked, violations, downgraded, diagnostics}``;
+        None when the ledger kept no compiled executables.  In plan
+        mode (``aot_plan=True``) the capacity planner calls this after
+        ``aot_compile_train_step`` so a donation or mesh-axis bug fails
+        the plan, not the 2-AM run."""
+        from ..profiling.verify import verify_engine_programs
+
+        return verify_engine_programs(self)
 
     def _sample_comm_skew(self):
         """Per-rank step-latency export + cross-rank skew at the
@@ -2034,8 +2088,18 @@ class DeepSpeedEngine:
         def accum(acc, g):
             return acc + g
 
+        # donation metadata per jit entry point: single-sourced here so
+        # the DSP6xx program verifier (profiling/verify) checks the
+        # SAME donate tuples the jits were built with — an entry point
+        # without donation declares an empty tuple and is exempt from
+        # the DSP601 alias check
+        self._donation_specs = {"cast_params": (), "fwd_bwd": (),
+                                "eval_fwd": ()}
+
+        accum_donate = (0,)
+        self._donation_specs["accum"] = accum_donate
         self._accum_fn = self.memory_ledger.wrap(
-            "accum", jax.jit(accum, donate_argnums=(0,),
+            "accum", jax.jit(accum, donate_argnums=accum_donate,
                              out_shardings=grad_sharding))
 
         def apply_update(master, opt_state, scale_state, skipped, flat_g, hp,
@@ -2107,11 +2171,13 @@ class DeepSpeedEngine:
                 k: (tuple(host_big for _ in v) if type(v) is tuple
                     else host_big)
                 for k, v in self.state["qres"].items()}
+        apply_donate = (0, 1, 4) + ((7,) if self.state.get("qres")
+                                    else ())
+        self._donation_specs["apply_update"] = apply_donate
         self._apply_fn = self.memory_ledger.wrap(
             "apply_update", jax.jit(
                 apply_update,
-                donate_argnums=(0, 1, 4) + ((7,) if self.state.get("qres")
-                                            else ()),
+                donate_argnums=apply_donate,
                 out_shardings=(master_out_sharding, opt_out_shardings,
                                None, None, None, None, qres_sharding)))
 
@@ -2224,6 +2290,7 @@ class DeepSpeedEngine:
             donate = donate + (11,)
         if self.state.get("qres"):
             donate = donate + (12,)
+        self._donation_specs["train_step"] = donate
         self._train_step_fn = self.memory_ledger.wrap(
             "train_step", jax.jit(
                 train_step,
@@ -2259,6 +2326,9 @@ class DeepSpeedEngine:
                     "warmup (dense) phase; the compressed phase exchanges "
                     "1-bit momenta and cannot clip by global grad norm "
                     "(matches reference onebit_adam.py behavior)", clip)
+            # onebit_adam.build_compressed_step jits with
+            # donate_argnums=(0, 1, 5) (master, opt state, ustep)
+            self._donation_specs["train_step_compressed"] = (0, 1, 5)
             self._train_step_compressed_fn = self.memory_ledger.wrap(
                 "train_step_compressed", optimizer.build_compressed_step(
                     mesh=mesh, loss_fn=self._loss_fn,

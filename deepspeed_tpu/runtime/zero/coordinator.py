@@ -235,6 +235,13 @@ class FlatParamCoordinator:
         self.grad_sharding = NamedSharding(mesh, grad_spec)
         self.replicated = NamedSharding(mesh, P())
 
+        # provenance of the flat master the step programs DONATE
+        # ("jit" = XLA-allocated by the jitted flatten; "jit_copy" =
+        # host-staged then re-homed through a jitted copy;
+        # "host_staging_device_put" = device_put of numpy staging —
+        # offload only, see flatten_to_master).  Recorded into the
+        # DSP6xx program-verification artifacts.
+        self.master_provenance = None
         # row-group layout for offloaded state over the per-host-buffer
         # toolchain limit (see HOST_GROUP_BYTES); None = single buffer
         self.host_group_bounds = None
@@ -308,8 +315,16 @@ class FlatParamCoordinator:
         multi_axis = any(ax != DATA_AXIS
                          for ax in mesh_axis_sizes(self.mesh))
         if self.cpu_offload:
+            # donation provenance (surfaced to the DSP6xx program
+            # verifier via the engine's verify context): the offload
+            # master IS a device_put of host staging buffers — the
+            # documented exception to the jitted-copy laundering rule,
+            # since a copy would round-trip pinned-host state through
+            # device memory and re-impose the init HBM ceiling
+            self.master_provenance = "host_staging_device_put"
             return self._flatten_to_master_host(params)
         if multi_axis:
+            self.master_provenance = "jit_copy"
             master = self._flatten_to_master_host(params)
             # Donation provenance: the engine's step programs DONATE the
             # master, and on CPU a device_put of a numpy staging buffer
@@ -326,6 +341,7 @@ class FlatParamCoordinator:
                 return jax.jit(
                     lambda m: m + jnp.zeros((), m.dtype),
                     out_shardings=self.master_device_sharding)(master)
+        self.master_provenance = "jit"
         with self.mesh:
             return jax.jit(self._flatten_traced,
                            out_shardings=self.master_device_sharding)(params)

@@ -80,6 +80,21 @@ FIELDS = {
                                   "collective ops in the step program"),
     "comm_wire_bytes_per_step": (numbers.Integral,
                                  "predicted wire bytes per step"),
+    # program-verification receipt (round 10, profiling/verify +
+    # tools/dslint/programs): unsuppressed DSP6xx violations over every
+    # compiled engine program — donation aliases materialized,
+    # collectives on the right mesh axes.  0 at HEAD; any regression
+    # gates via bench_diff
+    "dsp_violations": (numbers.Integral,
+                       "ERROR-severity DSP6xx program-verifier findings "
+                       "(gated at zero; heuristic warnings report via "
+                       "dsp_warnings, which has no ratchet to need)"),
+    "dsp_warnings": (numbers.Integral,
+                     "warning-severity DSP6xx findings (informational, "
+                     "never gated — no ratchet exists on this surface)"),
+    "dsp_downgraded": (numbers.Integral,
+                       "DSP602 downgraded verdicts (alias bytes "
+                       "unverifiable: warm-cache/absent/partial)"),
     # multichip-dryrun record envelope (dryrun_multichip's one line;
     # legacy blobs keep n_devices/rc/ok/skipped readable)
     "multichip_schema_version": (numbers.Integral, ""),
@@ -108,6 +123,9 @@ _LEG_FIELDS = {
     "resized_from": numbers.Integral,
     "resized_to": numbers.Integral,
     "resume_step": numbers.Integral,
+    # program-verification receipt (round 10): DSP6xx violations over
+    # the leg engine's compiled programs
+    "dsp_violations": numbers.Integral,
     "error": str,
     "note": str,
 }
@@ -133,6 +151,8 @@ _OFFLOAD_ROW_FIELDS = {
     # comm receipts (round 8)
     "comm_collectives_per_step": numbers.Integral,
     "comm_wire_bytes_per_step": numbers.Integral,
+    # program-verification receipt (round 10)
+    "dsp_violations": numbers.Integral,
     "error": str,
     "note": str,
 }
@@ -175,6 +195,9 @@ THRESHOLDS = {
     # is a sharding/collective regression even before it shows up in
     # step time (generous tol: XLA is free to re-split collectives)
     "comm_wire_bytes_per_step": ("lower", 0.25),
+    # any new program-verifier violation is a gated regression (zero
+    # tolerance: the receipt exists to pin this at 0)
+    "dsp_violations": ("lower", 0.0),
     # multichip: device-count or passing-leg shrinkage must show
     "n_devices": ("higher", 0.0),
     "legs_ok": ("higher", 0.0),
@@ -184,6 +207,7 @@ THRESHOLDS = {
 # thresholds for the pattern-based leg_<name>_<field> family
 _LEG_FIELD_THRESHOLDS = {
     "comm_wire_bytes": ("lower", 0.25),
+    "dsp_violations": ("lower", 0.0),
 }
 
 # thresholds for the pattern-based offload_<row>_<field> family
@@ -194,6 +218,7 @@ _OFFLOAD_FIELD_THRESHOLDS = {
     "predicted_temp_bytes": ("lower", 0.10),
     "host_buffer_bytes": ("lower", 0.10),
     "comm_wire_bytes_per_step": ("lower", 0.25),
+    "dsp_violations": ("lower", 0.0),
 }
 
 

@@ -13,7 +13,14 @@ Three rule families (see ``docs/static_analysis.md``):
   ``DeepSpeedConfig`` calls on every construction;
 - **robustness** (DSE5xx): swallowed-failure patterns — bare
   ``except:`` and broad except-with-empty-body handlers that hide
-  failures from the resilience guard and the logs.
+  failures from the resilience guard and the logs;
+- **programs** (DSP6xx): program-level semantics on the COMPILED
+  artifacts — donation/aliasing safety (declared ``donate_argnums``
+  must materialize as ``input_output_alias`` entries; AST dataflow
+  flags reads-after-donation) and collective semantics (parameter
+  sums spanning non-data mesh axes, psum-for-pmean, comm-ledger
+  drift), via ``--programs <run_dir>`` or
+  ``engine.verify_programs()``.
 
 Suppression: ``# dslint: disable=<rule-id>[,<rule-id>...] [-- reason]``
 inline on the flagged line, or standalone on the line above.
@@ -22,9 +29,10 @@ Stdlib-only by design — importable before jax, usable in any CI image.
 """
 
 # importing the rule modules populates the registries
-from . import hotpath, retrace, robustness, schema  # noqa: F401
+from . import hotpath, programs, retrace, robustness, schema  # noqa: F401
 from .cli import failing, lint_paths, main
-from .core import RULES, Diagnostic, Rule, register_rule, rule_catalog
+from .core import (RULES, Diagnostic, Rule, SourceReadError,
+                   register_rule, rule_catalog, rule_family)
 from .schema import (ConfigIssue, dead_key_diagnostics, extract_schema,
                      get_schema, validate_config_dict)
 
@@ -32,5 +40,5 @@ __all__ = [
     "RULES", "Rule", "Diagnostic", "register_rule", "lint_paths",
     "failing", "main", "extract_schema", "get_schema",
     "validate_config_dict", "dead_key_diagnostics", "ConfigIssue",
-    "rule_catalog",
+    "rule_catalog", "rule_family", "SourceReadError",
 ]

@@ -2,25 +2,43 @@
 
     python -m deepspeed_tpu.tools.dslint deepspeed_tpu/
     python -m deepspeed_tpu.tools.dslint --config ds_config.json
+    python -m deepspeed_tpu.tools.dslint --programs runs/telemetry
     python -m deepspeed_tpu.tools.dslint --list-rules
     python -m deepspeed_tpu.tools.dslint deepspeed_tpu/ --json report.json
+    python -m deepspeed_tpu.tools.dslint deepspeed_tpu/ \
+        --baseline dslint_baseline.json [--update-baseline]
 
-Exit status: 0 when no unsuppressed error/warning diagnostics, 1 when
-violations exist, 2 on usage/parse errors.
+Exit status: 0 when no unsuppressed (and non-baselined) error/warning
+diagnostics, 1 when violations exist, 2 on usage errors — including
+unreadable/non-UTF8 source files and missing baseline/program dirs.
+
+``--programs <run_dir>`` verifies the per-program artifacts a run
+dumped under ``<run_dir>/programs/`` (optimized HLO + donation/mesh
+sidecars, ``profiling.program_dump``) against the DSP6xx rules.
+
+``--baseline <file>`` is the ratchet: known violations recorded in the
+checked-in JSON stop failing the CLI — only NEW ones do.  Pair with
+``--update-baseline`` to (re)record the current state.
 """
 
 import argparse
 import json
 import os
+import re
 import sys
+from collections import Counter
 from typing import List
 
 # rule modules register their checkers on import
-from . import hotpath, retrace, robustness  # noqa: F401
+from . import hotpath, programs, retrace, robustness  # noqa: F401
 from .core import (Diagnostic, FAILING_SEVERITIES, RULES, ParsedFile,
-                   check_file, rule_catalog)
+                   SourceReadError, check_file, rule_catalog, rule_family)
 from .schema import (dead_key_diagnostics, get_schema,
                      issues_to_diagnostics, validate_config_dict)
+
+# version of the --json report format (bumped on breaking shape change)
+JSON_SCHEMA_VERSION = 1
+BASELINE_SCHEMA_VERSION = 1
 
 
 def iter_python_files(paths) -> List[str]:
@@ -51,7 +69,8 @@ def lint_files(files, select=None, ignore=None) -> List[Diagnostic]:
 
     The dead-key cross-check runs once when the scanned set includes the
     package's ``runtime/constants.py`` (i.e. when linting the package
-    itself rather than a stray file).
+    itself rather than a stray file).  Raises :class:`SourceReadError`
+    (CLI: exit 2) for a file that cannot be read or is not UTF-8.
     """
     diags: List[Diagnostic] = []
     constants_file = None
@@ -63,6 +82,8 @@ def lint_files(files, select=None, ignore=None) -> List[Diagnostic]:
                                     rule_id="DSC402",
                                     message=f"file does not parse: {e.msg}"))
             continue
+        except (OSError, UnicodeDecodeError, ValueError) as e:
+            raise SourceReadError(path, e) from e
         diags.extend(check_file(pf))
         norm = path.replace(os.sep, "/")
         if norm.endswith("runtime/constants.py"):
@@ -101,20 +122,122 @@ def lint_config_files(paths) -> List[Diagnostic]:
     return diags
 
 
+def lint_program_dirs(run_dirs):
+    """(diagnostics, artifact count): DSP6xx verification of dumped
+    program artifacts (see ``tools/dslint/programs.py``).  Raises
+    FileNotFoundError when a run dir holds no artifacts (usage error,
+    exit 2)."""
+    diags: List[Diagnostic] = []
+    checked = 0
+    for run_dir in run_dirs:
+        artifacts = programs.load_run_artifacts(run_dir)
+        checked += len(artifacts)
+        diags.extend(programs.verify_artifacts(artifacts))
+    return diags, checked
+
+
+# ---------------------------------------------------------------------------
+# baseline ratchet
+# ---------------------------------------------------------------------------
+
+_PROGRAM_DIAG_RE = re.compile(r"^\[(?P<program>[^\]]+)\] ")
+
+
+def baseline_key(d: Diagnostic) -> str:
+    """Stable identity of one violation for the ratchet: path + rule +
+    message (NOT line numbers, which drift with unrelated edits).
+
+    Program-verifier (DSP6xx artifact) diagnostics key on the PROGRAM
+    name + rule only: their paths embed the run dir and their messages
+    embed byte counts, both of which change run to run — a baselined
+    intentional psum must keep matching after a re-dump or a model
+    resize (the ratchet is the only suppression mechanism for program
+    findings; they have no source line to pragma)."""
+    m = _PROGRAM_DIAG_RE.match(d.message)
+    if m and d.rule_id.startswith("DSP6"):
+        return f"<programs>|{d.rule_id}|{m.group('program')}"
+    return f"{d.path.replace(os.sep, '/')}|{d.rule_id}|{d.message}"
+
+
+def load_baseline(path) -> Counter:
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    violations = data.get("violations") if isinstance(data, dict) else None
+    if violations is None:
+        violations = {}
+    if not isinstance(violations, dict):
+        raise ValueError(
+            f"baseline {path}: 'violations' must be an object of "
+            f"key -> count, got {type(violations).__name__}")
+    try:
+        return Counter({str(k): int(v) for k, v in violations.items()})
+    except (TypeError, ValueError) as e:
+        raise ValueError(
+            f"baseline {path}: violation counts must be integers "
+            f"({e})") from e
+
+
+def write_baseline(path, fail) -> dict:
+    data = {
+        "schema_version": BASELINE_SCHEMA_VERSION,
+        "violations": dict(sorted(Counter(
+            baseline_key(d) for d in fail).items())),
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return data
+
+
+def apply_baseline(fail, baseline: Counter):
+    """(new_violations, baselined_count): occurrences beyond the
+    baselined count of their key still fail (a second instance of a
+    known violation is NEW)."""
+    budget = Counter(baseline)
+    new, baselined = [], 0
+    for d in fail:
+        key = baseline_key(d)
+        if budget[key] > 0:
+            budget[key] -= 1
+            baselined += 1
+        else:
+            new.append(d)
+    return new, baselined
+
+
+def _by_family(diags):
+    return dict(sorted(Counter(rule_family(d.rule_id)
+                               for d in diags).items()))
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="dslint",
         description="TPU-correctness static analysis for DeepSpeed-TPU: "
-                    "hot-path host-sync rules, retrace-hazard rules, and "
-                    "config-schema validation.")
+                    "hot-path host-sync rules, retrace-hazard rules, "
+                    "config-schema validation, and program-level "
+                    "donation/collective-semantics verification "
+                    "(DSP6xx) over dumped compile artifacts.")
     ap.add_argument("paths", nargs="*",
                     help="python files/directories to lint")
     ap.add_argument("--config", action="append", default=[],
                     metavar="JSON",
                     help="validate a DeepSpeed JSON config file against "
                          "the extracted schema")
+    ap.add_argument("--programs", action="append", default=[],
+                    metavar="RUN_DIR",
+                    help="verify per-program artifacts dumped under "
+                         "RUN_DIR/programs/ (profiling.program_dump) "
+                         "against the DSP6xx rules")
     ap.add_argument("--json", metavar="FILE", dest="json_out",
-                    help="write a machine-readable report")
+                    help="write a machine-readable report (carries a "
+                         "stable schema_version field)")
+    ap.add_argument("--baseline", metavar="FILE",
+                    help="ratchet mode: violations recorded in FILE do "
+                         "not fail; only NEW ones do")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="write the current violations to --baseline "
+                         "FILE (exit 0)")
     ap.add_argument("--select", metavar="IDS",
                     help="comma-separated rule ids to run exclusively")
     ap.add_argument("--ignore", metavar="IDS",
@@ -128,8 +251,12 @@ def main(argv=None) -> int:
     if args.list_rules:
         print(rule_catalog())
         return 0
-    if not args.paths and not args.config:
+    if not args.paths and not args.config and not args.programs:
         ap.print_usage(sys.stderr)
+        return 2
+    if args.update_baseline and not args.baseline:
+        print("dslint: --update-baseline requires --baseline FILE",
+              file=sys.stderr)
         return 2
 
     select = set(args.select.split(",")) if args.select else None
@@ -139,25 +266,67 @@ def main(argv=None) -> int:
     except FileNotFoundError as e:
         print(f"dslint: no such path: {e}", file=sys.stderr)
         return 2
-    diags = lint_files(files, select=select, ignore=ignore)
+    try:
+        diags = lint_files(files, select=select, ignore=ignore)
+    except SourceReadError as e:
+        print(f"dslint: {e}", file=sys.stderr)
+        return 2
     diags.extend(lint_config_files(args.config))
+    try:
+        prog_diags, programs_checked = lint_program_dirs(args.programs)
+    except (FileNotFoundError, OSError, ValueError) as e:
+        print(f"dslint: cannot load program artifacts: {e}",
+              file=sys.stderr)
+        return 2
+    if select:
+        prog_diags = [d for d in prog_diags if d.rule_id in select]
+    if ignore:
+        prog_diags = [d for d in prog_diags if d.rule_id not in ignore]
+    diags.extend(prog_diags)
     diags.sort(key=lambda d: (d.path, d.line, d.col, d.rule_id))
 
     fail = failing(diags)
     suppressed = [d for d in diags if d.suppressed]
+
+    baseline = None
+    baselined = 0
+    if args.baseline:
+        if args.update_baseline:
+            write_baseline(args.baseline, fail)
+            print(f"dslint: baseline updated: {len(fail)} violation(s) "
+                  f"recorded to {args.baseline}")
+            baseline = Counter(baseline_key(d) for d in fail)
+            fail, baselined = [], len(fail)
+        else:
+            try:
+                baseline = load_baseline(args.baseline)
+            except (OSError, ValueError) as e:
+                print(f"dslint: cannot read --baseline {args.baseline}: "
+                      f"{e}", file=sys.stderr)
+                return 2
+            fail, baselined = apply_baseline(fail, baseline)
+
     for d in diags:
         if d.suppressed and not args.show_suppressed:
             continue
         print(d.format())
+    tail = f", {baselined} baselined" if args.baseline else ""
     print(f"dslint: {len(fail)} violation(s), {len(suppressed)} "
-          f"suppressed, {len(files)} file(s) scanned, "
+          f"suppressed{tail}, {len(files)} file(s) scanned, "
           f"{len(RULES)} rules")
 
     if args.json_out:
         report = {
+            "schema_version": JSON_SCHEMA_VERSION,
             "violations": len(fail),
+            "violations_by_family": _by_family(fail),
             "suppressed": len(suppressed),
+            "suppressed_by_family": _by_family(suppressed),
+            "baselined": baselined,
+            "baseline_file": args.baseline,
             "files_scanned": len(files),
+            "program_dirs": list(args.programs),
+            "programs_checked": programs_checked,
             "schema_keys": len(get_schema().all_keys()),
             "diagnostics": [d.to_json() for d in diags],
             "rules": {r.id: {"name": r.name, "severity": r.severity,

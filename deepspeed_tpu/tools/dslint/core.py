@@ -51,6 +51,23 @@ def register_rule(rule: Rule) -> Rule:
     return rule
 
 
+def rule_family(rule_id: str) -> str:
+    """'DSH203' -> 'DSH2': the per-family budget/reporting key (family
+    = letter prefix + leading digit of the hundreds block)."""
+    return rule_id[:4]
+
+
+class SourceReadError(Exception):
+    """A source file could not be read (missing, unreadable, or not
+    UTF-8) — a usage-class failure (CLI exit 2), distinct from a
+    file that reads fine but does not parse (DSC402 diagnostic)."""
+
+    def __init__(self, path, err):
+        super().__init__(f"cannot read {path}: {err}")
+        self.path = path
+        self.err = err
+
+
 @dataclasses.dataclass
 class Diagnostic:
     """One finding at a source location."""

@@ -1,0 +1,713 @@
+"""Program-level semantic rules (DSP6xx): donation/aliasing safety and
+collective semantics, checked on the COMPILED program.
+
+dslint's other rule families lint Python ASTs; the two real bugs this
+repo has shipped lived below what any AST rule can see — in the
+optimized HLO that XLA/GSPMD emits:
+
+- the ZeRO flatten that psum-SUMMED parameters across the tensor-
+  parallel axis on every dp×tp mesh (finite loss masked it for eight
+  rounds; caught only by the runtime dp=1 parity assert, PR 8);
+- the donated ``device_put`` of a live numpy staging buffer that
+  flakily corrupted the glibc heap on the second train step.
+
+Both are *statically decidable* from artifacts the stack already
+captures at AOT-compile time (the MemoryLedger/CommLedger hook walks
+``compiled.as_text()`` once per program): donation shows up as the
+module-header ``input_output_alias`` map, and a wrong-mesh-axis sum
+shows up as an ``all-reduce`` whose replica groups span more devices
+than the data axis.  This module turns each into a rule, so the next
+instance is a CI failure instead of a 2-AM loss divergence.
+
+Two analysis surfaces:
+
+- **HLO artifacts** (:class:`ProgramArtifact` + :func:`verify_program`)
+  — built live by ``engine.verify_programs()``
+  (``profiling/verify.py``) or loaded from the ``<run_dir>/programs/``
+  dump via ``python -m deepspeed_tpu.tools.dslint --programs
+  <run_dir>``;
+- **Python source** (the DSP603 dataflow checker registered below) —
+  an AST companion that flags driver code reading a buffer after it
+  was passed to a donating jit call (the heap-corruption shape).
+
+Like the rest of dslint, this module is stdlib-only; the HLO collective
+parser is borrowed lazily from ``profiling/comm.py`` (itself
+stdlib+regex) so the ring-model accounting has exactly one
+implementation.
+"""
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from .core import (Diagnostic, ParsedFile, Rule, call_name, diag,
+                   register_file_checker, register_rule)
+
+# artifact sidecar format version (``<run_dir>/programs/<name>.json``)
+ARTIFACT_SCHEMA_VERSION = 1
+PROGRAMS_DIRNAME = "programs"
+
+# -- rule catalog -----------------------------------------------------------
+
+register_rule(Rule(
+    id="DSP601", name="donation-not-materialized", severity="error",
+    summary="jit entry point declares donate_argnums but the compiled "
+            "executable materialized no input→output aliases",
+    rationale="Donation is a capacity contract: the engine sizes HBM "
+              "assuming state buffers are reused in place.  A program "
+              "that silently drops every alias (dtype/sharding mismatch, "
+              "backend limitation) doubles its state footprint and the "
+              "capacity planner's verdict is wrong.",
+    autofix_hint="Check that donated arguments' shapes/dtypes/shardings "
+                 "match the outputs they should alias; see the "
+                 "input_output_alias header of the dumped HLO."))
+
+register_rule(Rule(
+    id="DSP602", name="donation-unverifiable", severity="info",
+    summary="donation aliases present in HLO but memory_analysis "
+            "reports alias=0 (warm-cache deserialization caveat)",
+    rationale="Executables deserialized from the persistent compile "
+              "cache can report alias_size_in_bytes=0 even though the "
+              "program text declares its input_output_alias map (PR 7 "
+              "measured caveat, docs/observability.md).  Structural "
+              "aliasing IS verified from the text; only the byte "
+              "accounting is unverifiable — an explicit downgraded "
+              "verdict, never silence.",
+    autofix_hint="Cold-compile (clear the XLA cache) to re-verify the "
+                 "byte accounting; predicted peaks are conservative "
+                 "meanwhile."))
+
+register_rule(Rule(
+    id="DSP603", name="use-after-donation", severity="error",
+    summary="a buffer reference is read after being passed to a "
+            "donating jit call",
+    rationale="A donated buffer is dead the moment the call is issued: "
+              "XLA may reuse its memory for the outputs.  Reading the "
+              "Python reference afterwards observes garbage — and when "
+              "the donated value is a device_put of a live numpy "
+              "staging buffer, the runtime can free numpy-owned memory "
+              "and corrupt the allocator heap (observed: flaky glibc "
+              "aborts on the 2nd train step, PR 8).",
+    autofix_hint="Drop the reference after the donating call, or "
+                 "re-home device_put results through a jitted copy so "
+                 "the XLA allocator owns the donated buffer."))
+
+register_rule(Rule(
+    id="DSP611", name="param-sum-over-non-data-axis", severity="error",
+    summary="cross-replica all-reduce sums a parameter-sized tensor "
+            "over replica groups spanning a non-data mesh axis",
+    rationale="Non-data mesh axes (model/pipe/seq/expert) hold REPLICAS "
+              "of unsharded parameters, not partial values: an "
+              "all-reduce whose groups span them multiplies every "
+              "parameter by the axis product.  This is the flatten-×tp "
+              "bug — loss stays finite (~ln vocab), so nothing "
+              "downstream fails loudly.  Scope: the rule fires only "
+              "when the full-mesh sum is the program's ONLY collective "
+              "shape — the standalone init/flatten program signature.  "
+              "Inside step programs GSPMD legitimately emits full-mesh "
+              "assembly all-reduces over partition-exact "
+              "dynamic-update-slice writes (measured parity-exact on "
+              "this toolchain); those programs always carry data-axis-"
+              "scoped collectives alongside and are exempt — the "
+              "multichip dp=1 parity asserts remain their gate.",
+    autofix_hint="Reduce over the data axis only (psum with the axis "
+                 "name), or build the buffer host-side as "
+                 "flatten_to_master now does."))
+
+register_rule(Rule(
+    id="DSP612", name="psum-for-pmean-suspect", severity="warning",
+    summary="scalar cross-replica all-reduce with no mean-compensation "
+            "scaling constant anywhere in the program",
+    rationale="Step semantics for losses/metrics exchanged across data "
+              "replicas almost always require a MEAN; a bare psum "
+              "scales them by the group size and trains on a silently "
+              "multiplied signal.  Heuristic: a correct pmean (or a "
+              "global-batch-normalized loss) leaves a 1/k scaling "
+              "constant with the group size dividing k in the "
+              "optimized HLO; its absence is the psum signature.",
+    autofix_hint="Use jax.lax.pmean (or divide by the axis size); if "
+                 "the sum is intentional (e.g. a grad-norm psum), "
+                 "ratchet it via `--baseline`."))
+
+register_rule(Rule(
+    id="DSP614", name="collective-analysis-unavailable",
+    severity="warning",
+    summary="the HLO collective parser (profiling/comm.py) could not "
+            "be imported — DSP611/DSP612/DSP613 did NOT run",
+    rationale="The collective-semantics checks borrow the CommLedger's "
+              "parser so the wire model has one implementation; when "
+              "that import fails (broken environment, vendored tools "
+              "without the profiling package) the checks silently not "
+              "running would read as 'verified clean' — the exact "
+              "silence this rule family exists to eliminate.",
+    autofix_hint="Run the verifier in an environment where "
+                 "deepspeed_tpu.profiling imports (any env that can "
+                 "train), or fix the import error it reports."))
+
+register_rule(Rule(
+    id="DSP613", name="comm-ledger-drift", severity="warning",
+    summary="recorded CommLedger totals drift from the HLO re-parse "
+            "beyond tolerance",
+    rationale="The run artifact's recorded collective/wire-byte totals "
+              "are what bench receipts and regression gates quote; if "
+              "re-walking the dumped HLO disagrees, the artifact is "
+              "stale (edited, or recorded by a drifted parser) and the "
+              "quoted receipts are unauditable.",
+    autofix_hint="Re-dump the program artifacts from a fresh compile "
+                 "(delete <run_dir>/programs and rerun)."))
+
+
+# ---------------------------------------------------------------------------
+# HLO text helpers
+# ---------------------------------------------------------------------------
+
+# one module-header alias entry: ``{1}: (0, {}, may-alias)`` —
+# (output tuple index path): (parameter number, param index path, kind)
+_ALIAS_ENTRY_RE = re.compile(
+    r"\{(?P<out>[0-9, ]*)\}\s*:\s*\((?P<param>\d+),\s*\{[0-9, ]*\},\s*"
+    r"(?P<kind>may-alias|must-alias)\)")
+_ALIAS_HEADER_RE = re.compile(r"input_output_alias=\{")
+
+# scalar f32/f64 constants in optimized HLO (array literals don't match)
+_CONST_RE = re.compile(r"constant\((-?[0-9][0-9.eE+-]*)\)")
+
+
+def parse_input_output_aliases(hlo_text: str) -> List[Tuple[str, int]]:
+    """``[(output_index_path, parameter_number)]`` from the module
+    header's ``input_output_alias`` map (empty when the program
+    materialized no aliases)."""
+    m = _ALIAS_HEADER_RE.search(hlo_text)
+    if m is None:
+        return []
+    # entries live between the header's braces; scanning the following
+    # header line is enough (entries never span lines)
+    segment = hlo_text[m.end():hlo_text.find("\n", m.end())]
+    return [(e.group("out").strip(), int(e.group("param")))
+            for e in _ALIAS_ENTRY_RE.finditer(segment)]
+
+
+def _parse_collectives(hlo_text: str, all_participants: int):
+    """The CommLedger's own parser, borrowed lazily (one wire-model
+    implementation); None when unavailable (dslint running without the
+    package's profiling modules)."""
+    try:
+        from ...profiling import comm as comm_prof
+    except Exception:
+        return None
+    return comm_prof.parse_hlo_collectives(
+        hlo_text, all_participants=all_participants)
+
+
+def _collective_summary(ops):
+    try:
+        from ...profiling import comm as comm_prof
+    except Exception:
+        return None
+    return comm_prof.collective_summary(ops)
+
+
+def has_mean_scaling_evidence(hlo_text: str, group: int) -> bool:
+    """Whether the module holds a scaling constant consistent with a
+    mean over a ``group``-wide replica group: any fractional constant
+    ``c`` with ``1/c`` an integer that ``group`` divides.  Covers both
+    the direct pmean lowering (``multiply(all-reduce, 1/g)``) and a
+    loss normalized by the global element count (``1/(g·k)``)."""
+    if group <= 1:
+        return True
+    for tok in set(_CONST_RE.findall(hlo_text)):
+        try:
+            c = float(tok)
+        except ValueError:
+            continue
+        if not 0.0 < abs(c) < 1.0:
+            continue
+        inv = 1.0 / abs(c)
+        k = round(inv)
+        if k and abs(inv - k) <= 1e-6 * inv and k % group == 0:
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Program artifacts
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ProgramArtifact:
+    """One compiled program plus the metadata the DSP6xx rules need.
+
+    Built live from an engine's ledger (``profiling/verify.py``) or
+    loaded from a ``<run_dir>/programs/`` dump.  ``path`` is what
+    diagnostics point at (the ``.hlo`` file, or a ``<program>`` pseudo
+    path for in-memory verification)."""
+
+    name: str
+    hlo: str
+    path: str = ""
+    # declared pytree-level donate_argnums (empty tuple/None = no
+    # donation declared; the DSP60x checks then have nothing to verify)
+    donate_argnums: Optional[Tuple[int, ...]] = None
+    # memory_analysis alias bytes (None = analysis unavailable)
+    alias_size_in_bytes: Optional[int] = None
+    mesh_axes: Dict[str, int] = dataclasses.field(default_factory=dict)
+    data_axis: str = "data"
+    # total bytes of the flat parameter master (the DSP611 payload
+    # floor); None disables the parameter-shape test
+    param_bytes: Optional[int] = None
+    # the CommLedger entry recorded at compile time (DSP613 cross-check)
+    comm: Optional[dict] = None
+    # init-provenance note from the flat coordinator (informational)
+    master_provenance: Optional[str] = None
+
+    def __post_init__(self):
+        if not self.path:
+            self.path = f"<{self.name}>"
+        if self.donate_argnums is not None:
+            self.donate_argnums = tuple(int(i) for i in self.donate_argnums)
+
+    @property
+    def total_devices(self) -> int:
+        n = 1
+        for size in self.mesh_axes.values():
+            n *= int(size)
+        return n
+
+    def sidecar(self) -> dict:
+        """The JSON sidecar ``profiling/verify.ProgramDumper`` writes."""
+        return {
+            "artifact_schema_version": ARTIFACT_SCHEMA_VERSION,
+            "program": self.name,
+            "hlo_file": f"{self.name}.hlo",
+            "donate_argnums": (list(self.donate_argnums)
+                               if self.donate_argnums is not None else None),
+            "alias_size_in_bytes": self.alias_size_in_bytes,
+            "mesh_axes": dict(self.mesh_axes),
+            "data_axis": self.data_axis,
+            "param_bytes": self.param_bytes,
+            "comm": self.comm,
+            "master_provenance": self.master_provenance,
+        }
+
+
+def load_run_artifacts(run_dir: str) -> List[ProgramArtifact]:
+    """Artifacts from ``<run_dir>/programs/*.json`` (+ their ``.hlo``
+    texts).  Accepts the programs dir itself too.  Raises
+    ``FileNotFoundError`` when neither exists."""
+    progdir = os.path.join(run_dir, PROGRAMS_DIRNAME)
+    if not os.path.isdir(progdir):
+        if os.path.isdir(run_dir) and any(
+                n.endswith(".json") for n in os.listdir(run_dir)):
+            progdir = run_dir
+        else:
+            raise FileNotFoundError(
+                f"no program artifacts under {run_dir!r} (expected "
+                f"{PROGRAMS_DIRNAME}/<name>.json sidecars — run with "
+                "profiling.program_dump enabled)")
+    out = []
+    for name in sorted(os.listdir(progdir)):
+        if not name.endswith(".json"):
+            continue
+        path = os.path.join(progdir, name)
+        with open(path, "r", encoding="utf-8") as f:
+            side = json.load(f)
+        if not isinstance(side, dict) or "program" not in side:
+            continue  # foreign json in a shared dir
+        hlo_name = side.get("hlo_file") or f"{side['program']}.hlo"
+        if not isinstance(hlo_name, str):
+            raise ValueError(
+                f"malformed program sidecar {path}: hlo_file must be a "
+                f"string, got {type(hlo_name).__name__}")
+        hlo_path = os.path.join(progdir, hlo_name)
+        try:
+            with open(hlo_path, "r", encoding="utf-8") as f:
+                hlo = f.read()
+        except OSError:
+            hlo = ""
+        try:
+            out.append(ProgramArtifact(
+                name=str(side["program"]), hlo=hlo, path=hlo_path,
+                donate_argnums=(tuple(side["donate_argnums"])
+                                if side.get("donate_argnums") else None),
+                alias_size_in_bytes=side.get("alias_size_in_bytes"),
+                mesh_axes=dict(side.get("mesh_axes") or {}),
+                data_axis=side.get("data_axis") or "data",
+                param_bytes=side.get("param_bytes"),
+                comm=side.get("comm"),
+                master_provenance=side.get("master_provenance")))
+        except (TypeError, ValueError) as e:
+            # type-malformed sidecar (donate_argnums: 5, mesh_axes as a
+            # list, ...): a usage-class load failure the CLI reports as
+            # exit 2, never a traceback
+            raise ValueError(
+                f"malformed program sidecar {path}: {e}") from e
+    if not out:
+        # a run dir full of OTHER json (latency-rank*.json etc.) must
+        # not read as "0 programs, verified clean" — a run that never
+        # dumped (program_dump off) fails the CI verify step loudly
+        raise FileNotFoundError(
+            f"no program artifacts under {run_dir!r} (found json files "
+            f"but none with a 'program' sidecar key — was "
+            "profiling.program_dump enabled for this run?)")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# HLO-side verification passes
+# ---------------------------------------------------------------------------
+
+def _pdiag(artifact, rule_id, message) -> Diagnostic:
+    return Diagnostic(path=artifact.path, line=1, col=1, rule_id=rule_id,
+                      message=f"[{artifact.name}] {message}")
+
+
+def check_donation(artifact: ProgramArtifact) -> List[Diagnostic]:
+    """DSP601/DSP602: declared donation must materialize as
+    input→output aliases in the compiled module."""
+    declared = artifact.donate_argnums
+    if not declared or not artifact.hlo:
+        return []
+    aliases = parse_input_output_aliases(artifact.hlo)
+    if not aliases:
+        return [_pdiag(
+            artifact, "DSP601",
+            f"donate_argnums={tuple(declared)} declared but the compiled "
+            "module header carries NO input_output_alias entries — every "
+            "donated state buffer is copied, not reused")]
+    # Partial-drop lower bound: each donated pytree argument flattens
+    # to >= 1 HLO parameter, so fewer DISTINCT aliased parameters than
+    # declared argnums proves at least one donated argument aliased
+    # nothing at all.  This is a lower bound only — per-ARGUMENT
+    # coverage needs the pytree->parameter mapping, which the artifact
+    # does not carry, so a dropped buffer inside a multi-leaf argument
+    # (XLA's "Some donated buffers were not usable" warning) can still
+    # pass; the verdict is program-granular by design.
+    aliased_params = {param for _, param in aliases}
+    if len(aliased_params) < len(declared):
+        return [_pdiag(
+            artifact, "DSP602",
+            f"only {len(aliased_params)} distinct aliased parameter(s) "
+            f"for {len(declared)} donated argument(s) "
+            f"(donate_argnums={tuple(declared)}): at least one donated "
+            "argument materialized no alias — its buffers are copied, "
+            "not reused, and the capacity math overcounts")]
+    if artifact.alias_size_in_bytes == 0 \
+            or artifact.alias_size_in_bytes is None:
+        # byte accounting unverifiable either way — explicit downgraded
+        # verdict, never silence: 0 is the documented warm-cache
+        # deserialization caveat, None means the backend (or sidecar)
+        # carried no memory_analysis at all
+        why = ("memory_analysis reports alias=0 bytes "
+               "(cache-deserialized executable)"
+               if artifact.alias_size_in_bytes == 0 else
+               "no memory_analysis byte data available for this "
+               "executable")
+        return [_pdiag(
+            artifact, "DSP602",
+            f"{len(aliases)} input_output_alias entr"
+            f"{'y' if len(aliases) == 1 else 'ies'} verified from HLO "
+            f"text, but {why}; byte accounting unverifiable, predicted "
+            "peaks conservative")]
+    return []
+
+
+def check_collectives(artifact: ProgramArtifact) -> List[Diagnostic]:
+    """DSP611/DSP612/DSP613 over one program's optimized HLO."""
+    if not artifact.hlo:
+        return []
+    ops = _parse_collectives(artifact.hlo, artifact.total_devices)
+    if ops is None:
+        # parser unavailable: the checks did NOT run — say so loudly
+        # instead of reading as verified-clean (DSP614)
+        return [_pdiag(
+            artifact, "DSP614",
+            "collective parser (deepspeed_tpu.profiling.comm) "
+            "unimportable in this environment — DSP611/DSP612/DSP613 "
+            "were skipped, this program's collective semantics are "
+            "UNVERIFIED")]
+    out: List[Diagnostic] = []
+    dp = max(int(artifact.mesh_axes.get(artifact.data_axis, 1)), 1)
+
+    # DSP611: parameter-sized all-reduce spanning a non-data axis.
+    # Exemption (see the rule rationale): a program that ALSO holds
+    # collectives of any other shape — data-axis-scoped reductions,
+    # gathers, scatters — is a step program whose full-mesh sum is a
+    # GSPMD assembly over partition-exact DUS writes (parity-exact by
+    # measurement); only the init/flatten signature, where the suspect
+    # sum is the sole collective shape, fires.
+    if artifact.param_bytes:
+        suspects = [rec for rec in ops
+                    if rec["op"] == "all-reduce" and rec["group"] > dp
+                    and rec["out_bytes"] >= artifact.param_bytes]
+        assembly_evidence = any(
+            rec["op"] != "all-reduce" or rec["group"] <= dp
+            for rec in ops if rec not in suspects)
+        for rec in () if assembly_evidence else suspects:
+            factor = rec["group"] // dp
+            out.append(_pdiag(
+                artifact, "DSP611",
+                f"all-reduce over {rec['group']} devices sums a "
+                f"parameter-sized tensor ({rec['out_bytes']} bytes >= "
+                f"flat master {artifact.param_bytes}) but the "
+                f"{artifact.data_axis} axis is only {dp} wide: the "
+                f"non-data replicas get SUMMED and every parameter "
+                f"arrives ×{factor} (the flatten-×tp bug shape)"))
+
+    # DSP612: scalar psum with no mean-compensation constant in sight
+    for rec in ops:
+        if (rec["op"] == "all-reduce" and rec["group"] > 1
+                and rec["out_bytes"] <= 8
+                and not has_mean_scaling_evidence(artifact.hlo,
+                                                 rec["group"])):
+            out.append(_pdiag(
+                artifact, "DSP612",
+                f"scalar all-reduce over {rec['group']} replicas with no "
+                f"1/k scaling constant (k divisible by {rec['group']}) "
+                "anywhere in the module — psum where the step semantics "
+                "likely require a mean"))
+
+    # DSP613: recorded ledger entry vs re-parse
+    if artifact.comm:
+        fresh = _collective_summary(ops)
+        if fresh is not None:
+            drifts = []
+            if fresh["collectives"] != artifact.comm.get("collectives"):
+                drifts.append(
+                    f"collectives {artifact.comm.get('collectives')} -> "
+                    f"{fresh['collectives']}")
+            for field in ("payload_bytes", "wire_bytes"):
+                rec_v = artifact.comm.get(field)
+                new_v = fresh[field]
+                if rec_v is None:
+                    continue
+                tol = max(abs(new_v), 1) * 0.02
+                if abs(int(rec_v) - int(new_v)) > tol:
+                    drifts.append(f"{field} {rec_v} -> {new_v}")
+            if drifts:
+                out.append(_pdiag(
+                    artifact, "DSP613",
+                    "recorded comm-ledger totals drift from the HLO "
+                    f"re-parse: {'; '.join(drifts)} (stale or tampered "
+                    "artifact)"))
+    return out
+
+
+def verify_program(artifact: ProgramArtifact) -> List[Diagnostic]:
+    """All DSP6xx HLO-side diagnostics for one program artifact."""
+    if not artifact.hlo:
+        # a sidecar whose HLO text is missing/empty would otherwise
+        # make every HLO-side rule early-return — "verified clean" on
+        # exactly the stale/tampered-dump scenario DSP613 exists for
+        return [_pdiag(
+            artifact, "DSP613",
+            "sidecar present but the program's HLO text is missing or "
+            "empty — artifact unverifiable (stale or tampered dump; "
+            "re-dump with profiling.program_dump enabled)")]
+    return check_donation(artifact) + check_collectives(artifact)
+
+
+def verify_artifacts(artifacts) -> List[Diagnostic]:
+    out: List[Diagnostic] = []
+    for artifact in artifacts:
+        out.extend(verify_program(artifact))
+    out.sort(key=lambda d: (d.path, d.rule_id, d.message))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# DSP603: AST dataflow — read-after-donation in driver code
+# ---------------------------------------------------------------------------
+
+_NUMPY_ALLOC_FNS = {"zeros", "empty", "ones", "full", "asarray", "array",
+                    "frombuffer", "copy", "ascontiguousarray",
+                    "zeros_like", "empty_like"}
+_MISSING = object()
+
+
+def _literal_argnums(node) -> Optional[Tuple[int, ...]]:
+    """Literal donate_argnums value -> positions tuple, None when the
+    expression is computed (engine-style ``donate`` variables)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if not (isinstance(elt, ast.Constant)
+                    and isinstance(elt.value, int)):
+                return None
+            out.append(elt.value)
+        return tuple(out)
+    return None
+
+
+def _donating_jit_spec(expr):
+    """donate positions of the first ``jit(..., donate_argnums=...)``
+    call inside ``expr`` (``_MISSING`` when none)."""
+    for sub in ast.walk(expr):
+        if not isinstance(sub, ast.Call):
+            continue
+        if call_name(sub).rsplit(".", 1)[-1] != "jit":
+            continue
+        for kw in sub.keywords:
+            if kw.arg == "donate_argnums":
+                return _literal_argnums(kw.value)
+    return _MISSING
+
+
+def _target_key(tgt) -> Optional[str]:
+    if isinstance(tgt, ast.Name):
+        return tgt.id
+    if (isinstance(tgt, ast.Attribute) and isinstance(tgt.value, ast.Name)
+            and tgt.value.id == "self"):
+        return f"self.{tgt.attr}"
+    return None
+
+
+def _callee_key(call: ast.Call) -> Optional[str]:
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    if (isinstance(call.func, ast.Attribute)
+            and isinstance(call.func.value, ast.Name)
+            and call.func.value.id == "self"):
+        return f"self.{call.func.attr}"
+    return None
+
+
+def _collect_donors(tree) -> Dict[str, Optional[Tuple[int, ...]]]:
+    """Names (``x`` / ``self.x``) bound to donating jit callables
+    anywhere in the module, with their donated positions (None =
+    positions not statically known)."""
+    donors: Dict[str, Optional[Tuple[int, ...]]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        key = _target_key(node.targets[0])
+        if key is None:
+            continue
+        spec = _donating_jit_spec(node.value)
+        if spec is not _MISSING:
+            donors[key] = spec
+    return donors
+
+
+def _is_numpy_alloc(expr) -> bool:
+    return (isinstance(expr, ast.Call)
+            and isinstance(expr.func, ast.Attribute)
+            and expr.func.attr in _NUMPY_ALLOC_FNS
+            and isinstance(expr.func.value, ast.Name)
+            and expr.func.value.id in ("np", "numpy"))
+
+
+def _is_device_put(expr) -> bool:
+    return (isinstance(expr, ast.Call)
+            and call_name(expr).rsplit(".", 1)[-1] == "device_put")
+
+
+def check_use_after_donation(pf: ParsedFile,
+                             index=None) -> List[Diagnostic]:
+    """The DSP603 dataflow pass over one module.
+
+    Intra-procedural and name-based by design: only plain local names
+    are tracked (engine code passing ``self.state[...]`` pytree slots
+    that the call's outputs re-bind is the sanctioned pattern and never
+    matches).  A later re-binding of the name clears the watch."""
+    from .analysis import ModuleIndex, body_nodes
+
+    if index is None:
+        index = ModuleIndex(pf.tree)
+    donors = _collect_donors(pf.tree)
+    out: List[Diagnostic] = []
+    for fn in index.functions:
+        # last simple assignment per local name (for device_put / numpy
+        # staging provenance), in source order
+        assigns: Dict[str, ast.expr] = {}
+        events = []  # (lineno, col, kind, payload)
+        for node, _ in body_nodes(fn, index.node_map):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                events.append((node.lineno, node.col_offset, "assign",
+                               (node.targets[0].id, node.value)))
+            elif isinstance(node, ast.Name):
+                if isinstance(node.ctx, ast.Store):
+                    events.append((node.lineno, node.col_offset, "store",
+                                   node.id))
+                elif isinstance(node.ctx, (ast.Del,)):
+                    events.append((node.lineno, node.col_offset, "store",
+                                   node.id))
+                elif isinstance(node.ctx, ast.Load):
+                    events.append((node.lineno, node.col_offset, "load",
+                                   node))
+            if isinstance(node, ast.Call):
+                callee = _callee_key(node)
+                if callee in donors:
+                    events.append((node.lineno, node.col_offset, "donate",
+                                   (node, donors[callee], callee)))
+        # within one statement line: argument loads evaluate first, then
+        # the donating call, then the target re-binding — so
+        # ``acc = donor(acc)`` watches and immediately clears ``acc``
+        _PRIO = {"load": 0, "donate": 1, "assign": 2, "store": 2}
+        events.sort(key=lambda e: (e[0], _PRIO[e[2]], e[1]))
+
+        # watched[name] -> (donating call node, callee, staged_numpy)
+        watched: Dict[str, tuple] = {}
+        for lineno, col, kind, payload in events:
+            if kind == "assign":
+                name, value = payload
+                assigns[name] = value
+                watched.pop(name, None)
+            elif kind == "store":
+                watched.pop(payload, None)
+            elif kind == "donate":
+                call, positions, callee = payload
+                if positions is None:
+                    # computed donate_argnums: only the high-confidence
+                    # staged-numpy shape is worth flagging
+                    cand = list(enumerate(call.args))
+                else:
+                    cand = [(i, call.args[i]) for i in positions
+                            if i < len(call.args)]
+                for i, arg in cand:
+                    names = []
+                    staged = False
+                    src = arg
+                    if isinstance(src, ast.Name):
+                        names.append(src.id)
+                        src = assigns.get(src.id, src)
+                    if _is_device_put(src) and src.args \
+                            and isinstance(src.args[0], ast.Name):
+                        base = src.args[0].id
+                        names.append(base)
+                        staged = _is_numpy_alloc(assigns.get(base, base))
+                    if positions is None and not staged:
+                        continue
+                    for nm in names:
+                        watched[nm] = (call, callee, staged)
+            elif kind == "load":
+                node = payload
+                info = watched.get(node.id)
+                if info is None:
+                    continue
+                call_end = getattr(info[0], "end_lineno", info[0].lineno)
+                if node.lineno <= (call_end or info[0].lineno):
+                    continue
+                call, callee, staged = info
+                extra = (" — and it is a live numpy STAGING buffer whose "
+                         "memory the runtime may free (heap corruption)"
+                         if staged else "")
+                # no line number in the message: baseline keys embed the
+                # message verbatim, and line numbers drift with
+                # unrelated edits (the diagnostic's own location already
+                # points at the read site)
+                out.append(diag(
+                    pf, node, "DSP603",
+                    f"'{node.id}' read after being donated to "
+                    f"{callee}(...): the buffer may already be reused "
+                    f"by its outputs{extra}"))
+    return out
+
+
+@register_file_checker
+def check_donation_dataflow(pf: ParsedFile) -> List[Diagnostic]:
+    return check_use_after_donation(pf)

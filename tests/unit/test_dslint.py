@@ -787,7 +787,7 @@ def test_cli_validates_config_files(tmp_path):
 def test_every_rule_id_is_documented():
     for rule in RULES.values():
         assert rule.summary and rule.rationale, rule.id
-        assert rule.id[:3] in ("DSH", "DSR", "DSC", "DSE")
+        assert rule.id[:3] in ("DSH", "DSR", "DSC", "DSE", "DSP")
 
 
 # ---------------------------------------------------------------------------
@@ -902,3 +902,159 @@ def probe():
     diags = lp([str(path)])
     assert not failing(diags)
     assert any(d.suppressed and d.rule_id == "DSE502" for d in diags)
+
+
+# ---------------------------------------------------------------------------
+# CLI: --json schema_version, exit codes, baseline ratchet
+# ---------------------------------------------------------------------------
+
+_VIOLATION_SRC = """
+import jax
+
+@jax.jit
+def step(x):
+    return x.item()
+"""
+
+
+def test_json_report_has_stable_schema_version(tmp_path):
+    path = tmp_path / "clean.py"
+    path.write_text("x = 1\n")
+    out = tmp_path / "report.json"
+    assert dslint_main([str(path), "--json", str(out)]) == 0
+    report = json.loads(out.read_text())
+    from deepspeed_tpu.tools.dslint.cli import JSON_SCHEMA_VERSION
+
+    assert report["schema_version"] == JSON_SCHEMA_VERSION == 1
+    assert report["violations"] == 0
+    assert report["violations_by_family"] == {}
+    assert report["suppressed_by_family"] == {}
+    assert report["baselined"] == 0
+
+
+def test_json_report_per_family_counts(tmp_path):
+    (tmp_path / "bad.py").write_text(_VIOLATION_SRC)
+    (tmp_path / "sup.py").write_text("""
+def probe():
+    try:
+        risky()
+    except Exception:  # dslint: disable=DSE502 -- optional probe
+        pass
+""")
+    out = tmp_path / "report.json"
+    assert dslint_main([str(tmp_path), "--json", str(out)]) == 1
+    report = json.loads(out.read_text())
+    assert report["violations_by_family"] == {"DSH1": 1}
+    assert report["suppressed_by_family"] == {"DSE5": 1}
+
+
+def test_cli_exit_2_on_non_utf8_source(tmp_path, capsys):
+    """An unreadable/non-UTF8 source file is a usage error (exit 2),
+    never a traceback."""
+    bad = tmp_path / "latin.py"
+    bad.write_bytes(b"# caf\xe9\nx = 1\n")       # invalid UTF-8
+    assert dslint_main([str(bad)]) == 2
+    err = capsys.readouterr().err
+    assert "cannot read" in err and "latin.py" in err
+    # the API surface raises the typed error rather than crashing
+    from deepspeed_tpu.tools.dslint import SourceReadError, lint_paths as lp
+
+    with pytest.raises(SourceReadError):
+        lp([str(bad)])
+
+
+def test_cli_exit_2_on_unreadable_file(tmp_path, capsys):
+    import os
+    import stat
+
+    locked = tmp_path / "locked.py"
+    locked.write_text("x = 1\n")
+    locked.chmod(0)
+    if os.access(str(locked), os.R_OK):      # running as root: chmod 0
+        locked.chmod(stat.S_IWUSR)           # is a no-op; skip gracefully
+        if os.access(str(locked), os.R_OK):
+            pytest.skip("cannot make file unreadable (running as root)")
+    try:
+        assert dslint_main([str(locked)]) == 2
+        assert "cannot read" in capsys.readouterr().err
+    finally:
+        locked.chmod(stat.S_IRUSR | stat.S_IWUSR)
+
+
+def test_baseline_ratchet_fails_only_new_violations(tmp_path, capsys):
+    """The satellite contract: known violations recorded in the
+    checked-in baseline stop failing CI; only NEW ones do."""
+    src = tmp_path / "legacy.py"
+    src.write_text(_VIOLATION_SRC)
+    baseline = tmp_path / "baseline.json"
+
+    # record the current state: exit 0, violations captured
+    assert dslint_main([str(src), "--baseline", str(baseline),
+                        "--update-baseline"]) == 0
+    data = json.loads(baseline.read_text())
+    assert data["schema_version"] == 1
+    assert len(data["violations"]) == 1
+    assert all(v == 1 for v in data["violations"].values())
+
+    # unchanged tree: baselined, exit 0
+    assert dslint_main([str(src), "--baseline", str(baseline)]) == 0
+    assert "1 baselined" in capsys.readouterr().out
+
+    # a NEW violation (even of an already-baselined rule) fails
+    src.write_text(_VIOLATION_SRC + """
+
+@jax.jit
+def second(x):
+    return x.tolist()
+""")
+    assert dslint_main([str(src), "--baseline", str(baseline)]) == 1
+    out = capsys.readouterr().out
+    assert "1 violation(s), 0 suppressed, 1 baselined" in out
+
+    # fixing the legacy violation keeps passing (stale baseline entries
+    # are inert, not errors)
+    src.write_text("x = 1\n")
+    assert dslint_main([str(src), "--baseline", str(baseline)]) == 0
+
+
+def test_baseline_missing_file_exits_2(tmp_path, capsys):
+    src = tmp_path / "a.py"
+    src.write_text("x = 1\n")
+    assert dslint_main([str(src), "--baseline",
+                        str(tmp_path / "nope.json")]) == 2
+    assert "baseline" in capsys.readouterr().err
+    # --update-baseline without --baseline is a usage error too
+    assert dslint_main([str(src), "--update-baseline"]) == 2
+
+
+def test_baseline_counts_are_multisets(tmp_path):
+    """Two identical-message violations at different lines: baselining
+    one occurrence must not absolve the second."""
+    from deepspeed_tpu.tools.dslint.cli import (apply_baseline,
+                                                baseline_key,
+                                                load_baseline,
+                                                write_baseline)
+    from deepspeed_tpu.tools.dslint import lint_paths as lp
+
+    src = tmp_path / "dup.py"
+    src.write_text(_VIOLATION_SRC)
+    one = failing(lp([str(src)]))
+    assert len(one) == 1
+    path = tmp_path / "b.json"
+    write_baseline(path, one)
+    base = load_baseline(path)
+    new, baselined = apply_baseline(one + one, base)   # second instance
+    assert baselined == 1 and len(new) == 1
+    assert baseline_key(new[0]) == baseline_key(one[0])
+
+
+def test_baseline_malformed_file_exits_2(tmp_path, capsys):
+    src = tmp_path / "a.py"
+    src.write_text("x = 1\n")
+    bad = tmp_path / "b.json"
+    bad.write_text('{"schema_version": 1, "violations": [1, 2]}')
+    assert dslint_main([str(src), "--baseline", str(bad)]) == 2
+    assert "must be an object" in capsys.readouterr().err
+    bad.write_text('{"violations": {"k": null}}')
+    assert dslint_main([str(src), "--baseline", str(bad)]) == 2
+    assert "integers" in capsys.readouterr().err

@@ -6,19 +6,30 @@ unsuppressed hot-path sync, retrace hazard, or dead config key fails the
 suite with the exact file:line diagnostics in the assertion message.
 """
 
+import collections
 import os
 
 import deepspeed_tpu
-from deepspeed_tpu.tools.dslint import failing, lint_paths
+from deepspeed_tpu.tools.dslint import failing, lint_paths, rule_family
 
 PKG_DIR = os.path.dirname(os.path.abspath(deepspeed_tpu.__file__))
 
-# Every suppression in the tree is an explicit, reasoned pragma; this
-# budget keeps "add a pragma" from becoming the path of least resistance.
-# Raise it only with a `-- reason` on the new pragma line.
-# (raised 8 -> 14 with the DSE5xx swallowed-failure rules: 7 pre-existing
-# optional-probe `except Exception: pass` sites got reasoned pragmas)
-MAX_SUPPRESSIONS = 14
+# Every suppression in the tree is an explicit, reasoned pragma; these
+# budgets keep "add a pragma" from becoming the path of least
+# resistance.  Raise one only with a `-- reason` on the new pragma
+# line.  Per-FAMILY since round 10 (the old global 13-of-14 budget let
+# one family silently consume another's headroom); the same per-family
+# counts are reported by `dslint --json` as suppressed_by_family.
+# Current usage: DSC4 1, DSH1 2, DSH2 3, DSE5 7 = 13.
+FAMILY_BUDGETS = {
+    "DSC4": 1,   # config dead-key (wired-by-reference constant)
+    "DSH1": 2,   # partial-bound static casts
+    "DSH2": 4,   # print-cadence driver fetches (1 spare for the class)
+    "DSE5": 7,   # optional-backend probes
+    "DSP6": 0,   # program verifier: NO pragma budget — ratchet via
+                 # --baseline or fix the program
+}
+MAX_SUPPRESSIONS = sum(FAMILY_BUDGETS.values())
 ALLOWED_SUPPRESSED_RULES = {"DSC401", "DSH102", "DSH202", "DSH203",
                             "DSE502"}
 
@@ -39,8 +50,15 @@ def test_package_is_dslint_clean():
 def test_suppression_budget():
     suppressed = [d for d in _diags() if d.suppressed]
     listing = "\n".join(d.format() for d in suppressed)
+    by_family = collections.Counter(rule_family(d.rule_id)
+                                    for d in suppressed)
+    for family, count in sorted(by_family.items()):
+        budget = FAMILY_BUDGETS.get(family, 0)
+        assert count <= budget, (
+            f"suppression budget for {family}xx exceeded ({count} > "
+            f"{budget}):\n{listing}")
     assert len(suppressed) <= MAX_SUPPRESSIONS, (
-        f"suppression budget exceeded ({len(suppressed)} > "
+        f"total suppression budget exceeded ({len(suppressed)} > "
         f"{MAX_SUPPRESSIONS}):\n{listing}")
     stray = {d.rule_id for d in suppressed} - ALLOWED_SUPPRESSED_RULES
     assert not stray, (
@@ -53,6 +71,26 @@ def test_cli_exit_zero_on_shipped_tree():
     from deepspeed_tpu.tools.dslint.cli import main
 
     assert main([PKG_DIR]) == 0
+
+
+def test_checked_in_baseline_is_empty_and_tree_passes_ratchet():
+    """The shipped ratchet file (tools/dslint_baseline.json) records
+    ZERO violations — the tree is clean, and any new violation fails
+    CI through the baseline path exactly as without it."""
+    import json
+
+    from deepspeed_tpu.tools.dslint.cli import main
+
+    baseline = os.path.join(os.path.dirname(PKG_DIR), "tools",
+                            "dslint_baseline.json")
+    assert os.path.isfile(baseline)
+    data = json.load(open(baseline, encoding="utf-8"))
+    assert data["schema_version"] == 1
+    assert data["violations"] == {}, (
+        "the checked-in dslint baseline must stay empty: fix or "
+        "pragma new violations instead of baselining them (the "
+        "ratchet file exists for downstream forks)")
+    assert main([PKG_DIR, "--baseline", baseline]) == 0
 
 
 def test_telemetry_package_is_hotpath_clean():

@@ -313,7 +313,7 @@ def test_engine_zero_added_host_syncs(cpu_devices, tmp_path, monkeypatch):
 
     batches = random_batches(4, 16, HIDDEN, seed=0)
 
-    def count_gets(config):
+    def count_gets(config, after=None):
         engine = make_engine(config, cpu_devices)
         counts = {"n": 0}
         real_get = jax.device_get
@@ -325,6 +325,8 @@ def test_engine_zero_added_host_syncs(cpu_devices, tmp_path, monkeypatch):
         monkeypatch.setattr(jax, "device_get", counting_get)
         try:
             run_steps(engine, batches)
+            if after is not None:
+                after(engine)
         finally:
             monkeypatch.setattr(jax, "device_get", real_get)
         engine.close()
@@ -359,6 +361,24 @@ def test_engine_zero_added_host_syncs(cpu_devices, tmp_path, monkeypatch):
                    "comm_ledger": True}))
     assert comm == base, (f"comm observability added host syncs: {comm} "
                           f"device_get calls vs {base} baseline")
+
+    # program verification on top (DSP6xx, profiling/verify): the
+    # artifact dump happens at the ledger's one compile-time recording
+    # and verify_programs() re-reads compile-time artifacts — running
+    # it INSIDE the counted window must still add ZERO device_get calls
+    def verify(engine):
+        report = engine.verify_programs()
+        assert report is not None and report["violations"] == 0, (
+            [d.format() for d in report["diagnostics"]])
+
+    ver = count_gets(tel_config(
+        tmp_path / "v", trace=True,
+        resilience=resilience,
+        profiling={"memory_ledger": True, "memory_watermarks": True,
+                   "comm_ledger": True, "program_dump": True}),
+        after=verify)
+    assert ver == base, (f"program verification added host syncs: {ver} "
+                         f"device_get calls vs {base} baseline")
 
 
 def test_engine_step_metrics_and_monitor_preserved(cpu_devices, tmp_path):
