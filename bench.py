@@ -135,6 +135,16 @@ def comm_receipts(record, engine, prefix=None):
         wire = engine.comm_wire_bytes_per_step()
         if wire is not None:
             record[tag("comm_wire_bytes_per_step")] = int(wire)
+        # overlap receipts (round 11, profiling/overlap): how much of
+        # the predicted wire the compiled schedules actually expose as
+        # step latency — the metric the overlapped-streaming work must
+        # drive down, with bench_diff gating regressions
+        ov = engine.overlap_receipt()
+        if ov is not None:
+            record[tag("exposed_wire_seconds")] = float(
+                ov["exposed_wire_seconds"])
+            record[tag("overlap_fraction")] = float(
+                ov["overlap_fraction"])
     except Exception as e:  # pragma: no cover - receipts never gate rows
         print(f"bench: comm receipts unavailable: {e!r:.200}",
               file=sys.stderr)

@@ -5,10 +5,11 @@ from .config import DeepSpeedFlopsProfilerConfig, DeepSpeedProfilingConfig
 from .flops_profiler import (FlopsProfiler, count_fn_flops, get_model_profile)
 from .memory import (HostBufferRegistry, MemoryLedger, device_memory_summary,
                      see_memory_usage)
+from .overlap import analyze_hlo, parse_hlo_transfers, transfer_summary
 from .step_profiler import (model_scope_breakdown, timed_loop, timed_scan,
                             wall_breakdown)
 from .utilization import (DEFAULT_PEAK_TFLOPS, PEAK_TFLOPS, chip_peak_tflops,
-                          model_flops_utilization)
+                          chip_specs, model_flops_utilization)
 
 __all__ = ["CommLedger", "collective_summary", "parse_hlo_collectives",
            "predicted_wire_bytes", "publish_rank_latency",
@@ -18,5 +19,6 @@ __all__ = ["CommLedger", "collective_summary", "parse_hlo_collectives",
            "wall_breakdown", "model_scope_breakdown", "timed_loop",
            "timed_scan", "MemoryLedger", "HostBufferRegistry",
            "device_memory_summary", "see_memory_usage", "PEAK_TFLOPS",
-           "DEFAULT_PEAK_TFLOPS", "chip_peak_tflops",
-           "model_flops_utilization"]
+           "DEFAULT_PEAK_TFLOPS", "chip_peak_tflops", "chip_specs",
+           "model_flops_utilization", "analyze_hlo",
+           "parse_hlo_transfers", "transfer_summary"]

@@ -140,6 +140,17 @@ def plan(config, model, sample_batch, mesh=None, capacity_bytes=None,
             "dsp_findings": ([d.format() for d in verify["diagnostics"]
                               if not d.suppressed]
                              if verify is not None else []),
+            # static overlap verdict (profiling/overlap, DSO7xx): the
+            # plan states not just whether the step fits but how much
+            # of its predicted wire is exposed as latency
+            "exposed_wire_seconds": (
+                verify["overlap"]["exposed_wire_seconds"]
+                if verify is not None and verify.get("overlap")
+                else None),
+            "overlap_fraction": (
+                verify["overlap"]["overlap_fraction"]
+                if verify is not None and verify.get("overlap")
+                else None),
             "predicted_peak_hbm_bytes": predicted_peak_bytes(entry),
             "predicted_temp_bytes": (entry or {}).get("temp_size_in_bytes"),
             "argument_bytes": (entry or {}).get("argument_size_in_bytes"),
@@ -350,6 +361,10 @@ def _print_report(r):
         print(f"  program verify ....... {verdict}{extra}")
         for line in r.get("dsp_findings") or []:
             print(f"    {line}")
+    if r.get("exposed_wire_seconds") is not None:
+        print(f"  exposed wire ......... "
+              f"{r['exposed_wire_seconds'] * 1e3:.3f} ms/step "
+              f"(overlap fraction {r['overlap_fraction']:.2f})")
     print(f"  device capacity ...... {_fmt_bytes(r['capacity_bytes'])} "
           f"(headroom {r['headroom']:.2f})")
     if r["fit"] is None:

@@ -237,11 +237,43 @@ class CommLedger:
         # {axis: size} context recorded into every program event so a
         # reader can tell dp=8 apart from dp=2 without the engine config
         self.mesh_axes = dict(mesh_axes or {})
+        # optional callable -> {"host_state_wire_bytes", "device_kind"}:
+        # the engine's program_verify_context, resolved lazily at record
+        # time (the declared offload stream is only final after
+        # _build_step_functions) — feeds the overlap analysis
+        self.overlap_context_fn = None
         self._lock = threading.Lock()
         self._entries = {}
 
+    def _overlap_entry(self, name, hlo, n_devices):
+        """Static overlap/critical-path summary for one program
+        (profiling/overlap); None on any failure — observability must
+        never take a compile down."""
+        try:
+            from . import overlap as overlap_prof
+
+            ctx = {}
+            if self.overlap_context_fn is not None:
+                try:
+                    ctx = self.overlap_context_fn() or {}
+                except Exception as e:
+                    logger.debug("comm ledger: overlap context "
+                                 "unavailable: %s", e)
+            declared = (int(ctx.get("host_state_wire_bytes") or 0)
+                        if str(name) in overlap_prof.UPDATE_PROGRAMS
+                        else 0)
+            return overlap_prof.analyze_hlo(
+                hlo, total_devices=n_devices,
+                device_kind=ctx.get("device_kind") or "",
+                declared_host_wire_bytes=declared)
+        except Exception as e:  # pragma: no cover - fail-soft by design
+            logger.debug("comm ledger: overlap analysis failed for %r: "
+                         "%s", name, e)
+            return None
+
     def record(self, name, compiled):
-        """Record one compiled executable's collectives (fail-soft)."""
+        """Record one compiled executable's collectives, host/p2p
+        transfers, and overlap analysis (fail-soft)."""
         if not self.enabled:
             return None
         try:
@@ -257,6 +289,21 @@ class CommLedger:
             n_devices *= size
         entry = collective_summary(parse_hlo_collectives(
             hlo, all_participants=n_devices))
+        # host-transfer accounting (copy-start/send/recv — the offload
+        # DMA ops, previously invisible to the ledger) + the overlap
+        # summary.  The transfer fields derive from the overlap
+        # analysis' own node set when available — ONE classification,
+        # so the entry fields and the declared-residual subtraction
+        # can never disagree; the standalone parser is the fallback
+        from . import overlap as overlap_prof
+
+        overlap_entry = self._overlap_entry(name, hlo, n_devices)
+        if overlap_entry is not None:
+            entry.update(overlap_entry["hlo_transfer_summary"])
+            entry["overlap"] = overlap_entry
+        else:
+            entry.update(overlap_prof.transfer_summary(
+                overlap_prof.parse_hlo_transfers(hlo)))
         with self._lock:
             self._entries[str(name)] = json.loads(json.dumps(entry))
             n_programs = len(self._entries)
@@ -266,9 +313,16 @@ class CommLedger:
 
             tel.emit(TEL.EVENT_COMM, kind=KIND_PROGRAM, program=str(name),
                      mesh=self.mesh_axes, **entry)
-            for field in ("collectives", "payload_bytes", "wire_bytes"):
+            for field in ("collectives", "payload_bytes", "wire_bytes",
+                          "host_transfer_bytes"):
                 tel.gauge(f"comm/program/{name}/{field}").set(
                     float(entry[field]))
+            if overlap_entry is not None:
+                tel.gauge(f"comm/program/{name}/exposed_wire_seconds"
+                          ).set(float(
+                              overlap_entry["exposed_wire_seconds"]))
+                tel.gauge(f"comm/program/{name}/overlap_fraction").set(
+                    float(overlap_entry["overlap_fraction"]))
             tel.gauge("comm/programs").set(float(n_programs))
         return entry
 
@@ -330,6 +384,43 @@ class CommLedger:
         :meth:`step_entry`); None when nothing has compiled yet."""
         e = self.step_entry(grad_accumulation_steps, prefer=prefer)
         return e["wire_bytes"] if e else None
+
+    def step_overlap(self, grad_accumulation_steps=1, prefer=None):
+        """``{program, wire_seconds, exposed_wire_seconds,
+        overlap_fraction}`` for ONE optimizer step, from the recorded
+        per-program overlap analyses (same fused-else-stepwise
+        resolution as :meth:`step_entry`).  None until a program with
+        an overlap summary has compiled."""
+        fused_order = ("train_step", "train_step_compressed")
+        if prefer is not None:
+            fused_order = (prefer,) + tuple(f for f in fused_order
+                                            if f != prefer)
+        for fused in fused_order:
+            e = self.entry(fused)
+            if e is not None and e.get("overlap"):
+                ov = e["overlap"]
+                return {"program": fused,
+                        "wire_seconds": ov["wire_seconds"],
+                        "exposed_wire_seconds":
+                            ov["exposed_wire_seconds"],
+                        "overlap_fraction": ov["overlap_fraction"]}
+        acc = max(int(grad_accumulation_steps), 1)
+        weights = {"fwd_bwd": acc, "accum": acc - 1, "apply_update": 1,
+                   "cast_params": 1}
+        wire = exposed = 0.0
+        seen = False
+        for name, mult in weights.items():
+            e = self.entry(name)
+            if e is not None and e.get("overlap") and mult > 0:
+                seen = True
+                wire += e["overlap"]["wire_seconds"] * mult
+                exposed += e["overlap"]["exposed_wire_seconds"] * mult
+        if not seen:
+            return None
+        return {"program": "stepwise", "wire_seconds": wire,
+                "exposed_wire_seconds": exposed,
+                "overlap_fraction": (1.0 - exposed / wire) if wire > 0
+                else 1.0}
 
 
 # ---------------------------------------------------------------------------

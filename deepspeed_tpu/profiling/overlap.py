@@ -1,0 +1,668 @@
+"""Static overlap / critical-path analysis over compiled HLO (DSO7xx).
+
+The comm and memory ledgers (PRs 7–8) price each compiled program's
+wire bytes and HBM footprint; this module answers the *scheduling*
+question the ledgers leave open: **which of those wire seconds are
+exposed** — paid as step latency — and which are hidden behind
+concurrent compute?  The reference's overlap machinery (ZeRO-Offload's
+delayed parameter update, the pipeline engine's interleaved
+comm/compute schedules) only pays off when overlap actually
+materializes in the compiled program, and post-scheduling HLO makes
+that statically decidable:
+
+- a **sync collective** (``all-reduce`` with no ``-start/-done`` split)
+  blocks its dependents by construction — its wire seconds are fully
+  exposed, however much independent compute sits in the program;
+- an **async pair** (``all-reduce-start``/``-done``,
+  ``copy-start``/``copy-done``, ``send``/``recv``) hides wire behind
+  whatever compute the scheduler placed between issue and completion
+  (``is_scheduled=true`` modules print in schedule order, so "between"
+  is the text order);
+- the **streamed-offload host round trips** run *outside* any single
+  program (device_put/device_get between dispatches), so the engine's
+  own wire accounting (``host_state_bytes_per_step``) declares them —
+  and absent async copy machinery in the update program they are
+  serialized by construction (PERF.md's ~2× offload-tax accounting,
+  now a per-program receipt instead of prose).
+
+Per program this module computes: an instruction dependency graph
+(extending the PR 8 collective parser with ``copy-start/copy-done``,
+``send/recv`` and async ``-start/-done`` pairs), roofline node costs
+(flops vs bytes over the chip tables in :mod:`.utilization`), the
+**critical-path seconds**, a per-collective / per-transfer **overlap
+classification** (``overlapped`` / ``partially_exposed`` /
+``serialized``, each with the concurrent-compute window that could
+hide it), and the ``exposed_wire_seconds`` / ``overlap_fraction``
+summary the DSO7xx dslint rules, ``engine.verify_programs()``, the
+capacity planner, and the bench receipts all quote.
+
+Everything is a pure function of the HLO text plus static chip tables:
+stdlib + regex only, zero device work — analysis happens at compile
+(record) time or offline, never on the step path.  Costs are a *model*
+(ring wire formulas, roofline min-bounds, while-body trip counts from
+``known_trip_count`` when the backend prints them); the point is the
+classification and the ratchetable exposure metric, not nanosecond
+truth.
+"""
+
+import dataclasses
+import re
+from typing import Dict, List, Optional
+
+from . import comm as comm_prof
+from .utilization import chip_specs
+
+OVERLAP_SCHEMA_VERSION = 1
+
+# programs whose dispatch performs the offloaded optimizer update: the
+# engine's DECLARED host-state stream (host_state_bytes_per_step —
+# round trips that happen between dispatches, invisible in any one
+# program's HLO) attaches to these and only these
+UPDATE_PROGRAMS = ("train_step", "train_step_compressed", "apply_update")
+
+# overlap classifications (per comm/transfer node)
+OVERLAPPED = "overlapped"
+PARTIAL = "partially_exposed"
+SERIALIZED = "serialized"
+
+# a node counts as fully overlapped when >= 95% of its wire seconds are
+# hidden (scheduling jitter makes exact equality meaningless)
+OVERLAP_SLACK = 0.05
+
+# DSO701 fires only when a fully serialized collective has at least
+# this much independent compute available to hide it — micro-programs
+# (CPU-mesh CI runs, tiny fixtures) have nothing to overlap WITH, and
+# flagging them would be noise
+DSO701_MIN_WINDOW_SECONDS = 1e-3
+
+# ancestor/descendant reachability is O(N^2/64) bitset work; beyond
+# this instruction count the independent-compute windows degrade to
+# "unknown" (None) rather than stalling a compile-time hook
+MAX_WINDOW_INSTRUCTIONS = 20000
+
+# instruction kinds carrying wire cost
+KIND_COLLECTIVE = "collective"
+KIND_HOST = "host_transfer"
+KIND_P2P = "p2p_transfer"
+
+# ops that route/alias but execute in ~zero time
+_FREE_OPS = frozenset((
+    "parameter", "constant", "tuple", "get-tuple-element", "bitcast",
+    "after-all", "partition-id", "replica-id",
+))
+
+# one instruction: ``[ROOT] %name = <result type> <op>(operands)attrs``
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%(?P<name>[\w.\-]+)\s*=\s*(?P<rest>.+)$")
+# first op token followed by an opening paren (the result type never
+# contains ``word(``: shapes are ``f32[2,3]{1,0}`` and tuple types wrap
+# shapes in parens without call syntax)
+_OP_TOKEN_RE = re.compile(r"(?:^|\s)(?P<op>[a-z][a-z0-9\-]*)\(")
+# computation header: ``[ENTRY] %name (params) -> type {``
+_COMP_RE = re.compile(
+    r"^(?P<entry>ENTRY\s+)?%?(?P<name>[\w.\-]+)\s*\(.*->.*\{\s*$")
+_OPERAND_NAME_RE = re.compile(r"%(?P<name>[\w.\-]+)")
+_CALLED_RE = re.compile(
+    r"(?:calls|to_apply|condition|body)=%?(?P<name>[\w.\-]+)")
+_BRANCHES_RE = re.compile(r"branch_computations=\{(?P<names>[^}]*)\}")
+_TRIP_COUNT_RE = re.compile(r'"known_trip_count":\{"n":"(?P<n>\d+)"\}')
+_CONTRACT_RE = re.compile(r"lhs_contracting_dims=\{(?P<dims>[0-9,]*)\}")
+
+
+@dataclasses.dataclass
+class Instruction:
+    name: str
+    op: str
+    outs: str          # result type text
+    operands: str      # text between the op's parens
+    attrs: str         # text after the operand close paren
+    line: str
+    index: int
+
+    @property
+    def is_start(self) -> bool:
+        return self.op.endswith("-start") or self.op in ("send", "recv")
+
+    @property
+    def is_done(self) -> bool:
+        return self.op.endswith("-done")
+
+
+@dataclasses.dataclass
+class Computation:
+    name: str
+    is_entry: bool
+    instructions: List[Instruction]
+
+    def __post_init__(self):
+        self.by_name = {i.name: i for i in self.instructions}
+
+
+def parse_hlo_computations(hlo_text: str):
+    """``(computations, entry_name, scheduled)`` from one HLO module
+    dump.  ``entry_name`` falls back to the last computation when no
+    ENTRY marker is present (hand-written fixtures)."""
+    comps: Dict[str, Computation] = {}
+    entry_name = None
+    current = None
+    for raw in hlo_text.splitlines():
+        line = raw.rstrip()
+        if current is None:
+            m = _COMP_RE.match(line.strip())
+            if m and "=" not in line.split("(", 1)[0]:
+                current = Computation(name=m.group("name"),
+                                      is_entry=bool(m.group("entry")),
+                                      instructions=[])
+            continue
+        if line.strip() == "}":
+            current.__post_init__()
+            comps[current.name] = current
+            if current.is_entry:
+                entry_name = current.name
+            current = None
+            continue
+        m = _INSTR_RE.match(line)
+        if m is None:
+            continue
+        rest = m.group("rest")
+        om = _OP_TOKEN_RE.search(rest)
+        if om is None:
+            continue
+        op = om.group("op")
+        outs = rest[:om.start()].strip()
+        # operand region: from the op's paren to its matching close
+        depth = 0
+        start = om.end() - 1
+        end = len(rest)
+        for i in range(start, len(rest)):
+            if rest[i] == "(":
+                depth += 1
+            elif rest[i] == ")":
+                depth -= 1
+                if depth == 0:
+                    end = i
+                    break
+        current.instructions.append(Instruction(
+            name=m.group("name"), op=op, outs=outs,
+            operands=rest[start + 1:end], attrs=rest[end + 1:],
+            line=line, index=len(current.instructions)))
+    if entry_name is None and comps:
+        entry_name = list(comps)[-1]
+    scheduled = "is_scheduled=true" in hlo_text.split("\n", 1)[0]
+    return comps, entry_name, scheduled
+
+
+# ---------------------------------------------------------------------------
+# host/p2p transfer parsing (the CommLedger satellite shares these)
+# ---------------------------------------------------------------------------
+
+# ``copy-start`` = an async copy; with a host memory-space annotation
+# (``S(5)`` on TPU lowerings) it is a host<->device DMA.  ``send/recv``
+# carry ``is_host_transfer=true`` for host streams, otherwise they are
+# point-to-point device wire (pipeline stages).  ``-done`` halves never
+# match (their ``-start``/``send``/``recv`` already counted).
+# the result-tuple alternative admits one nesting level: memory-space
+# layout annotations print parens inside the tuple (``{0:S(5)}``)
+_TRANSFER_RE = re.compile(
+    r"=\s*(?P<outs>\((?:[^()]|\([^()]*\))*\)|\S+)\s+"
+    r"(?P<op>copy-start|send|recv)\(")
+_HOST_SPACE_RE = re.compile(r"S\(5\)|is_host_transfer=true")
+
+
+def _largest_shape_bytes(text):
+    sizes = comm_prof._shape_bytes_list(text)
+    return max(sizes) if sizes else 0
+
+
+def parse_hlo_transfers(hlo_text: str):
+    """``[{op, bytes, host}]`` — one record per async transfer
+    instruction (``copy-start``, ``send``, ``recv``) in an HLO module
+    dump.  ``host`` marks host<->device transfers (host memory space
+    ``S(5)`` or ``is_host_transfer=true``); the rest are device
+    point-to-point wire.  Payload bytes are the LARGEST typed shape on
+    the instruction (async results are bookkeeping tuples of operand
+    alias + payload + context — summing would double-count)."""
+    out = []
+    for line in hlo_text.splitlines():
+        m = _TRANSFER_RE.search(line)
+        if m is None:
+            continue
+        n = _largest_shape_bytes(line)
+        out.append({"op": m.group("op"), "bytes": n,
+                    "host": bool(_HOST_SPACE_RE.search(line))})
+    return out
+
+
+def transfer_summary(transfers):
+    """Aggregate parsed transfers into ledger-entry fields::
+
+        {"host_transfers": N, "host_transfer_bytes": ...,
+         "p2p_transfers": N, "p2p_transfer_bytes": ...}
+
+    ``copy-start`` without a host memory space is a device-local async
+    copy — neither bucket (it moves HBM, not wire)."""
+    out = {"host_transfers": 0, "host_transfer_bytes": 0,
+           "p2p_transfers": 0, "p2p_transfer_bytes": 0}
+    for rec in transfers:
+        if rec["host"]:
+            out["host_transfers"] += 1
+            out["host_transfer_bytes"] += rec["bytes"]
+        elif rec["op"] in ("send", "recv"):
+            out["p2p_transfers"] += 1
+            out["p2p_transfer_bytes"] += rec["bytes"]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# roofline cost model
+# ---------------------------------------------------------------------------
+
+def _shape_elems(dims_text):
+    n = 1
+    for d in dims_text.split(","):
+        if d:
+            n *= int(d)
+    return n
+
+
+def _result_elems(outs):
+    total = 0
+    for m in comm_prof._SHAPE_RE.finditer(outs):
+        total += _shape_elems(m.group("dims"))
+    return total
+
+
+def _dot_flops(ins):
+    """2 * output elements * contracted extent, from the printed lhs
+    shape + ``lhs_contracting_dims``; 0 when either is unparseable."""
+    cm = _CONTRACT_RE.search(ins.attrs)
+    lhs = comm_prof._SHAPE_RE.search(ins.operands)
+    if cm is None or lhs is None:
+        return 0
+    dims = [int(x) for x in lhs.group("dims").split(",") if x]
+    contracted = 1
+    for i in (int(x) for x in cm.group("dims").split(",") if x):
+        if i < len(dims):
+            contracted *= dims[i]
+    return 2 * _result_elems(ins.outs) * contracted
+
+
+def _io_bytes(ins):
+    sizes = comm_prof._shape_bytes_list(ins.operands)
+    return sum(sizes) + sum(comm_prof._shape_bytes_list(ins.outs))
+
+
+def _compute_cost(ins, specs, metrics):
+    """Roofline seconds for one non-comm instruction: the larger of its
+    flop time and its HBM-traffic time.  Call-like ops charge their
+    callee (while bodies multiplied by ``known_trip_count`` when the
+    backend printed one)."""
+    op = ins.op
+    if op in _FREE_OPS:
+        return 0.0
+    peak_flops = specs["peak_tflops"] * 1e12
+    hbm_bps = specs["hbm_gbps"] * 1e9
+    if op == "fusion":
+        m = _CALLED_RE.search(ins.attrs)
+        flops = metrics.get(m.group("name"), {}).get("flops", 0) if m else 0
+        return max(flops / peak_flops, _io_bytes(ins) / hbm_bps)
+    if op in ("call", "map"):
+        m = _CALLED_RE.search(ins.attrs)
+        return metrics.get(m.group("name"), {}).get("cp", 0.0) if m else 0.0
+    if op == "while":
+        trips = 1
+        tm = _TRIP_COUNT_RE.search(ins.attrs)
+        if tm:
+            trips = max(int(tm.group("n")), 1)
+        total = 0.0
+        for cm in _CALLED_RE.finditer(ins.attrs):
+            total += metrics.get(cm.group("name"), {}).get("cp", 0.0)
+        return total * trips
+    if op == "conditional":
+        bm = _BRANCHES_RE.search(ins.attrs)
+        if bm:
+            branches = _OPERAND_NAME_RE.findall(bm.group("names"))
+            return max([metrics.get(b, {}).get("cp", 0.0)
+                        for b in branches] or [0.0])
+        return 0.0
+    if op == "dot":
+        return max(_dot_flops(ins) / peak_flops, _io_bytes(ins) / hbm_bps)
+    # element-wise / reductions / custom-calls: bytes dominate; charge
+    # one flop per output element so pure-compute fixtures stay ordered
+    return max(_result_elems(ins.outs) / peak_flops,
+               _io_bytes(ins) / hbm_bps)
+
+
+def _instr_flops(ins, metrics):
+    """Flop count of one instruction (for fusion-body totals)."""
+    if ins.op == "dot":
+        return _dot_flops(ins)
+    if ins.op in ("fusion", "call", "map", "while", "conditional"):
+        total = 0
+        for cm in _CALLED_RE.finditer(ins.attrs):
+            total += metrics.get(cm.group("name"), {}).get("flops", 0)
+        bm = _BRANCHES_RE.search(ins.attrs)
+        if bm:
+            for b in _OPERAND_NAME_RE.findall(bm.group("names")):
+                total += metrics.get(b, {}).get("flops", 0)
+        tm = _TRIP_COUNT_RE.search(ins.attrs)
+        if tm:
+            total *= max(int(tm.group("n")), 1)
+        return total
+    if ins.op in _FREE_OPS:
+        return 0
+    return _result_elems(ins.outs)
+
+
+# ---------------------------------------------------------------------------
+# per-computation analysis
+# ---------------------------------------------------------------------------
+
+def _wire_node(ins, specs, total_devices):
+    """``(kind, wire_bytes, wire_seconds)`` when the instruction starts
+    (or IS, for sync forms) a wire transfer; None otherwise."""
+    base_op = ins.op[:-6] if ins.op.endswith("-start") else ins.op
+    if base_op in comm_prof.COLLECTIVE_OPS:
+        out_bytes = comm_prof._result_bytes(ins.outs,
+                                            ins.op.endswith("-start"))
+        group = comm_prof._group_size(ins.line, total_devices)
+        wire = comm_prof.predicted_wire_bytes(base_op, out_bytes, group)
+        return (KIND_COLLECTIVE, wire, wire / (specs["ici_gbps"] * 1e9))
+    host = bool(_HOST_SPACE_RE.search(ins.line))
+    if ins.op == "copy-start" and host:
+        n = _largest_shape_bytes(ins.line)
+        return (KIND_HOST, n, n / (specs["host_gbps"] * 1e9))
+    if ins.op in ("send", "recv"):
+        n = _largest_shape_bytes(ins.line)
+        if host:
+            return (KIND_HOST, n, n / (specs["host_gbps"] * 1e9))
+        return (KIND_P2P, n, n / (specs["ici_gbps"] * 1e9))
+    return None
+
+
+def _independent_compute(comp, costs, node_indices):
+    """{index: seconds of compute neither upstream nor downstream of
+    the instruction} for the requested indices, via ancestor/descendant
+    bitsets; None (unknown) past MAX_WINDOW_INSTRUCTIONS."""
+    n = len(comp.instructions)
+    if not node_indices:
+        return {}
+    if n > MAX_WINDOW_INSTRUCTIONS:
+        return {i: None for i in node_indices}
+    index_of = {ins.name: ins.index for ins in comp.instructions}
+    deps = []
+    for ins in comp.instructions:
+        deps.append([index_of[nm] for nm in
+                     _OPERAND_NAME_RE.findall(ins.operands)
+                     if nm in index_of])
+    anc = [0] * n
+    for i in range(n):
+        a = 0
+        for d in deps[i]:
+            a |= anc[d] | (1 << d)
+        anc[i] = a
+    desc = [0] * n
+    for i in range(n - 1, -1, -1):
+        di = desc[i] | (1 << i)
+        for d in deps[i]:
+            desc[d] |= di
+    total = sum(costs)
+    out = {}
+    for i in node_indices:
+        related = anc[i] | desc[i]
+        dependent = 0.0
+        j = 0
+        while related:
+            if related & 1:
+                dependent += costs[j]
+            related >>= 1
+            j += 1
+        out[i] = max(total - dependent, 0.0)
+    return out
+
+
+def _analyze_computation(comp, specs, metrics, total_devices, scheduled):
+    """One computation's ``{cp, compute, flops, nodes}``: critical-path
+    seconds (wire-aware), total roofline compute seconds, flop total,
+    and the classified wire nodes."""
+    finish: Dict[str, float] = {}
+    issue: Dict[str, float] = {}     # -start name -> issue time
+    pending: Dict[str, tuple] = {}   # -start name -> (kind, bytes, secs, idx)
+    costs = [0.0] * len(comp.instructions)
+    nodes = []
+    compute_total = 0.0
+    flops_total = 0
+    for ins in comp.instructions:
+        dep_t = 0.0
+        for nm in _OPERAND_NAME_RE.findall(ins.operands):
+            dep_t = max(dep_t, finish.get(nm, 0.0))
+        flops_total += _instr_flops(ins, metrics)
+        wire = _wire_node(ins, specs, total_devices)
+        if ins.is_done:
+            # completion of an async pair: no earlier than issue + wire
+            started = [nm for nm in _OPERAND_NAME_RE.findall(ins.operands)
+                       if nm in pending]
+            t = dep_t
+            for nm in started:
+                kind, wbytes, wsecs, sidx = pending.pop(nm)
+                t = max(t, issue.get(nm, 0.0) + wsecs)
+                if kind == "copy":
+                    # device-local async copy: HBM traffic, not wire —
+                    # neither bucket, and its latency is schedule-hidden
+                    # exactly like the wire pairs
+                    continue
+                # hidden window: compute scheduled between issue and
+                # completion that does not depend on the start
+                hidden = _async_hidden_window(comp, costs, sidx,
+                                              ins.index, scheduled)
+                nodes.append(_classify(ins_op=comp.instructions[sidx].op,
+                                       kind=kind, wire_bytes=wbytes,
+                                       seconds=wsecs, hidden=hidden,
+                                       window=hidden, index=sidx,
+                                       name=nm))
+            finish[ins.name] = t
+            continue
+        if wire is not None and ins.is_start:
+            issue[ins.name] = dep_t
+            pending[ins.name] = (wire[0], wire[1], wire[2], ins.index)
+            finish[ins.name] = dep_t  # issue is ~free
+            continue
+        if wire is not None:
+            # sync form: blocks inline, fully exposed by construction
+            kind, wbytes, wsecs = wire
+            costs[ins.index] = 0.0
+            nodes.append({"index": ins.index, "name": ins.name,
+                          "op": ins.op, "kind": kind,
+                          "wire_bytes": wbytes, "seconds": wsecs,
+                          "hidden_seconds": 0.0, "window_seconds": None,
+                          "classification": SERIALIZED, "source": "hlo"})
+            finish[ins.name] = dep_t + wsecs
+            continue
+        cost = _compute_cost(ins, specs, metrics)
+        if ins.op == "copy-start":
+            # device-local async copy: charge HBM time at completion
+            issue[ins.name] = dep_t
+            pending[ins.name] = ("copy", 0, _io_bytes(ins) /
+                                 (specs["hbm_gbps"] * 1e9), ins.index)
+            finish[ins.name] = dep_t
+            continue
+        costs[ins.index] = cost
+        compute_total += cost
+        finish[ins.name] = dep_t + cost
+    # any unmatched -start (malformed fixture): complete at the end
+    for nm, (kind, wbytes, wsecs, sidx) in pending.items():
+        if kind == "copy":
+            continue
+        nodes.append(_classify(ins_op=comp.instructions[sidx].op,
+                               kind=kind, wire_bytes=wbytes, seconds=wsecs,
+                               hidden=0.0, window=0.0, index=sidx, name=nm))
+    # available-but-unused windows for the serialized nodes: sync forms
+    # (window still None) and async pairs the scheduler left back-to-
+    # back (achieved window 0) both get the DAG-independence window —
+    # "what COULD have hidden this" is the DSO701/DSO702 message
+    ser_idx = [n["index"] for n in nodes
+               if n["classification"] == SERIALIZED and n["seconds"] > 0
+               and not n["window_seconds"]]
+    windows = _independent_compute(comp, costs, ser_idx)
+    for node in nodes:
+        if node["index"] in windows:
+            node["window_seconds"] = windows[node["index"]]
+    cp = max(finish.values(), default=0.0)
+    return {"cp": cp, "compute": compute_total, "flops": flops_total,
+            "nodes": nodes}
+
+
+def _async_hidden_window(comp, costs, start_idx, done_idx, scheduled):
+    """Compute seconds the scheduler placed between an async pair's
+    issue and completion that do NOT depend on the start — what
+    actually hides the wire.  Only meaningful for scheduled modules
+    (text order == schedule order); unscheduled fixtures get the same
+    slice-based estimate (the scheduler is free to realize it)."""
+    del scheduled  # same estimate either way; kept for the signature
+    start_name = comp.instructions[start_idx].name
+    depends = {start_name}
+    hidden = 0.0
+    for ins in comp.instructions[start_idx + 1:done_idx]:
+        names = set(_OPERAND_NAME_RE.findall(ins.operands))
+        if names & depends:
+            depends.add(ins.name)
+            continue
+        hidden += costs[ins.index]
+    return hidden
+
+
+def _classify(ins_op, kind, wire_bytes, seconds, hidden, window, index,
+              name, source="hlo"):
+    hidden = min(max(hidden, 0.0), seconds)
+    if seconds <= 0:
+        cls = OVERLAPPED
+    elif hidden >= seconds * (1.0 - OVERLAP_SLACK):
+        cls = OVERLAPPED
+    elif hidden > 0:
+        cls = PARTIAL
+    else:
+        cls = SERIALIZED
+    base_op = ins_op[:-6] if ins_op.endswith("-start") else ins_op
+    return {"index": index, "name": name, "op": base_op, "kind": kind,
+            "wire_bytes": wire_bytes, "seconds": seconds,
+            "hidden_seconds": hidden, "window_seconds": window,
+            "classification": cls, "source": source}
+
+
+# ---------------------------------------------------------------------------
+# whole-program analysis
+# ---------------------------------------------------------------------------
+
+def _bucket(nodes, kind):
+    sel = [n for n in nodes if n["kind"] == kind]
+    return {"total": len(sel),
+            "overlapped": sum(1 for n in sel
+                              if n["classification"] == OVERLAPPED),
+            "partially_exposed": sum(1 for n in sel
+                                     if n["classification"] == PARTIAL),
+            "serialized": sum(1 for n in sel
+                              if n["classification"] == SERIALIZED)}
+
+
+def analyze_hlo(hlo_text, total_devices=1, device_kind="",
+                declared_host_wire_bytes=0, max_nodes=32):
+    """Full overlap analysis of one compiled program.
+
+    ``max_nodes`` caps the emitted per-node list (telemetry events must
+    not balloon on collective-heavy programs; the bucket counts and
+    second totals always cover EVERY node).  Pass ``max_nodes=None``
+    for the untruncated list — the DSO7xx rule checks need every node,
+    not the first 32.
+
+    Returns the summary dict (schema below) or None when the text holds
+    no parseable computation.  ``declared_host_wire_bytes`` is the
+    engine-declared per-step host-state stream (see
+    :data:`UPDATE_PROGRAMS`); the portion not accounted for by HLO-level
+    transfer ops is modeled as one serialized host transfer whose
+    available window is the whole program's compute (the stream runs
+    between dispatches, serialized against all of it).
+
+    Known floor: wire nodes inside called computations (a collective in
+    a ``while`` body) enter the node list and wire totals ONCE, while
+    the critical path charges the body cost (wire included) times its
+    ``known_trip_count`` — per-iteration wire totals would need the
+    call-multiplicity product, which this model deliberately keeps
+    simple.  This repo's step programs emit collectives at entry level
+    (GSPMD), so the floor is theoretical today.
+
+    Summary::
+
+        {"overlap_schema_version", "device_kind", "scheduled",
+         "instructions", "critical_path_seconds", "compute_seconds",
+         "wire_seconds", "exposed_wire_seconds", "overlap_fraction",
+         "collectives": {total, overlapped, partially_exposed,
+                         serialized},
+         "host_transfers": {...}, "p2p_transfers": {...},
+         "nodes": [...], "nodes_truncated": N}
+    """
+    comps, entry_name, scheduled = parse_hlo_computations(hlo_text)
+    if not comps or entry_name is None:
+        return None
+    specs = chip_specs(device_kind)
+    metrics: Dict[str, dict] = {}
+    nodes = []
+    n_instructions = 0
+    # computations print callees-first; one pass memoizes cleanly
+    for name, comp in comps.items():
+        m = _analyze_computation(comp, specs, metrics, total_devices,
+                                 scheduled)
+        metrics[name] = m
+        nodes.extend(m["nodes"])
+        n_instructions += len(comp.instructions)
+    # program compute = the ENTRY computation's total: its call-like
+    # instruction costs already fold their callees in (fusion bodies,
+    # while cond+body x trip count) — summing every computation as well
+    # would double-count each called body
+    compute_total = metrics[entry_name]["compute"]
+    cp = metrics[entry_name]["cp"]
+    # HLO-visible transfer accounting over the SAME node set the
+    # residual subtraction below uses — the CommLedger's
+    # host_transfer_bytes entry fields derive from this (one
+    # classification, not two walks that can desync)
+    hlo_transfers = {
+        "host_transfers": sum(1 for n in nodes
+                              if n["kind"] == KIND_HOST),
+        "host_transfer_bytes": sum(n["wire_bytes"] for n in nodes
+                                   if n["kind"] == KIND_HOST),
+        "p2p_transfers": sum(1 for n in nodes
+                             if n["kind"] == KIND_P2P),
+        "p2p_transfer_bytes": sum(n["wire_bytes"] for n in nodes
+                                  if n["kind"] == KIND_P2P),
+    }
+    hlo_host_bytes = hlo_transfers["host_transfer_bytes"]
+    declared_residual = max(int(declared_host_wire_bytes or 0)
+                            - hlo_host_bytes, 0)
+    if declared_residual > 0:
+        secs = declared_residual / (specs["host_gbps"] * 1e9)
+        nodes.append({
+            "index": -1, "name": "<declared-host-stream>",
+            "op": "host-stream", "kind": KIND_HOST,
+            "wire_bytes": declared_residual, "seconds": secs,
+            "hidden_seconds": 0.0, "window_seconds": compute_total,
+            "classification": SERIALIZED, "source": "declared"})
+    wire = sum(n["seconds"] for n in nodes)
+    exposed = sum(n["seconds"] - n["hidden_seconds"] for n in nodes)
+    summary = {
+        "overlap_schema_version": OVERLAP_SCHEMA_VERSION,
+        "device_kind": specs["device_kind"],
+        "scheduled": scheduled,
+        "instructions": n_instructions,
+        "critical_path_seconds": cp,
+        "compute_seconds": compute_total,
+        "wire_seconds": wire,
+        "exposed_wire_seconds": exposed,
+        "overlap_fraction": (1.0 - exposed / wire) if wire > 0 else 1.0,
+        "collectives": _bucket(nodes, KIND_COLLECTIVE),
+        "host_transfers": _bucket(nodes, KIND_HOST),
+        "p2p_transfers": _bucket(nodes, KIND_P2P),
+        "hlo_transfer_summary": hlo_transfers,
+        "nodes": nodes if max_nodes is None else nodes[:max_nodes],
+        "nodes_truncated": (0 if max_nodes is None
+                            else max(len(nodes) - max_nodes, 0)),
+    }
+    return summary

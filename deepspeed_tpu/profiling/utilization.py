@@ -19,6 +19,49 @@ PEAK_TFLOPS = {
 # no-sync guard never false-fails a legitimately fast device.
 DEFAULT_PEAK_TFLOPS = 990.0
 
+# Bandwidth tables for the overlap analyzer's roofline/wire costing
+# (GB/s, by the same device_kind substrings as PEAK_TFLOPS).
+# ``hbm_gbps`` is stream bandwidth, ``ici_gbps`` one-direction per-link
+# interconnect — both conservative public figures, same spirit as the
+# peak-TFLOPs table.
+CHIP_BANDWIDTHS = {
+    "v5 lite": {"hbm_gbps": 819.0, "ici_gbps": 45.0},
+    "v5e": {"hbm_gbps": 819.0, "ici_gbps": 45.0},
+    "v4": {"hbm_gbps": 1228.0, "ici_gbps": 50.0},
+    "v5p": {"hbm_gbps": 2765.0, "ici_gbps": 90.0},
+    "v6": {"hbm_gbps": 1640.0, "ici_gbps": 90.0},
+}
+# Unknown chips assume fast links (small predicted windows/exposure:
+# the analyzer under-claims rather than inventing findings).
+DEFAULT_HBM_GBPS = 3000.0
+DEFAULT_ICI_GBPS = 100.0
+# host<->device DMA: ~14 GB/s effective measured on this attachment
+# (PERF.md "ZeRO-Offload wire bytes" accounting) — the one link whose
+# figure comes from this repo's own measurement, not a spec sheet
+DEFAULT_HOST_GBPS = 14.0
+
+
+def chip_specs(device_kind=""):
+    """Roofline/wire constants for one ``device_kind`` string:
+    ``{device_kind, peak_tflops, hbm_gbps, ici_gbps, host_gbps}``.
+    Unknown kinds (CPU test meshes included) get the fast defaults."""
+    kind = (device_kind or "").lower()
+    peak = DEFAULT_PEAK_TFLOPS
+    for key, val in PEAK_TFLOPS.items():
+        if key in kind:
+            peak = val
+            break
+    bw = {}
+    for key, val in CHIP_BANDWIDTHS.items():
+        if key in kind:
+            bw = val
+            break
+    return {"device_kind": device_kind or "",
+            "peak_tflops": peak,
+            "hbm_gbps": bw.get("hbm_gbps", DEFAULT_HBM_GBPS),
+            "ici_gbps": bw.get("ici_gbps", DEFAULT_ICI_GBPS),
+            "host_gbps": DEFAULT_HOST_GBPS}
+
 
 def chip_peak_tflops(device):
     """bf16 peak TFLOP/s for one jax device (by ``device_kind``)."""

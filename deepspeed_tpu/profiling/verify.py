@@ -41,6 +41,19 @@ def _donation_spec(engine, name):
     return tuple(spec) if spec else None
 
 
+def _declared_host_wire(ctx, name):
+    """The engine-declared host-state stream attaches only to the
+    update-performing programs (overlap.UPDATE_PROGRAMS) — the same
+    gating the CommLedger's recorded analysis uses, so the offline
+    re-analysis (DSO703) compares like with like."""
+    from .overlap import UPDATE_PROGRAMS
+
+    if str(name) not in UPDATE_PROGRAMS:
+        return None
+    wire = ctx.get("host_state_wire_bytes")
+    return int(wire) if wire else None
+
+
 def build_engine_artifact(engine, name, compiled):
     """One :class:`ProgramArtifact` from a live compiled executable plus
     the engine's ledgers/metadata; None when the HLO text is
@@ -60,10 +73,37 @@ def build_engine_artifact(engine, name, compiled):
         alias_size_in_bytes=(mem_entry or {}).get("alias_size_in_bytes"),
         mesh_axes=ctx["mesh_axes"], data_axis=ctx["data_axis"],
         param_bytes=ctx["param_bytes"], comm=comm_entry,
-        master_provenance=ctx["master_provenance"])
+        master_provenance=ctx["master_provenance"],
+        host_state_wire_bytes=_declared_host_wire(ctx, name),
+        device_kind=ctx.get("device_kind"))
 
 
-def _report(diags, programs_checked):
+def _overlap_aggregate(artifacts):
+    """Cross-program overlap verdict: summed wire/exposed seconds and
+    serialized-node counts over every artifact the analyzer could
+    summarize; None when none could (no claim, never a silent 0)."""
+    wire = exposed = 0.0
+    n = ser_coll = ser_host = 0
+    for artifact in artifacts:
+        summary = dsp.program_overlap(artifact)
+        if not summary:
+            continue
+        n += 1
+        wire += summary["wire_seconds"]
+        exposed += summary["exposed_wire_seconds"]
+        ser_coll += summary["collectives"]["serialized"]
+        ser_host += summary["host_transfers"]["serialized"]
+    if n == 0:
+        return None
+    return {"programs": n, "wire_seconds": wire,
+            "exposed_wire_seconds": exposed,
+            "overlap_fraction": (1.0 - exposed / wire) if wire > 0
+            else 1.0,
+            "serialized_collectives": ser_coll,
+            "serialized_host_transfers": ser_host}
+
+
+def _report(diags, programs_checked, artifacts=()):
     failing = [d for d in diags
                if not d.suppressed and d.severity in FAILING_SEVERITIES]
     return {
@@ -71,10 +111,15 @@ def _report(diags, programs_checked):
         "violations": len(failing),
         # error-severity subset: what non-ratchetable surfaces (the
         # capacity planner's exit code) gate on — heuristic warnings
-        # (DSP612/613/614) report but only the CLI's --baseline can
-        # absolve them, so they must not hard-fail a plan
+        # (DSP612/613/614, the DSO7xx overlap family) report but only
+        # the CLI's --baseline can absolve them, so they must not
+        # hard-fail a plan
         "errors": sum(1 for d in failing if d.severity == "error"),
         "downgraded": sum(1 for d in diags if d.rule_id == "DSP602"),
+        # static exposed-wire verdict (profiling/overlap, DSO7xx):
+        # which of the priced wire seconds the compiled schedules
+        # actually pay as latency
+        "overlap": _overlap_aggregate(artifacts),
         "diagnostics": diags,
     }
 
@@ -88,12 +133,14 @@ def verify_engine_programs(engine):
     if not compiled_map:
         return None
     diags = []
+    artifacts = []
     checked = 0
     for name, compiled in sorted(compiled_map.items()):
         artifact = build_engine_artifact(engine, name, compiled)
         if artifact is None:
             continue
         checked += 1
+        artifacts.append(artifact)
         diags.extend(dsp.verify_program(artifact))
     if checked == 0:
         # every as_text() failed (backend specific): NO check ran —
@@ -105,7 +152,7 @@ def verify_engine_programs(engine):
                      "withheld (%d compiled programs)",
                      len(compiled_map))
         return None
-    return _report(diags, checked)
+    return _report(diags, checked, artifacts)
 
 
 def verify_run_dir(run_dir):
@@ -116,7 +163,8 @@ def verify_run_dir(run_dir):
     ``FileNotFoundError``/``ValueError`` when the run dir holds no (or
     malformed) program artifacts."""
     artifacts = dsp.load_run_artifacts(str(run_dir))
-    return _report(dsp.verify_artifacts(artifacts), len(artifacts))
+    return _report(dsp.verify_artifacts(artifacts), len(artifacts),
+                   artifacts)
 
 
 class ProgramDumper:
@@ -173,7 +221,9 @@ class ProgramDumper:
             data_axis=ctx.get("data_axis") or "data",
             param_bytes=ctx.get("param_bytes"),
             comm=comm_entry,
-            master_provenance=ctx.get("master_provenance"))
+            master_provenance=ctx.get("master_provenance"),
+            host_state_wire_bytes=_declared_host_wire(ctx, name),
+            device_kind=ctx.get("device_kind"))
         try:
             os.makedirs(self.programs_dir, exist_ok=True)
             hlo_path = os.path.join(self.programs_dir, f"{name}.hlo")

@@ -654,6 +654,11 @@ class DeepSpeedEngine:
                 self.telemetry.enabled),
             telemetry=self.telemetry,
             mesh_axes=mesh_axis_sizes(self.mesh))
+        # the overlap analyzer (profiling/overlap) rides the same one
+        # compile-time HLO walk: the context resolves lazily because
+        # the declared host-state stream and donation specs are only
+        # final after _build_step_functions
+        self.comm_ledger.overlap_context_fn = self.program_verify_context
         # the comm ledger and the program dumper both ride the memory
         # ledger's AOT hook, so either being on forces the shared hook
         # on even with the memory ledger off (memory events stay gated
@@ -934,6 +939,17 @@ class DeepSpeedEngine:
             self.gradient_accumulation_steps(),
             prefer=self._active_step_program())
 
+    def overlap_receipt(self):
+        """{program, wire_seconds, exposed_wire_seconds,
+        overlap_fraction} for ONE optimizer step from the comm ledger's
+        compile-time overlap analysis (``profiling/overlap.py``): the
+        static statement of which predicted wire seconds the compiled
+        schedules actually pay as latency.  None until a program with
+        an overlap summary has compiled or with the ledger off."""
+        return self.comm_ledger.step_overlap(
+            self.gradient_accumulation_steps(),
+            prefer=self._active_step_program())
+
     # ------------------------------------------------------------------
     # program verification (deepspeed_tpu/profiling/verify, DSP6xx)
     # ------------------------------------------------------------------
@@ -950,6 +966,14 @@ class DeepSpeedEngine:
             "param_bytes": int(np.prod(self.segments.shape)) * 4,
             "master_provenance": getattr(self.flat, "master_provenance",
                                          None),
+            # overlap-analysis context (profiling/overlap, DSO7xx):
+            # the per-step host-state stream the offload update moves
+            # BETWEEN dispatches (serialized by construction until the
+            # overlapped-streaming work lands), and the chip the
+            # roofline/wire tables resolve against
+            "host_state_wire_bytes": self.host_state_bytes_per_step(),
+            "device_kind": getattr(self.mesh.devices.flat[0],
+                                   "device_kind", ""),
         }
 
     def verify_programs(self):

@@ -16,7 +16,8 @@ per-rank event streams (``events-rank*.jsonl``) and metric snapshots
 - **metric summaries**: counters, gauges, and histogram percentiles per
   rank;
 - with ``--comm``, the communication section: the per-program collective
-  table (count / payload bytes / predicted wire bytes from the comm
+  table (count / payload bytes / predicted wire bytes / exposed wire
+  seconds from the comm
   ledger's compile-time HLO walk), a per-step cross-rank latency table
   with a slowest-vs-median skew column, and the straggler verdicts;
 - with ``--prometheus``, a Prometheus text-exposition dump of the merged
@@ -294,6 +295,7 @@ def comm_summary(records):
     lines = []
     wire = {}
     measured = {}
+    exposure = {}
     for rec in records:
         data = rec.get("data", {})
         if rec.get("type") != ev.EVENT_COMM:
@@ -308,12 +310,18 @@ def comm_summary(records):
                 and data.get("program") in ("train_step",
                                             "train_step_compressed")):
             wire[stream] = data.get("wire_bytes")
+            if data.get("overlap"):
+                exposure[stream] = data["overlap"]
         elif data.get("kind") == "latency" and data.get("p50"):
             measured[stream] = float(data["p50"])   # last snapshot wins
     for stream in sorted(set(wire) | set(measured)):
         w, m = wire.get(stream), measured.get(stream)
+        ov = exposure.get(stream)
+        exposed = ("" if ov is None else
+                   f", exposed wire {ov['exposed_wire_seconds']*1e3:.3f}"
+                   f"ms (overlap {ov['overlap_fraction']:.0%})")
         lines.append(
-            f"  [{stream}] predicted step wire {_fmt_bytes(w)}"
+            f"  [{stream}] predicted step wire {_fmt_bytes(w)}{exposed}"
             + (f", measured step p50 {m*1e3:.2f}ms" if m else
                ", no measured steps"))
     return lines or ["  (no step program / latency events)"]

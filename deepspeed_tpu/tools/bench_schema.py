@@ -80,6 +80,16 @@ FIELDS = {
                                   "collective ops in the step program"),
     "comm_wire_bytes_per_step": (numbers.Integral,
                                  "predicted wire bytes per step"),
+    # overlap receipts (round 11, profiling/overlap): the static
+    # critical-path analysis' statement of which predicted wire seconds
+    # the compiled schedules actually pay as latency — the metric the
+    # overlapped-streaming work (ROADMAP item 2) must drive down
+    "exposed_wire_seconds": (numbers.Real,
+                             "predicted un-overlapped (exposed) wire "
+                             "seconds per step"),
+    "overlap_fraction": (numbers.Real,
+                         "hidden/total wire seconds, 0..1 (1.0 = fully "
+                         "hidden or no wire)"),
     # program-verification receipt (round 10, profiling/verify +
     # tools/dslint/programs): unsuppressed DSP6xx violations over every
     # compiled engine program — donation aliases materialized,
@@ -126,6 +136,9 @@ _LEG_FIELDS = {
     # program-verification receipt (round 10): DSP6xx violations over
     # the leg engine's compiled programs
     "dsp_violations": numbers.Integral,
+    # overlap receipts (round 11)
+    "exposed_wire_seconds": numbers.Real,
+    "overlap_fraction": numbers.Real,
     "error": str,
     "note": str,
 }
@@ -153,6 +166,9 @@ _OFFLOAD_ROW_FIELDS = {
     "comm_wire_bytes_per_step": numbers.Integral,
     # program-verification receipt (round 10)
     "dsp_violations": numbers.Integral,
+    # overlap receipts (round 11)
+    "exposed_wire_seconds": numbers.Real,
+    "overlap_fraction": numbers.Real,
     "error": str,
     "note": str,
 }
@@ -195,6 +211,11 @@ THRESHOLDS = {
     # is a sharding/collective regression even before it shows up in
     # step time (generous tol: XLA is free to re-split collectives)
     "comm_wire_bytes_per_step": ("lower", 0.25),
+    # exposure must not creep back once overlap lands; the fraction is
+    # gated loosely (model-derived, scheduler-version sensitive) and
+    # the absolute exposed seconds generously for the same reason
+    "exposed_wire_seconds": ("lower", 0.25),
+    "overlap_fraction": ("higher", 0.10),
     # any new program-verifier violation is a gated regression (zero
     # tolerance: the receipt exists to pin this at 0)
     "dsp_violations": ("lower", 0.0),
@@ -208,6 +229,8 @@ THRESHOLDS = {
 _LEG_FIELD_THRESHOLDS = {
     "comm_wire_bytes": ("lower", 0.25),
     "dsp_violations": ("lower", 0.0),
+    "exposed_wire_seconds": ("lower", 0.25),
+    "overlap_fraction": ("higher", 0.10),
 }
 
 # thresholds for the pattern-based offload_<row>_<field> family
@@ -219,6 +242,8 @@ _OFFLOAD_FIELD_THRESHOLDS = {
     "host_buffer_bytes": ("lower", 0.10),
     "comm_wire_bytes_per_step": ("lower", 0.25),
     "dsp_violations": ("lower", 0.0),
+    "exposed_wire_seconds": ("lower", 0.25),
+    "overlap_fraction": ("higher", 0.10),
 }
 
 

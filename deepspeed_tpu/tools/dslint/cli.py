@@ -5,6 +5,7 @@
     python -m deepspeed_tpu.tools.dslint --programs runs/telemetry
     python -m deepspeed_tpu.tools.dslint --list-rules
     python -m deepspeed_tpu.tools.dslint deepspeed_tpu/ --json report.json
+    python -m deepspeed_tpu.tools.dslint deepspeed_tpu/ --sarif out.sarif
     python -m deepspeed_tpu.tools.dslint deepspeed_tpu/ \
         --baseline dslint_baseline.json [--update-baseline]
 
@@ -31,8 +32,9 @@ from typing import List
 
 # rule modules register their checkers on import
 from . import hotpath, programs, retrace, robustness  # noqa: F401
-from .core import (Diagnostic, FAILING_SEVERITIES, RULES, ParsedFile,
-                   SourceReadError, check_file, rule_catalog, rule_family)
+from .core import (Diagnostic, FAILING_SEVERITIES, FAMILY_BUDGETS, RULES,
+                   ParsedFile, SourceReadError, check_file, rule_catalog,
+                   rule_family)
 from .schema import (dead_key_diagnostics, get_schema,
                      issues_to_diagnostics, validate_config_dict)
 
@@ -147,14 +149,14 @@ def baseline_key(d: Diagnostic) -> str:
     """Stable identity of one violation for the ratchet: path + rule +
     message (NOT line numbers, which drift with unrelated edits).
 
-    Program-verifier (DSP6xx artifact) diagnostics key on the PROGRAM
-    name + rule only: their paths embed the run dir and their messages
+    Program-verifier (DSP6xx/DSO7xx artifact) diagnostics key on the
+    PROGRAM name + rule only: their paths embed the run dir and their messages
     embed byte counts, both of which change run to run — a baselined
     intentional psum must keep matching after a re-dump or a model
     resize (the ratchet is the only suppression mechanism for program
     findings; they have no source line to pragma)."""
     m = _PROGRAM_DIAG_RE.match(d.message)
-    if m and d.rule_id.startswith("DSP6"):
+    if m and d.rule_id.startswith(("DSP6", "DSO7")):
         return f"<programs>|{d.rule_id}|{m.group('program')}"
     return f"{d.path.replace(os.sep, '/')}|{d.rule_id}|{d.message}"
 
@@ -210,6 +212,72 @@ def _by_family(diags):
                                for d in diags).items()))
 
 
+# ---------------------------------------------------------------------------
+# SARIF 2.1.0 output (CI inline annotations)
+# ---------------------------------------------------------------------------
+
+SARIF_VERSION = "2.1.0"
+_SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/"
+                 "sarif-spec/master/Schemata/sarif-schema-2.1.0.json")
+_SARIF_LEVELS = {"error": "error", "warning": "warning", "info": "note"}
+
+
+def sarif_report(diags, new_fail) -> dict:
+    """One SARIF 2.1.0 run covering source AND program diagnostics.
+
+    Every diagnostic becomes a result; pragma-suppressed ones carry a
+    ``suppressions`` entry of kind ``inSource`` and baselined ones kind
+    ``external``.  Info-severity diagnostics (DSP602 downgrades) emit
+    as level ``note`` with no suppressions — informational, never
+    exit-code-driving — so the invariant round-trip-tested against
+    ``--json`` is: unsuppressed ``error``/``warning`` results ==
+    ``violations``."""
+    new_ids = {id(d) for d in new_fail}
+    rule_ids = sorted(RULES)
+    rule_index = {rid: i for i, rid in enumerate(rule_ids)}
+    results = []
+    for d in diags:
+        result = {
+            "ruleId": d.rule_id,
+            "ruleIndex": rule_index[d.rule_id],
+            "level": _SARIF_LEVELS[d.severity],
+            "message": {"text": d.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": d.path.replace(os.sep, "/")},
+                    "region": {"startLine": max(int(d.line), 1),
+                               "startColumn": max(int(d.col), 1)},
+                }}],
+        }
+        if d.suppressed:
+            result["suppressions"] = [{"kind": "inSource"}]
+        elif (d.severity in FAILING_SEVERITIES
+              and id(d) not in new_ids):
+            result["suppressions"] = [{"kind": "external"}]
+        results.append(result)
+    return {
+        "$schema": _SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "dslint",
+                "informationUri":
+                    "https://github.com/deepspeed-tpu/deepspeed-tpu",
+                "rules": [{
+                    "id": rid,
+                    "name": RULES[rid].name,
+                    "shortDescription": {"text": RULES[rid].summary},
+                    "fullDescription": {"text": RULES[rid].rationale},
+                    "defaultConfiguration": {
+                        "level": _SARIF_LEVELS[RULES[rid].severity]},
+                } for rid in rule_ids],
+            }},
+            "results": results,
+        }],
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="dslint",
@@ -232,6 +300,10 @@ def main(argv=None) -> int:
     ap.add_argument("--json", metavar="FILE", dest="json_out",
                     help="write a machine-readable report (carries a "
                          "stable schema_version field)")
+    ap.add_argument("--sarif", metavar="FILE", dest="sarif_out",
+                    help="write a SARIF 2.1.0 report covering source "
+                         "AND program findings (CI inline annotations); "
+                         "exit codes unchanged")
     ap.add_argument("--baseline", metavar="FILE",
                     help="ratchet mode: violations recorded in FILE do "
                          "not fail; only NEW ones do")
@@ -315,6 +387,11 @@ def main(argv=None) -> int:
           f"suppressed{tail}, {len(files)} file(s) scanned, "
           f"{len(RULES)} rules")
 
+    if args.sarif_out:
+        with open(args.sarif_out, "w", encoding="utf-8") as f:
+            json.dump(sarif_report(diags, fail), f, indent=2,
+                      sort_keys=True)
+
     if args.json_out:
         report = {
             "schema_version": JSON_SCHEMA_VERSION,
@@ -322,6 +399,10 @@ def main(argv=None) -> int:
             "violations_by_family": _by_family(fail),
             "suppressed": len(suppressed),
             "suppressed_by_family": _by_family(suppressed),
+            # the per-family pragma budgets the tier-1 self-test
+            # enforces (program families DSP6/DSO7 are 0: baseline-
+            # ratchet only)
+            "family_budgets": dict(FAMILY_BUDGETS),
             "baselined": baselined,
             "baseline_file": args.baseline,
             "files_scanned": len(files),

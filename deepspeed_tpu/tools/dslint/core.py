@@ -57,6 +57,25 @@ def rule_family(rule_id: str) -> str:
     return rule_id[:4]
 
 
+# Per-family pragma suppression budgets: the number of reasoned
+# `# dslint: disable=` pragmas each rule family may carry in the
+# shipped tree.  Enforced by the tier-1 self-test
+# (tests/unit/test_dslint_self.py) and reported by `--json` /
+# `--list-rules`.  Program-level families (DSP6 donation/collective
+# semantics, DSO7 overlap/exposed-wire) have NO pragma budget by
+# construction — program findings carry no source line to pragma; the
+# `--baseline` ratchet is their only suppression mechanism.
+FAMILY_BUDGETS = {
+    "DSC4": 1,   # config dead-key (wired-by-reference constant)
+    "DSH1": 2,   # partial-bound static casts
+    "DSH2": 4,   # print-cadence driver fetches (1 spare for the class)
+    "DSR3": 0,   # retrace hazards: fix them, never pragma them
+    "DSE5": 7,   # optional-backend probes
+    "DSP6": 0,   # program verifier: ratchet via --baseline or fix
+    "DSO7": 0,   # overlap analyzer: ratchet via --baseline or fix
+}
+
+
 class SourceReadError(Exception):
     """A source file could not be read (missing, unreadable, or not
     UTF-8) — a usage-class failure (CLI exit 2), distinct from a
@@ -220,4 +239,8 @@ def rule_catalog() -> str:
         lines.append(f"    why: {rule.rationale}")
         if rule.autofix_hint:
             lines.append(f"    fix: {rule.autofix_hint}")
+    lines.append("suppression budgets (pragmas per family; 0 = "
+                 "baseline-ratchet only):")
+    lines.append("    " + "  ".join(f"{fam}xx={n}" for fam, n in
+                                    sorted(FAMILY_BUDGETS.items())))
     return "\n".join(lines)

@@ -148,6 +148,56 @@ register_rule(Rule(
                  "train), or fix the import error it reports."))
 
 register_rule(Rule(
+    id="DSO701", name="serialized-collective", severity="warning",
+    summary="fully serialized collective(s) with enough independent "
+            "compute available to hide them",
+    rationale="A sync-form collective blocks its dependents for its "
+              "full wire time even when the program holds compute that "
+              "depends on neither its inputs nor its outputs — wire "
+              "seconds paid as step latency that an async "
+              "-start/-done schedule would hide for free.  The overlap "
+              "analyzer (profiling/overlap.py) only fires this when "
+              "the independent-compute window clears a floor "
+              "(DSO701_MIN_WINDOW_SECONDS): micro-programs have "
+              "nothing to overlap WITH.",
+    autofix_hint="Let XLA's async scheduler split the op "
+                 "(--xla_tpu_enable_async_collective_*), or "
+                 "restructure so dependent work moves off the "
+                 "collective's path; ratchet intentional cases via "
+                 "`--baseline`."))
+
+register_rule(Rule(
+    id="DSO702", name="serialized-host-transfer", severity="warning",
+    summary="serialized host transfer(s) adjacent to independent "
+            "compute — the offload tax, statically",
+    rationale="Host<->device round trips (copy-start without "
+              "overlapping schedule, or the engine's DECLARED "
+              "offload-state stream running between dispatches) pay "
+              "full wire latency while compute that could hide them "
+              "sits idle — PERF.md's ~2x offload-tax accounting, per "
+              "program.  The exposed seconds this rule quotes are the "
+              "exact metric the overlapped-streaming work (ROADMAP "
+              "item 2) must drive down; the --baseline ratchet records "
+              "today's known-serialized stream without gating it.",
+    autofix_hint="Double-buffer the chunk stream (prefetch group k+1 "
+                 "while group k updates, overlap write-back with the "
+                 "next fetch); on TPU lowerings, move transfers to "
+                 "async copy-start/copy-done pairs."))
+
+register_rule(Rule(
+    id="DSO703", name="overlap-model-drift", severity="warning",
+    summary="recorded overlap summary drifts from the HLO re-analysis "
+            "beyond tolerance",
+    rationale="The sidecar's recorded exposure figures are what bench "
+              "receipts and the ratchet baseline quote; if re-analyzing "
+              "the dumped HLO disagrees, the artifact is stale (edited, "
+              "or recorded by a drifted analyzer) and the quoted "
+              "exposed-wire receipts are unauditable — the DSP613 "
+              "argument, applied to the exposure model.",
+    autofix_hint="Re-dump the program artifacts from a fresh compile "
+                 "(delete <run_dir>/programs and rerun)."))
+
+register_rule(Rule(
     id="DSP613", name="comm-ledger-drift", severity="warning",
     summary="recorded CommLedger totals drift from the HLO re-parse "
             "beyond tolerance",
@@ -257,10 +307,17 @@ class ProgramArtifact:
     # total bytes of the flat parameter master (the DSP611 payload
     # floor); None disables the parameter-shape test
     param_bytes: Optional[int] = None
-    # the CommLedger entry recorded at compile time (DSP613 cross-check)
+    # the CommLedger entry recorded at compile time (DSP613 cross-check;
+    # its "overlap" sub-dict is the DSO703 cross-check)
     comm: Optional[dict] = None
     # init-provenance note from the flat coordinator (informational)
     master_provenance: Optional[str] = None
+    # engine-declared per-step host-state stream bytes (the offload
+    # round trips that run BETWEEN dispatches, invisible in this
+    # program's HLO) — producers set it only on update programs
+    host_state_wire_bytes: Optional[int] = None
+    # device_kind string the roofline/wire tables resolve against
+    device_kind: Optional[str] = None
 
     def __post_init__(self):
         if not self.path:
@@ -289,6 +346,8 @@ class ProgramArtifact:
             "param_bytes": self.param_bytes,
             "comm": self.comm,
             "master_provenance": self.master_provenance,
+            "host_state_wire_bytes": self.host_state_wire_bytes,
+            "device_kind": self.device_kind,
         }
 
 
@@ -336,7 +395,12 @@ def load_run_artifacts(run_dir: str) -> List[ProgramArtifact]:
                 data_axis=side.get("data_axis") or "data",
                 param_bytes=side.get("param_bytes"),
                 comm=side.get("comm"),
-                master_provenance=side.get("master_provenance")))
+                master_provenance=side.get("master_provenance"),
+                host_state_wire_bytes=(
+                    int(side["host_state_wire_bytes"])
+                    if side.get("host_state_wire_bytes") is not None
+                    else None),
+                device_kind=side.get("device_kind")))
         except (TypeError, ValueError) as e:
             # type-malformed sidecar (donate_argnums: 5, mesh_axes as a
             # list, ...): a usage-class load failure the CLI reports as
@@ -494,8 +558,139 @@ def check_collectives(artifact: ProgramArtifact) -> List[Diagnostic]:
     return out
 
 
+def program_overlap(artifact: ProgramArtifact):
+    """The overlap/critical-path analysis (profiling/overlap.py) for
+    one artifact, memoized on the artifact; None when the analyzer is
+    unavailable or the text holds no computation."""
+    if "_overlap_summary" not in artifact.__dict__:
+        summary = None
+        try:
+            from ...profiling import overlap as overlap_prof
+
+            # max_nodes=None: the rule checks must see EVERY node — a
+            # collective-heavy program truncated at the telemetry cap
+            # would silently drop the declared host-stream node (it is
+            # appended last) and every finding past the cap
+            summary = overlap_prof.analyze_hlo(
+                artifact.hlo,
+                total_devices=artifact.total_devices,
+                device_kind=artifact.device_kind or "",
+                declared_host_wire_bytes=(
+                    artifact.host_state_wire_bytes or 0),
+                max_nodes=None)
+        except Exception:
+            summary = None
+        artifact.__dict__["_overlap_summary"] = summary
+    return artifact.__dict__["_overlap_summary"]
+
+
+def check_overlap(artifact: ProgramArtifact) -> List[Diagnostic]:
+    """DSO701/DSO702/DSO703 over one program's overlap analysis.
+
+    One finding per (rule, program), aggregating every offending node:
+    the ratchet baseline keys on (rule, program), so per-node findings
+    would break the baseline count on any re-dump that re-splits the
+    stream."""
+    if not artifact.hlo:
+        return []
+    try:
+        from ...profiling.overlap import (DSO701_MIN_WINDOW_SECONDS,
+                                          KIND_COLLECTIVE, KIND_HOST,
+                                          MAX_WINDOW_INSTRUCTIONS,
+                                          SERIALIZED)
+    except Exception:
+        # the profiling package is unimportable — check_collectives'
+        # DSP614 already says every HLO-side heuristic was skipped; a
+        # second flag would be noise
+        return []
+    summary = program_overlap(artifact)
+    if summary is None:
+        # header-only artifact (no computation body): nothing is
+        # scheduled, so there is no overlap to verify — same silence as
+        # an empty collective walk
+        return []
+    out: List[Diagnostic] = []
+
+    nodes = summary.get("nodes") or []
+    # Window analysis degrades to None past MAX_WINDOW_INSTRUCTIONS —
+    # exactly the production-size programs the analyzer targets.  The
+    # window-gated checks below then never fire, and silence would
+    # read as overlap-clean: say so loudly instead (the DSP614
+    # contract).  Declared-stream nodes carry an explicit window and
+    # are unaffected.
+    unknown = [n for n in nodes
+               if n["classification"] == SERIALIZED and n["seconds"] > 0
+               and n.get("window_seconds") is None]
+    if unknown:
+        out.append(_pdiag(
+            artifact, "DSP614",
+            f"{len(unknown)} serialized wire node(s) have UNKNOWN "
+            "independent-compute windows (program exceeds the "
+            f"{MAX_WINDOW_INSTRUCTIONS}-instruction window-analysis "
+            "cap) — the DSO701/DSO702 window checks did NOT run for "
+            "them; their exposure is UNVERIFIED, not clean"))
+    # DSO701: serialized collectives with a real window to hide them
+    culprits = [n for n in nodes
+                if n["kind"] == KIND_COLLECTIVE
+                and n["classification"] == SERIALIZED
+                and n["seconds"] > 0
+                and (n.get("window_seconds") or 0.0)
+                >= DSO701_MIN_WINDOW_SECONDS]
+    if culprits:
+        wire_ms = sum(n["seconds"] for n in culprits) * 1e3
+        window_ms = max(n["window_seconds"] for n in culprits) * 1e3
+        out.append(_pdiag(
+            artifact, "DSO701",
+            f"{len(culprits)} fully serialized collective(s) paying "
+            f"{wire_ms:.3f} ms of exposed wire with up to "
+            f"{window_ms:.3f} ms of independent compute available to "
+            "hide them (no -start/-done overlap materialized)"))
+    # DSO702: serialized host transfers next to independent compute
+    host = [n for n in nodes
+            if n["kind"] == KIND_HOST
+            and n["classification"] == SERIALIZED
+            and n["seconds"] > 0
+            and (n.get("window_seconds") or 0.0) > 0]
+    if host:
+        total_bytes = sum(n["wire_bytes"] for n in host)
+        exposed_ms = sum(n["seconds"] - n["hidden_seconds"]
+                         for n in host) * 1e3
+        sources = sorted({n["source"] for n in host})
+        out.append(_pdiag(
+            artifact, "DSO702",
+            f"{len(host)} serialized host transfer(s) ({total_bytes} "
+            f"bytes, {exposed_ms:.3f} ms exposed wire; source: "
+            f"{'/'.join(sources)}) adjacent to an independent compute "
+            "region — the offload tax, statically (exposed_wire_"
+            f"seconds={summary['exposed_wire_seconds']:.6f})"))
+    # DSO703: recorded exposure vs re-analysis
+    recorded = (artifact.comm or {}).get("overlap")
+    if recorded:
+        drifts = []
+        for field in ("wire_seconds", "exposed_wire_seconds"):
+            rec_v, new_v = recorded.get(field), summary[field]
+            if rec_v is None:
+                continue
+            tol = max(abs(new_v), 1e-12) * 0.05
+            if abs(float(rec_v) - float(new_v)) > tol:
+                drifts.append(f"{field} {rec_v} -> {new_v}")
+        for field in ("collectives", "host_transfers"):
+            rec_v = (recorded.get(field) or {}).get("total")
+            if rec_v is not None and rec_v != summary[field]["total"]:
+                drifts.append(
+                    f"{field} {rec_v} -> {summary[field]['total']}")
+        if drifts:
+            out.append(_pdiag(
+                artifact, "DSO703",
+                "recorded overlap summary drifts from the HLO "
+                f"re-analysis: {'; '.join(drifts)} (stale or tampered "
+                "artifact)"))
+    return out
+
+
 def verify_program(artifact: ProgramArtifact) -> List[Diagnostic]:
-    """All DSP6xx HLO-side diagnostics for one program artifact."""
+    """All DSP6xx/DSO7xx HLO-side diagnostics for one program
+    artifact."""
     if not artifact.hlo:
         # a sidecar whose HLO text is missing/empty would otherwise
         # make every HLO-side rule early-return — "verified clean" on
@@ -505,7 +700,8 @@ def verify_program(artifact: ProgramArtifact) -> List[Diagnostic]:
             "sidecar present but the program's HLO text is missing or "
             "empty — artifact unverifiable (stale or tampered dump; "
             "re-dump with profiling.program_dump enabled)")]
-    return check_donation(artifact) + check_collectives(artifact)
+    return (check_donation(artifact) + check_collectives(artifact)
+            + check_overlap(artifact))
 
 
 def verify_artifacts(artifacts) -> List[Diagnostic]:

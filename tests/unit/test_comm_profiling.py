@@ -228,6 +228,26 @@ def test_comm_ledger_emits_schema_clean_events(cpu_devices, tmp_path):
     prog = [r for r in comm if r["data"].get("program") == "train_step"][0]
     assert prog["data"]["mesh"] == {"data": 4}
     assert prog["data"]["wire_bytes"] > 0
+    # round 11: the program event carries the host-transfer accounting
+    # (0 on this CPU lowering — the receipt proves it rather than
+    # leaving "no DMA ops" as an assumption) and the overlap summary
+    assert prog["data"]["host_transfers"] == 0
+    assert prog["data"]["host_transfer_bytes"] == 0
+    ovl = prog["data"]["overlap"]
+    assert ovl["overlap_schema_version"] == 1
+    assert ovl["wire_seconds"] >= ovl["exposed_wire_seconds"] >= 0
+    assert 0.0 <= ovl["overlap_fraction"] <= 1.0
+
+
+def test_comm_ledger_gauges_include_host_transfer_bytes(cpu_devices,
+                                                        tmp_path):
+    engine = _comm_engine(cpu_devices, tmp_path)
+    engine.train_batch(iter(random_batches(1, 16, HIDDEN, seed=1)))
+    names = engine.telemetry.registry.names()
+    engine.close()
+    assert "comm/program/train_step/host_transfer_bytes" in names
+    assert "comm/program/train_step/exposed_wire_seconds" in names
+    assert "comm/program/train_step/overlap_fraction" in names
 
 
 def test_comm_ledger_off_by_default_without_telemetry(cpu_devices):

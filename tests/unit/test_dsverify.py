@@ -415,8 +415,11 @@ def test_verify_report_shape_and_downgrade_count():
     diags = dsp.verify_program(dsp.ProgramArtifact(
         name="p", hlo=hlo, donate_argnums=(0,), alias_size_in_bytes=0))
     report = _report(diags, 1)
+    # "overlap" is None here: a header-only artifact has no scheduled
+    # computation to analyze, and the report must say "no claim"
+    # rather than a silent fully-overlapped 0
     assert report == {"programs_checked": 1, "violations": 0,
-                      "errors": 0, "downgraded": 1,
+                      "errors": 0, "downgraded": 1, "overlap": None,
                       "diagnostics": diags}
 
 

@@ -1,0 +1,530 @@
+"""DSO7xx overlap-analyzer tests (``profiling/overlap.py`` +
+``tools/dslint/programs.py`` rules + CLI surfaces).
+
+Hand-written scheduled-HLO fixtures pin every layer: the instruction
+/ computation parser, the roofline cost model and critical path, the
+host/p2p transfer parser (the CommLedger satellite), the per-node
+overlap classification (sync = serialized, async pair hidden by the
+schedule window between ``-start`` and ``-done``), the DSO701/702/703
+rules, the ``--sarif`` CLI output round-tripped against ``--json``,
+and the bench-schema registration of the exposure receipts.
+
+All figures below assume the v5e table in ``profiling/utilization.py``
+(peak 197 TF/s, HBM 819 GB/s, ICI 45 GB/s, host 14 GB/s): an
+f32[8192,8192] dot costs ~5.6 ms (flops-bound), the f32[1024,8192]
+group-4 all-reduce moves 2·(3/4)·32 MiB ≈ 50 MiB of wire ≈ 1.1 ms.
+"""
+
+import io
+import json
+import os
+from contextlib import redirect_stdout
+
+from deepspeed_tpu.profiling import overlap as ov
+from deepspeed_tpu.profiling.utilization import chip_specs
+from deepspeed_tpu.tools.dslint import programs as dsp
+from deepspeed_tpu.tools.dslint.cli import main as dslint_main
+
+V5E = chip_specs("TPU v5e")
+
+_HEADER = "HloModule fixture, is_scheduled=true\n\n"
+
+_BIG_DOT = ("  %dot.big = f32[8192,8192]{1,0} dot(f32[8192,8192]{1,0} "
+            "%p1, f32[8192,8192]{1,0} %p1), lhs_contracting_dims={1}, "
+            "rhs_contracting_dims={0}\n")
+
+# sync all-reduce next to an independent flops-bound dot: fully
+# serialized by construction, with a >1 ms window available -> DSO701
+SERIAL_AR = _HEADER + (
+    "ENTRY %main.1 (p0: f32[1024,8192], p1: f32[8192,8192]) -> "
+    "(f32[1024,8192], f32[8192,8192]) {\n"
+    "  %p0 = f32[1024,8192]{1,0} parameter(0)\n"
+    "  %p1 = f32[8192,8192]{1,0} parameter(1)\n"
+    + _BIG_DOT +
+    "  %all-reduce.1 = f32[1024,8192]{1,0} all-reduce("
+    "f32[1024,8192]{1,0} %p0), replica_groups={{0,1,2,3}}\n"
+    "  ROOT %tuple.1 = (f32[1024,8192]{1,0}, f32[8192,8192]{1,0}) "
+    "tuple(%all-reduce.1, %dot.big)\n"
+    "}\n")
+
+# the same collective as an async pair with the dot scheduled inside
+# the start/done window: hidden compute >= wire -> overlapped, clean
+OVERLAPPED_AR = _HEADER + (
+    "ENTRY %main.1 (p0: f32[1024,8192], p1: f32[8192,8192]) -> "
+    "(f32[1024,8192], f32[8192,8192]) {\n"
+    "  %p0 = f32[1024,8192]{1,0} parameter(0)\n"
+    "  %p1 = f32[8192,8192]{1,0} parameter(1)\n"
+    "  %all-reduce-start.1 = (f32[1024,8192]{1,0}, f32[1024,8192]{1,0})"
+    " all-reduce-start(f32[1024,8192]{1,0} %p0), "
+    "replica_groups={{0,1,2,3}}\n"
+    + _BIG_DOT +
+    "  %all-reduce-done.1 = f32[1024,8192]{1,0} all-reduce-done("
+    "(f32[1024,8192]{1,0}, f32[1024,8192]{1,0}) %all-reduce-start.1)\n"
+    "  ROOT %tuple.1 = (f32[1024,8192]{1,0}, f32[8192,8192]{1,0}) "
+    "tuple(%all-reduce-done.1, %dot.big)\n"
+    "}\n")
+
+# async pair hiding only a smaller dot: 0 < hidden < wire -> partial
+PARTIAL_AR = _HEADER + (
+    "ENTRY %main.1 (p0: f32[1024,8192], p1: f32[4096,4096]) -> "
+    "(f32[1024,8192], f32[4096,4096]) {\n"
+    "  %p0 = f32[1024,8192]{1,0} parameter(0)\n"
+    "  %p1 = f32[4096,4096]{1,0} parameter(1)\n"
+    "  %all-reduce-start.1 = (f32[1024,8192]{1,0}, f32[1024,8192]{1,0})"
+    " all-reduce-start(f32[1024,8192]{1,0} %p0), "
+    "replica_groups={{0,1,2,3}}\n"
+    "  %dot.small = f32[4096,4096]{1,0} dot(f32[4096,4096]{1,0} %p1, "
+    "f32[4096,4096]{1,0} %p1), lhs_contracting_dims={1}, "
+    "rhs_contracting_dims={0}\n"
+    "  %all-reduce-done.1 = f32[1024,8192]{1,0} all-reduce-done("
+    "(f32[1024,8192]{1,0}, f32[1024,8192]{1,0}) %all-reduce-start.1)\n"
+    "  ROOT %tuple.1 = (f32[1024,8192]{1,0}, f32[4096,4096]{1,0}) "
+    "tuple(%all-reduce-done.1, %dot.small)\n"
+    "}\n")
+
+# a host copy pair the scheduler left back-to-back, next to an
+# independent dot -> DSO702 (the offload tax, HLO-visible form)
+SERIAL_HOST_COPY = _HEADER + (
+    "ENTRY %main.1 (p0: f32[8388608], p1: f32[8192,8192]) -> "
+    "(f32[8388608], f32[8192,8192]) {\n"
+    "  %p0 = f32[8388608]{0} parameter(0)\n"
+    "  %p1 = f32[8192,8192]{1,0} parameter(1)\n"
+    "  %copy-start.1 = (f32[8388608]{0:S(5)}, f32[8388608]{0}, u32[]) "
+    "copy-start(f32[8388608]{0} %p0)\n"
+    "  %copy-done.1 = f32[8388608]{0:S(5)} copy-done("
+    "(f32[8388608]{0:S(5)}, f32[8388608]{0}, u32[]) %copy-start.1)\n"
+    + _BIG_DOT +
+    "  ROOT %tuple.1 = (f32[8388608]{0:S(5)}, f32[8192,8192]{1,0}) "
+    "tuple(%copy-done.1, %dot.big)\n"
+    "}\n")
+
+# pure-compute module for critical-path / declared-stream tests
+COMPUTE_ONLY = _HEADER + (
+    "ENTRY %main.1 (p0: f32[4096,4096]) -> f32[4096,4096] {\n"
+    "  %p0 = f32[4096,4096]{1,0} parameter(0)\n"
+    "  %dot.1 = f32[4096,4096]{1,0} dot(f32[4096,4096]{1,0} %p0, "
+    "f32[4096,4096]{1,0} %p0), lhs_contracting_dims={1}, "
+    "rhs_contracting_dims={0}\n"
+    "  %dot.2 = f32[4096,4096]{1,0} dot(f32[4096,4096]{1,0} %dot.1, "
+    "f32[4096,4096]{1,0} %p0), lhs_contracting_dims={1}, "
+    "rhs_contracting_dims={0}\n"
+    "  %dot.3 = f32[4096,4096]{1,0} dot(f32[4096,4096]{1,0} %p0, "
+    "f32[4096,4096]{1,0} %p0), lhs_contracting_dims={1}, "
+    "rhs_contracting_dims={0}\n"
+    "  ROOT %tuple.1 = (f32[4096,4096]{1,0}, f32[4096,4096]{1,0}) "
+    "tuple(%dot.2, %dot.3)\n"
+    "}\n")
+
+
+def _ar_wire_seconds():
+    # f32[1024,8192] = 32 MiB; ring all-reduce over group 4 moves
+    # 2*(3/4) of it; ICI 45 GB/s
+    payload = 1024 * 8192 * 4
+    return 2 * payload * 3 // 4 / (V5E["ici_gbps"] * 1e9)
+
+
+def _dot_seconds(n):
+    return 2 * n ** 3 / (V5E["peak_tflops"] * 1e12)
+
+
+# ------------------------------------------------------------ parsing
+def test_parse_computations_and_instructions():
+    comps, entry, scheduled = ov.parse_hlo_computations(SERIAL_AR)
+    assert scheduled and entry == "main.1"
+    main = comps["main.1"]
+    assert [i.op for i in main.instructions] == [
+        "parameter", "parameter", "dot", "all-reduce", "tuple"]
+    ar = main.by_name["all-reduce.1"]
+    assert "%p0" in ar.operands and "replica_groups" in ar.attrs
+
+
+def test_parse_hlo_transfers_and_summary():
+    hlo = (
+        "  %copy-start.1 = (f32[1024]{0:S(5)}, f32[1024]{0}, u32[]) "
+        "copy-start(f32[1024]{0} %a)\n"
+        "  %copy-done.1 = f32[1024]{0:S(5)} copy-done(%copy-start.1)\n"
+        "  %copy-start.2 = (f32[256]{0}, f32[256]{0}, u32[]) "
+        "copy-start(f32[256]{0} %b)\n"
+        "  %send.1 = (f32[512]{0}, u32[], token[]) send(f32[512]{0} "
+        "%c, token[] %tok), channel_id=1, is_host_transfer=true\n"
+        "  %send-done.1 = token[] send-done(%send.1), channel_id=1\n"
+        "  %recv.1 = (f32[2048]{0}, u32[], token[]) recv(token[] "
+        "%tok2), channel_id=2\n"
+        "  %recv-done.1 = (f32[2048]{0}, token[]) recv-done(%recv.1)\n")
+    recs = ov.parse_hlo_transfers(hlo)
+    # -done halves never double-count; the async result tuple takes its
+    # LARGEST element, not the sum
+    assert [(r["op"], r["bytes"], r["host"]) for r in recs] == [
+        ("copy-start", 4096, True),    # S(5): a host DMA
+        ("copy-start", 1024, False),   # device-local async copy
+        ("send", 2048, True),          # is_host_transfer=true
+        ("recv", 8192, False),         # device point-to-point
+    ]
+    assert ov.transfer_summary(recs) == {
+        "host_transfers": 2, "host_transfer_bytes": 4096 + 2048,
+        "p2p_transfers": 1, "p2p_transfer_bytes": 8192}
+
+
+def test_critical_path_vs_total_compute():
+    s = ov.analyze_hlo(COMPUTE_ONLY, device_kind="TPU v5e")
+    d = _dot_seconds(4096)
+    # three equal dots, two chained: cp = 2 dots, compute total = 3
+    assert abs(s["compute_seconds"] - 3 * d) / d < 0.1
+    assert abs(s["critical_path_seconds"] - 2 * d) / d < 0.1
+    assert s["wire_seconds"] == 0 and s["overlap_fraction"] == 1.0
+
+
+def test_called_computations_are_not_double_counted():
+    """A fusion body's cost is charged at the call site (whose roofline
+    folds the body flops in) — summing the body computation again would
+    report ~2x compute for fully-fused programs."""
+    hlo = _HEADER + (
+        "%fused_computation (param_0: f32[4096,4096]) -> "
+        "f32[4096,4096] {\n"
+        "  %param_0 = f32[4096,4096]{1,0} parameter(0)\n"
+        "  ROOT %dot.f = f32[4096,4096]{1,0} dot(f32[4096,4096]{1,0} "
+        "%param_0, f32[4096,4096]{1,0} %param_0), "
+        "lhs_contracting_dims={1}, rhs_contracting_dims={0}\n"
+        "}\n\n"
+        "ENTRY %main.1 (p0: f32[4096,4096]) -> f32[4096,4096] {\n"
+        "  %p0 = f32[4096,4096]{1,0} parameter(0)\n"
+        "  ROOT %fusion.1 = f32[4096,4096]{1,0} fusion("
+        "f32[4096,4096]{1,0} %p0), kind=kLoop, "
+        "calls=%fused_computation\n"
+        "}\n")
+    s = ov.analyze_hlo(hlo, device_kind="TPU v5e")
+    d = _dot_seconds(4096)
+    assert abs(s["compute_seconds"] - d) / d < 0.1
+    assert abs(s["critical_path_seconds"] - d) / d < 0.1
+
+
+# --------------------------------------------------- classification
+def test_sync_collective_is_serialized_with_window():
+    s = ov.analyze_hlo(SERIAL_AR, total_devices=4, device_kind="TPU v5e")
+    assert s["collectives"] == {"total": 1, "overlapped": 0,
+                                "partially_exposed": 0, "serialized": 1}
+    (node,) = s["nodes"]
+    assert node["classification"] == ov.SERIALIZED
+    assert abs(node["seconds"] - _ar_wire_seconds()) < 1e-6
+    # the big dot is independent of the all-reduce: its ~5.6 ms is the
+    # available window
+    assert node["window_seconds"] > ov.DSO701_MIN_WINDOW_SECONDS
+    assert s["exposed_wire_seconds"] == s["wire_seconds"] > 0
+    assert s["overlap_fraction"] == 0.0
+
+
+def test_async_pair_fully_hidden_is_overlapped():
+    s = ov.analyze_hlo(OVERLAPPED_AR, total_devices=4,
+                       device_kind="TPU v5e")
+    assert s["collectives"]["overlapped"] == 1
+    assert s["exposed_wire_seconds"] == 0.0
+    assert s["overlap_fraction"] == 1.0
+    # the hidden wire must not stretch the critical path beyond the
+    # compute that hides it (start issues at t~0, dot covers the wire)
+    assert s["critical_path_seconds"] < _ar_wire_seconds() + \
+        _dot_seconds(8192)
+
+
+def test_async_pair_partially_hidden():
+    s = ov.analyze_hlo(PARTIAL_AR, total_devices=4, device_kind="TPU v5e")
+    assert s["collectives"]["partially_exposed"] == 1
+    (node,) = s["nodes"]
+    hidden = _dot_seconds(4096)
+    assert abs(node["hidden_seconds"] - hidden) / hidden < 0.1
+    assert 0 < s["exposed_wire_seconds"] < s["wire_seconds"]
+    assert 0.0 < s["overlap_fraction"] < 1.0
+
+
+def test_serialized_host_copy_and_declared_stream():
+    s = ov.analyze_hlo(SERIAL_HOST_COPY, device_kind="TPU v5e")
+    assert s["host_transfers"]["serialized"] == 1
+    (node,) = s["nodes"]
+    assert node["kind"] == ov.KIND_HOST and node["source"] == "hlo"
+    assert node["window_seconds"] > 0  # the dot could have hidden it
+    # a DECLARED stream (engine host_state_bytes_per_step) larger than
+    # what the HLO accounts for adds the residual as one serialized
+    # node whose window is the whole program's compute
+    declared = 8388608 * 4 + (32 << 20)
+    s2 = ov.analyze_hlo(SERIAL_HOST_COPY, device_kind="TPU v5e",
+                        declared_host_wire_bytes=declared)
+    extra = [n for n in s2["nodes"] if n["source"] == "declared"]
+    assert len(extra) == 1 and extra[0]["wire_bytes"] == 32 << 20
+    assert extra[0]["window_seconds"] == s2["compute_seconds"]
+    # and a declared stream already covered by HLO transfers adds none
+    s3 = ov.analyze_hlo(SERIAL_HOST_COPY, device_kind="TPU v5e",
+                        declared_host_wire_bytes=1024)
+    assert not [n for n in s3["nodes"] if n["source"] == "declared"]
+
+
+def test_analysis_is_deterministic():
+    a = ov.analyze_hlo(SERIAL_HOST_COPY, device_kind="TPU v5e",
+                       declared_host_wire_bytes=123456)
+    b = ov.analyze_hlo(SERIAL_HOST_COPY, device_kind="TPU v5e",
+                       declared_host_wire_bytes=123456)
+    assert a == b
+
+
+# ------------------------------------------------------- DSO7x rules
+def _artifact(hlo, name="fix", **kw):
+    kw.setdefault("mesh_axes", {"data": 4})
+    kw.setdefault("device_kind", "TPU v5e")
+    return dsp.ProgramArtifact(name=name, hlo=hlo, **kw)
+
+
+def rule_ids(diags):
+    return sorted(d.rule_id for d in diags)
+
+
+def test_dso701_serialized_collective_with_window():
+    diags = dsp.verify_program(_artifact(SERIAL_AR))
+    assert rule_ids(diags) == ["DSO701"]
+    assert "independent compute" in diags[0].message
+
+
+def test_overlapped_program_is_clean():
+    assert dsp.verify_program(_artifact(OVERLAPPED_AR)) == []
+    # partial exposure is not flagged either (DSO701 is about FULLY
+    # serialized collectives; the exposure metric rides the receipts)
+    assert dsp.verify_program(_artifact(PARTIAL_AR)) == []
+
+
+def test_dso702_serialized_host_transfer():
+    diags = dsp.verify_program(_artifact(SERIAL_HOST_COPY))
+    assert rule_ids(diags) == ["DSO702"]
+    assert "exposed_wire_seconds=" in diags[0].message
+    # declared-stream form (no HLO transfer ops at all, offload tax
+    # known from the engine's wire accounting)
+    diags = dsp.verify_program(_artifact(
+        COMPUTE_ONLY, host_state_wire_bytes=64 << 20))
+    assert rule_ids(diags) == ["DSO702"]
+    assert "declared" in diags[0].message
+
+
+def test_dso703_overlap_model_drift():
+    fresh = dsp.program_overlap(_artifact(SERIAL_AR))
+    ok = _artifact(SERIAL_AR, comm={"overlap": {
+        "wire_seconds": fresh["wire_seconds"],
+        "exposed_wire_seconds": fresh["exposed_wire_seconds"],
+        "collectives": {"total": 1}, "host_transfers": {"total": 0}}})
+    assert "DSO703" not in rule_ids(dsp.verify_program(ok))
+    drifted = _artifact(SERIAL_AR, comm={"overlap": {
+        "wire_seconds": fresh["wire_seconds"] * 3,
+        "exposed_wire_seconds": fresh["exposed_wire_seconds"],
+        "collectives": {"total": 2}, "host_transfers": {"total": 0}}})
+    diags = dsp.verify_program(drifted)
+    assert "DSO703" in rule_ids(diags)
+    msg = next(d.message for d in diags if d.rule_id == "DSO703")
+    assert "wire_seconds" in msg and "collectives 2 -> 1" in msg
+
+
+def test_header_only_artifact_has_no_overlap_claim():
+    art = _artifact("HloModule m, entry_computation_layout={...}\n")
+    assert dsp.program_overlap(art) is None
+    assert dsp.verify_program(art) == []
+
+
+def test_rule_checks_see_past_the_telemetry_node_cap():
+    """The telemetry event caps the node list at 32, but the rule
+    checks must see EVERY node: a program with > 32 serialized
+    collectives plus a declared host stream (appended LAST) still
+    fires DSO702."""
+    body = ["  %p0 = f32[1024,8192]{1,0} parameter(0)",
+            "  %p1 = f32[8192,8192]{1,0} parameter(1)", _BIG_DOT.rstrip()]
+    for i in range(40):
+        body.append(
+            f"  %all-reduce.{i} = f32[1024,8192]{{1,0}} all-reduce("
+            f"f32[1024,8192]{{1,0}} %p0), replica_groups={{{{0,1,2,3}}}}")
+    body.append("  ROOT %tuple.1 = (f32[1024,8192]{1,0}) "
+                "tuple(%all-reduce.0)")
+    hlo = _HEADER + ("ENTRY %main.1 (p0: f32[1024,8192], "
+                     "p1: f32[8192,8192]) -> (f32[1024,8192]) {\n"
+                     + "\n".join(body) + "\n}\n")
+    art = _artifact(hlo, name="train_step",
+                    host_state_wire_bytes=64 << 20)
+    summary = dsp.program_overlap(art)
+    assert summary["collectives"]["total"] == 40
+    assert summary["nodes_truncated"] == 0  # untruncated for the rules
+    assert len(summary["nodes"]) == 41
+    ids = rule_ids(dsp.verify_program(art))
+    assert "DSO702" in ids and "DSO701" in ids
+    # the telemetry-facing default DOES truncate (event size bound)
+    capped = ov.analyze_hlo(hlo, total_devices=4, device_kind="TPU v5e",
+                            declared_host_wire_bytes=64 << 20)
+    assert len(capped["nodes"]) == 32 and capped["nodes_truncated"] == 9
+    assert capped["collectives"]["total"] == 40  # buckets never truncate
+
+
+# ----------------------------------------------- CLI: sarif + ratchet
+def _write_run_dir(tmp_path, hlo, name="fix", **side_extra):
+    progdir = tmp_path / "programs"
+    progdir.mkdir(parents=True, exist_ok=True)
+    (progdir / f"{name}.hlo").write_text(hlo)
+    side = {"artifact_schema_version": 1, "program": name,
+            "hlo_file": f"{name}.hlo", "mesh_axes": {"data": 4},
+            "device_kind": "TPU v5e"}
+    side.update(side_extra)
+    (progdir / f"{name}.json").write_text(json.dumps(side))
+    return tmp_path
+
+
+def test_sarif_round_trips_against_json(tmp_path):
+    run_dir = _write_run_dir(tmp_path / "run", SERIAL_AR)
+    # a second program whose donation verdict downgrades (aliases in
+    # the header, alias bytes 0): an INFO-severity DSP602 — must emit
+    # as a note-level SARIF result and never count as active
+    _write_run_dir(
+        tmp_path / "run",
+        "HloModule m, input_output_alias={ {0}: (0, {}, may-alias) }, "
+        "entry_computation_layout={...}\n",
+        name="downgraded", donate_argnums=[0], alias_size_in_bytes=0)
+    jout, sout = tmp_path / "r.json", tmp_path / "r.sarif"
+    src = tmp_path / "clean.py"
+    src.write_text("x = 1\n")
+    with redirect_stdout(io.StringIO()):
+        rc = dslint_main([str(src), "--programs", str(run_dir),
+                          "--json", str(jout), "--sarif", str(sout)])
+    assert rc == 1  # the DSO701 warning
+    jrep = json.loads(jout.read_text())
+    sarif = json.loads(sout.read_text())
+    assert sarif["version"] == "2.1.0"
+    run = sarif["runs"][0]
+    assert run["tool"]["driver"]["name"] == "dslint"
+    rules = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"DSO701", "DSO702", "DSO703", "DSP601"} <= rules
+    # the round-trip invariant: unsuppressed error/warning results ==
+    # --json violations; info results ride along as notes
+    active = [r for r in run["results"]
+              if not r.get("suppressions")
+              and r["level"] in ("error", "warning")]
+    assert len(active) == jrep["violations"] == 1
+    (res,) = active
+    assert res["ruleId"] == "DSO701" and res["level"] == "warning"
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("fix.hlo")
+    assert loc["region"]["startLine"] == 1
+    notes = [r for r in run["results"] if r["level"] == "note"]
+    assert [r["ruleId"] for r in notes] == ["DSP602"]
+    assert not notes[0].get("suppressions")
+
+
+def test_sarif_marks_baselined_findings_external(tmp_path):
+    run_dir = _write_run_dir(tmp_path / "run", SERIAL_AR)
+    baseline = tmp_path / "baseline.json"
+    with redirect_stdout(io.StringIO()):
+        assert dslint_main(["--programs", str(run_dir), "--baseline",
+                            str(baseline), "--update-baseline"]) == 0
+        sout = tmp_path / "r.sarif"
+        rc = dslint_main(["--programs", str(run_dir), "--baseline",
+                          str(baseline), "--sarif", str(sout)])
+    assert rc == 0
+    results = json.loads(sout.read_text())["runs"][0]["results"]
+    assert len(results) == 1
+    assert results[0]["suppressions"] == [{"kind": "external"}]
+
+
+def test_program_baseline_key_covers_dso7(tmp_path):
+    from deepspeed_tpu.tools.dslint.cli import baseline_key
+    diags = dsp.verify_program(_artifact(SERIAL_AR, name="train_step"))
+    assert baseline_key(diags[0]) == "<programs>|DSO701|train_step"
+
+
+# --------------------------------------------------- receipts/schema
+def test_overlap_fields_are_schema_registered():
+    from deepspeed_tpu.tools.bench_schema import (threshold_for,
+                                                  validate_record)
+
+    rec = {"exposed_wire_seconds": 0.0012, "overlap_fraction": 0.0,
+           "leg_zero2_exposed_wire_seconds": 0.0,
+           "leg_zero2_overlap_fraction": 1.0,
+           "offload_gpt2_large_exposed_wire_seconds": 0.08,
+           "offload_gpt2_large_overlap_fraction": 0.1}
+    assert validate_record(rec) == []
+    assert threshold_for("exposed_wire_seconds") == ("lower", 0.25)
+    assert threshold_for("overlap_fraction") == ("higher", 0.10)
+    assert threshold_for("leg_pipe_exposed_wire_seconds") == \
+        ("lower", 0.25)
+    assert threshold_for("offload_gpt2_xl_overlap_fraction") == \
+        ("higher", 0.10)
+
+
+class _FakeCompiled:
+    def __init__(self, hlo):
+        self._hlo = hlo
+
+    def as_text(self):
+        return self._hlo
+
+    def memory_analysis(self):
+        return None
+
+
+def test_window_cap_degrade_is_loud_not_clean(monkeypatch):
+    """Past MAX_WINDOW_INSTRUCTIONS the independence bitsets degrade to
+    unknown windows — the window-gated rules then CANNOT run, and that
+    must surface as a DSP614 'unverified' warning, never as clean."""
+    monkeypatch.setattr(ov, "MAX_WINDOW_INSTRUCTIONS", 3)
+    art = _artifact(SERIAL_AR)
+    ids = rule_ids(dsp.verify_program(art))
+    assert "DSP614" in ids and "DSO701" not in ids
+    msg = next(d.message for d in dsp.verify_program(_artifact(SERIAL_AR))
+               if d.rule_id == "DSP614")
+    assert "UNVERIFIED" in msg and "window" in msg
+    # the declared stream carries its own window and stays flagged
+    # even on over-cap programs
+    ids2 = rule_ids(dsp.verify_program(_artifact(
+        SERIAL_AR, name="train_step", host_state_wire_bytes=64 << 20)))
+    assert "DSO702" in ids2 and "DSP614" in ids2
+
+
+def test_ledger_transfer_fields_come_from_the_analysis_nodes():
+    """One classification: the entry's host_transfer_bytes must equal
+    the byte total of the overlap analysis' own KIND_HOST hlo-source
+    nodes (the set the declared-residual subtraction uses)."""
+    from deepspeed_tpu.profiling.comm import CommLedger
+
+    ledger = CommLedger(enabled=True, mesh_axes={"data": 4})
+    entry = ledger.record("fwd_bwd", _FakeCompiled(SERIAL_HOST_COPY))
+    ovl = entry["overlap"]
+    hlo_hosts = [n for n in ovl["nodes"]
+                 if n["kind"] == ov.KIND_HOST and n["source"] == "hlo"]
+    assert entry["host_transfers"] == len(hlo_hosts) == 1
+    assert entry["host_transfer_bytes"] == \
+        sum(n["wire_bytes"] for n in hlo_hosts) == 32 << 20
+    assert ovl["hlo_transfer_summary"]["host_transfer_bytes"] == 32 << 20
+
+
+def test_comm_ledger_records_transfers_and_overlap():
+    from deepspeed_tpu.profiling.comm import CommLedger
+
+    ledger = CommLedger(enabled=True, mesh_axes={"data": 4})
+    ledger.overlap_context_fn = lambda: {
+        "host_state_wire_bytes": 48 << 20, "device_kind": "TPU v5e"}
+    entry = ledger.record("train_step", _FakeCompiled(SERIAL_HOST_COPY))
+    # the S(5) copy-start is a host DMA: 8388608 f32 = 32 MiB
+    assert entry["host_transfers"] == 1
+    assert entry["host_transfer_bytes"] == 32 << 20
+    assert entry["p2p_transfers"] == 0
+    ovl = entry["overlap"]
+    # declared 48 MiB minus the 32 MiB the HLO accounts for: one extra
+    # 16 MiB declared-stream node (train_step IS an update program)
+    declared = [n for n in ovl["nodes"] if n["source"] == "declared"]
+    assert len(declared) == 1 and declared[0]["wire_bytes"] == 16 << 20
+    assert ovl["exposed_wire_seconds"] > 0
+    # a NON-update program never carries the declared stream
+    entry2 = ledger.record("fwd_bwd", _FakeCompiled(SERIAL_HOST_COPY))
+    assert not [n for n in entry2["overlap"]["nodes"]
+                if n["source"] == "declared"]
+
+
+def test_step_overlap_stepwise_aggregation():
+    from deepspeed_tpu.profiling.comm import CommLedger
+
+    ledger = CommLedger(enabled=True, mesh_axes={"data": 4})
+    ledger.record("fwd_bwd", _FakeCompiled(SERIAL_AR))
+    ledger.record("apply_update", _FakeCompiled(COMPUTE_ONLY))
+    step = ledger.step_overlap(grad_accumulation_steps=2)
+    single = ledger.entry("fwd_bwd")["overlap"]
+    assert step["program"] == "stepwise"
+    assert abs(step["wire_seconds"] - 2 * single["wire_seconds"]) < 1e-9
+    assert step["exposed_wire_seconds"] == step["wire_seconds"]
+    assert step["overlap_fraction"] == 0.0

@@ -362,14 +362,21 @@ def test_engine_zero_added_host_syncs(cpu_devices, tmp_path, monkeypatch):
     assert comm == base, (f"comm observability added host syncs: {comm} "
                           f"device_get calls vs {base} baseline")
 
-    # program verification on top (DSP6xx, profiling/verify): the
-    # artifact dump happens at the ledger's one compile-time recording
-    # and verify_programs() re-reads compile-time artifacts — running
-    # it INSIDE the counted window must still add ZERO device_get calls
+    # program verification on top (DSP6xx + the DSO7xx overlap
+    # analysis, profiling/verify + profiling/overlap): the artifact
+    # dump happens at the ledger's one compile-time recording and
+    # verify_programs() re-reads compile-time artifacts — running it
+    # INSIDE the counted window, overlap verdict included, must still
+    # add ZERO device_get calls
     def verify(engine):
         report = engine.verify_programs()
         assert report is not None and report["violations"] == 0, (
             [d.format() for d in report["diagnostics"]])
+        # the overlap verdict rode the same compile-time artifacts: a
+        # real claim (not None), computed with no device work
+        assert report["overlap"] is not None
+        assert report["overlap"]["programs"] >= 1
+        assert engine.overlap_receipt() is not None
 
     ver = count_gets(tel_config(
         tmp_path / "v", trace=True,
