@@ -259,13 +259,15 @@ class CommLedger:
                 except Exception as e:
                     logger.debug("comm ledger: overlap context "
                                  "unavailable: %s", e)
+            is_update = str(name) in overlap_prof.UPDATE_PROGRAMS
             declared = (int(ctx.get("host_state_wire_bytes") or 0)
-                        if str(name) in overlap_prof.UPDATE_PROGRAMS
-                        else 0)
+                        if is_update else 0)
             return overlap_prof.analyze_hlo(
                 hlo, total_devices=n_devices,
                 device_kind=ctx.get("device_kind") or "",
-                declared_host_wire_bytes=declared)
+                declared_host_wire_bytes=declared,
+                declared_host_stream=(ctx.get("host_stream_schedule")
+                                      if is_update else None))
         except Exception as e:  # pragma: no cover - fail-soft by design
             logger.debug("comm ledger: overlap analysis failed for %r: "
                          "%s", name, e)

@@ -564,8 +564,70 @@ def _bucket(nodes, kind):
                               if n["classification"] == SERIALIZED)}
 
 
+def _declared_stream_nodes(declared_residual, schedule, compute_total,
+                           specs, hlo_excess_bytes=0):
+    """Model the engine-declared between-dispatch host stream as wire
+    nodes, honoring the declared ISSUE SCHEDULE.
+
+    Serialized (no schedule, ``overlap: false``, or a single chunk):
+    one fully exposed host transfer — the stream chains fetch → update
+    → write-back per chunk, so every wire second is step latency
+    (PERF.md's ~2× offload-tax accounting).
+
+    Pipelined (``overlap: true, chunks: n, prefetch_depth: d``): the
+    double-buffered schedule issues chunk *k+1*'s fetch and chunk *k*'s
+    write-back concurrently with chunk *k*'s update, so the steady-state
+    wire hides behind compute and only the pipeline FILL (first fetch)
+    and DRAIN (last write-back) — one chunk's round trip, ``wire/n`` —
+    plus whatever steady-state wire exceeds the available compute stays
+    exposed.  Components share one compute budget (``compute_total``):
+    seconds of compute can hide at most themselves, so a second
+    declared component (the gradient spill/reload stream) draws from
+    what the first left — the model never claims more hiding than the
+    program holds.  ``hlo_excess_bytes`` is HLO-accounted host wire
+    beyond the state declaration (TPU lowerings can materialize the
+    grad spill as real transfer ops): it reduces the declared grad
+    component the same way ``hlo_host_bytes`` reduces the state one,
+    so no byte is ever counted both as an HLO node and as declared.
+    """
+    schedule = schedule or {}
+    chunks = int(schedule.get("chunks") or 0)
+    pipelined = bool(schedule.get("overlap")) and chunks > 1
+    components = []
+    if declared_residual > 0:
+        components.append(("<declared-host-stream>", "host-stream",
+                           declared_residual,
+                           int(schedule.get("redundant_prefetch_chunks")
+                               or 0)))
+    grad_bytes = max(int(schedule.get("grad_wire_bytes") or 0)
+                     - max(int(hlo_excess_bytes or 0), 0), 0)
+    if grad_bytes > 0:
+        components.append(("<declared-grad-stream>", "grad-stream",
+                           grad_bytes, 0))
+    nodes = []
+    budget = max(float(compute_total), 0.0)
+    bw = specs["host_gbps"] * 1e9
+    for i, (name, op, nbytes, redundant) in enumerate(components):
+        secs = nbytes / bw
+        extra = (redundant * (nbytes / (2 * chunks)) / bw
+                 if pipelined and chunks else 0.0)
+        if not pipelined:
+            hidden = 0.0
+        else:
+            fill_drain = secs / chunks
+            hidden = min(max(secs - fill_drain, 0.0), budget)
+            budget -= hidden
+        nodes.append(_classify(
+            ins_op=op, kind=KIND_HOST, wire_bytes=nbytes + int(
+                extra * bw), seconds=secs + extra, hidden=hidden,
+            window=compute_total, index=-(i + 1), name=name,
+            source="declared"))
+    return nodes
+
+
 def analyze_hlo(hlo_text, total_devices=1, device_kind="",
-                declared_host_wire_bytes=0, max_nodes=32):
+                declared_host_wire_bytes=0, max_nodes=32,
+                declared_host_stream=None):
     """Full overlap analysis of one compiled program.
 
     ``max_nodes`` caps the emitted per-node list (telemetry events must
@@ -578,9 +640,11 @@ def analyze_hlo(hlo_text, total_devices=1, device_kind="",
     no parseable computation.  ``declared_host_wire_bytes`` is the
     engine-declared per-step host-state stream (see
     :data:`UPDATE_PROGRAMS`); the portion not accounted for by HLO-level
-    transfer ops is modeled as one serialized host transfer whose
-    available window is the whole program's compute (the stream runs
-    between dispatches, serialized against all of it).
+    transfer ops is modeled per the engine's declared issue schedule
+    (``declared_host_stream``, :func:`_declared_stream_nodes`): one
+    fully serialized host transfer absent a pipelined schedule, a
+    fill/drain-exposed pipelined transfer under the double-buffered
+    schedule the round-12 overlapped streaming builds.
 
     Known floor: wire nodes inside called computations (a collective in
     a ``while`` body) enter the node list and wire totals ONCE, while
@@ -635,16 +699,11 @@ def analyze_hlo(hlo_text, total_devices=1, device_kind="",
                                   if n["kind"] == KIND_P2P),
     }
     hlo_host_bytes = hlo_transfers["host_transfer_bytes"]
-    declared_residual = max(int(declared_host_wire_bytes or 0)
-                            - hlo_host_bytes, 0)
-    if declared_residual > 0:
-        secs = declared_residual / (specs["host_gbps"] * 1e9)
-        nodes.append({
-            "index": -1, "name": "<declared-host-stream>",
-            "op": "host-stream", "kind": KIND_HOST,
-            "wire_bytes": declared_residual, "seconds": secs,
-            "hidden_seconds": 0.0, "window_seconds": compute_total,
-            "classification": SERIALIZED, "source": "declared"})
+    declared_state = int(declared_host_wire_bytes or 0)
+    declared_residual = max(declared_state - hlo_host_bytes, 0)
+    nodes.extend(_declared_stream_nodes(
+        declared_residual, declared_host_stream, compute_total, specs,
+        hlo_excess_bytes=max(hlo_host_bytes - declared_state, 0)))
     wire = sum(n["seconds"] for n in nodes)
     exposed = sum(n["seconds"] - n["hidden_seconds"] for n in nodes)
     summary = {

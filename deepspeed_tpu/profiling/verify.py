@@ -54,6 +54,18 @@ def _declared_host_wire(ctx, name):
     return int(wire) if wire else None
 
 
+def _declared_host_schedule(ctx, name):
+    """The declared issue schedule of that stream, gated IDENTICALLY to
+    :func:`_declared_host_wire` (change one gate, change both — the
+    DSO703 recorded-vs-reanalyzed consistency depends on it)."""
+    from .overlap import UPDATE_PROGRAMS
+
+    if str(name) not in UPDATE_PROGRAMS:
+        return None
+    sched = ctx.get("host_stream_schedule")
+    return dict(sched) if sched else None
+
+
 def build_engine_artifact(engine, name, compiled):
     """One :class:`ProgramArtifact` from a live compiled executable plus
     the engine's ledgers/metadata; None when the HLO text is
@@ -75,6 +87,7 @@ def build_engine_artifact(engine, name, compiled):
         param_bytes=ctx["param_bytes"], comm=comm_entry,
         master_provenance=ctx["master_provenance"],
         host_state_wire_bytes=_declared_host_wire(ctx, name),
+        host_stream_schedule=_declared_host_schedule(ctx, name),
         device_kind=ctx.get("device_kind"))
 
 
@@ -223,6 +236,7 @@ class ProgramDumper:
             comm=comm_entry,
             master_provenance=ctx.get("master_provenance"),
             host_state_wire_bytes=_declared_host_wire(ctx, name),
+            host_stream_schedule=_declared_host_schedule(ctx, name),
             device_kind=ctx.get("device_kind"))
         try:
             os.makedirs(self.programs_dir, exist_ok=True)

@@ -175,6 +175,24 @@ ZERO_OFFLOAD_GROUP_MB = "offload_group_mb"
 ZERO_OFFLOAD_GROUP_MB_DEFAULT = 1792
 ZERO_ELASTIC_CHECKPOINT = "elastic_checkpoint"
 ZERO_ELASTIC_CHECKPOINT_DEFAULT = True
+# Overlapped chunk streaming (round 12): issue the streamed update as a
+# double-buffered host<->device pipeline — prefetch chunk k+1's host
+# state while chunk k's device update runs, and overlap chunk k's
+# write-back with the next fetch — instead of the serialized
+# load->update->write-back chain.  Same per-chunk math in the same
+# order (bit-identical updates, CI parity-tested); only the ISSUE order
+# of the transfers changes, so the wire hides behind update compute.
+# "auto" (default) overlaps whenever the update streams; false keeps
+# the serialized schedule (the measured-receipts control); true forces
+# the config intent and raises if the update cannot stream at all.
+ZERO_OFFLOAD_OVERLAP = "offload_overlap"
+ZERO_OFFLOAD_OVERLAP_DEFAULT = "auto"
+# Chunks in flight in the overlapped pipeline: depth d keeps d-1
+# prefetched chunks resident on device while one updates (device peak
+# grows by (d-1) chunk states).  2 = classic double buffering; 1 is
+# the serialized schedule (what offload_overlap: false selects).
+ZERO_OFFLOAD_PREFETCH_DEPTH = "offload_prefetch_depth"
+ZERO_OFFLOAD_PREFETCH_DEPTH_DEFAULT = 2
 # Reduced-precision host optimizer state (zero/qstate.py): store the
 # pinned-host (p, m, v) buffers in bf16/fp16 and upcast to fp32 on
 # device inside the streamed update — the offload step is wire-bound

@@ -483,9 +483,8 @@ class DeepSpeedEngine:
                 def _mk(leaf, dtype):
                     if leaf.shape == self.segments.shape:
                         grps = tuple(
-                            jax.device_put(np.zeros((rc, LANES),
-                                                    np.dtype(dtype)),
-                                           self.flat.master_sharding)
+                            self.flat.home_host(np.zeros((rc, LANES),
+                                                         np.dtype(dtype)))
                             for _, rc in bounds)
                         return (grps if self.flat.host_group_bounds
                                 is not None else grps[0])
@@ -563,8 +562,8 @@ class DeepSpeedEngine:
 
             def _zeros_grouped(dtype):
                 grps = tuple(
-                    jax.device_put(np.zeros((rc, LANES), np.dtype(dtype)),
-                                   self.flat.master_sharding)
+                    self.flat.home_host(np.zeros((rc, LANES),
+                                                 np.dtype(dtype)))
                     for _, rc in res_bounds)
                 return (grps if self.flat.host_group_bounds is not None
                         else grps[0])
@@ -819,6 +818,13 @@ class DeepSpeedEngine:
         when offload is off."""
         return getattr(self, "_host_state_bytes_per_step", None)
 
+    def host_stream_schedule(self):
+        """Declared issue schedule of the streamed offload update
+        (``{overlap, prefetch_depth, chunks, groups, form, ...}``) —
+        the structure the overlap analyzer prices the exposed-wire
+        fraction from.  None when the update does not stream."""
+        return getattr(self, "_host_stream_schedule", None)
+
     def fp16_enabled(self):
         return self._config.fp16_enabled
 
@@ -972,6 +978,11 @@ class DeepSpeedEngine:
             # overlapped-streaming work lands), and the chip the
             # roofline/wire tables resolve against
             "host_state_wire_bytes": self.host_state_bytes_per_step(),
+            # the declared ISSUE SCHEDULE of that stream (chunk count,
+            # pipeline depth, form): what the overlap analyzer prices
+            # the exposed fraction from — None means serialized-by-
+            # construction (pre-overlap engines / no streaming)
+            "host_stream_schedule": self.host_stream_schedule(),
             "device_kind": getattr(self.mesh.devices.flat[0],
                                    "device_kind", ""),
         }
@@ -1464,6 +1475,80 @@ class DeepSpeedEngine:
                     f"O(groups), not O(chunks)", ranks=[0])
         self._offload_uniform = offload_uniform
 
+        # Overlapped chunk streaming (round 12): double-buffer the
+        # streamed update — prefetch chunk k+1's host state while chunk
+        # k updates, overlap write-back with the next fetch (scan form:
+        # the carry-held prefetch queue in zero/stream.py; unrolled
+        # form: round-robin group interleave + depth-2 tokens).  Same
+        # per-chunk math with the same canonical SR tags, so the
+        # overlapped and serialized schedules are BIT-IDENTICAL
+        # (tests/unit/test_offload_overlap.py); only transfer issue
+        # order changes.  "auto" overlaps whenever the update streams;
+        # false keeps the serialized schedule as the measured control.
+        overlap_cfg = getattr(self._config.zero_config,
+                              "offload_overlap", "auto")
+        prefetch_cfg = int(getattr(self._config.zero_config,
+                                   "offload_prefetch_depth", 2) or 2)
+        if overlap_cfg is True and prefetch_cfg < 2:
+            raise ValueError(
+                "offload_overlap: true contradicts offload_prefetch_"
+                "depth: 1 (a one-deep pipeline IS the serialized "
+                "schedule); raise the depth or drop offload_overlap")
+        # depth 1 means serialized — an explicit offload_prefetch_depth:
+        # 1 under "auto" selects the serialized control exactly like
+        # offload_overlap: false (the documented knob contract)
+        offload_overlap = (bool(offload_stream)
+                           and overlap_cfg is not False
+                           and prefetch_cfg >= 2)
+        if overlap_cfg is True and self._offload and not offload_stream:
+            raise ValueError(
+                "offload_overlap: true but the offloaded update does not "
+                "stream (eager-offload or the full-buffer one-shot path) "
+                "— there is no chunk pipeline to overlap; drop the key "
+                "or set offload_chunk_mb to force streaming")
+        self._offload_overlap = offload_overlap
+        self._offload_prefetch_depth = (prefetch_cfg if offload_overlap
+                                        else 1)
+
+        # Declared host-stream schedule (profiling/overlap, DSO7xx): the
+        # CPU-path receipt for the pipeline above.  The offload round
+        # trips run BETWEEN dispatches, invisible in any one program's
+        # HLO, so the engine declares not just the wire BYTES
+        # (host_state_bytes_per_step) but the SCHEDULE it actually
+        # built — chunk count, pipeline depth, issue form — and the
+        # overlap analyzer prices the exposed fraction from that.  This
+        # dict describes the program structure the jits below actually
+        # trace; keep them in lockstep.
+        self._host_stream_schedule = None
+        if offload_stream:
+            gb_all = groups or ((0, segments.rows),)
+            n_chunks_total = sum(len(_chunks(grc)) for _, grc in gb_all)
+            self._host_stream_schedule = {
+                "overlap": bool(offload_overlap),
+                "prefetch_depth": int(self._offload_prefetch_depth),
+                "chunks": int(n_chunks_total),
+                "groups": int(len(gb_all)),
+                "form": "scan" if offload_uniform else "unrolled",
+            }
+            if self._offload_grads:
+                # offload_gradients wire: one spill (device->host)
+                # during bwd + one reload (host->device) in the update;
+                # the spill chunks depend only on the grad leaves they
+                # cover, so the backward hides them when overlap is on
+                self._host_stream_schedule["grad_wire_bytes"] = int(
+                    2 * segments.rows * LANES * 4)
+            if self.telemetry.enabled:
+                self.telemetry.gauge("offload/overlap_enabled").set(
+                    float(bool(offload_overlap)))
+                self.telemetry.gauge("offload/prefetch_depth").set(
+                    float(self._offload_prefetch_depth))
+            log_dist(
+                f"ZeRO-Offload: {'double-buffered' if offload_overlap else 'serialized'} "
+                f"chunk streaming ({n_chunks_total} chunks, depth "
+                f"{self._offload_prefetch_depth}, "
+                f"{'scan' if offload_uniform else 'unrolled'} form)",
+                ranks=[0])
+
         # Wire-bytes accounting (PERF.md "ZeRO-Offload wire bytes"): the
         # streamed update moves every host state buffer down and back up
         # exactly once per step — a deterministic figure the bench JSON
@@ -1627,12 +1712,46 @@ class DeepSpeedEngine:
                     res_items.append((squant.leaf_names[li], li))
 
             per_group = [_chunks(grc) for _, grc in gb]
-            jobs, idx = [], [0] * n_g
-            while any(idx[gi] < len(per_group[gi]) for gi in range(n_g)):
+            n_chunks_total = sum(len(c) for c in per_group)
+            # Issue order: round-robin interleave overlaps group A's DMA
+            # with group B's update — but ONLY below the measured scale
+            # breakpoint (stream.ROUND_ROBIN_MAX_CHUNKS: gpt2-xl's 37
+            # chunks ran 19.5 s/step round-robin vs 5.16 sequential —
+            # interleaving spreads each group's in-place DUS chain past
+            # XLA's buffer-forwarding window and every write-back
+            # becomes a host-buffer copy).  Past the breakpoint, and
+            # always under offload_overlap: false (the serialized
+            # control schedule), chunks issue group-sequentially.
+            from .zero.stream import ROUND_ROBIN_MAX_CHUNKS, sr_chunk_tags
+
+            round_robin = (self._offload_overlap
+                           and n_chunks_total <= ROUND_ROBIN_MAX_CHUNKS)
+            if (self._offload_overlap and not round_robin
+                    and not getattr(self, "_rr_disabled_logged", False)):
+                self._rr_disabled_logged = True
+                log_dist(
+                    f"ZeRO-Offload: round-robin group interleave "
+                    f"auto-disabled at {n_chunks_total} chunks (> "
+                    f"{ROUND_ROBIN_MAX_CHUNKS}): issuing group-"
+                    f"sequentially (the measured-faster order at this "
+                    f"scale — PERF.md capacity ladder)", ranks=[0])
+            jobs = []
+            if round_robin:
+                idx = [0] * n_g
+                while any(idx[gi] < len(per_group[gi])
+                          for gi in range(n_g)):
+                    for gi in range(n_g):
+                        if idx[gi] < len(per_group[gi]):
+                            jobs.append((gi,)
+                                        + tuple(per_group[gi][idx[gi]]))
+                            idx[gi] += 1
+            else:
                 for gi in range(n_g):
-                    if idx[gi] < len(per_group[gi]):
-                        jobs.append((gi,) + tuple(per_group[gi][idx[gi]]))
-                        idx[gi] += 1
+                    jobs.extend((gi,) + tuple(c) for c in per_group[gi])
+            # canonical (issue-order-invariant) SR tags, shared with the
+            # scan form: rank by absolute row start
+            sr_tags = sr_chunk_tags(
+                [(gi, r0, gb[gi][0] + r0) for gi, r0, _ in jobs])
 
             cast_parts = {} if (want_cast and self.compute_dtype) else None
             tok2 = tok1 = jnp.float32(0.0)
@@ -1649,7 +1768,14 @@ class DeepSpeedEngine:
                 if g_on_host:
                     g_g = g[gi] if type(g) is tuple else g
                     slices.append(jax.lax.slice_in_dim(g_g, r0, r0 + rc))
-                host_slices = _after(tok2, slices)
+                # depth-2 token (gate on the update two jobs back)
+                # bounds in-flight chunks at two while letting job k+1's
+                # DMA stream during job k's update; the serialized
+                # control (offload_overlap: false) gates on the
+                # IMMEDIATELY previous update — one chunk in flight,
+                # wire fully exposed by construction
+                host_slices = _after(
+                    tok2 if self._offload_overlap else tok1, slices)
                 pm_q = jax.device_put(host_slices[0], dev_sharding)
                 it = iter(host_slices[1:1 + nf])
                 chunk_leaves_q = [
@@ -1684,7 +1810,8 @@ class DeepSpeedEngine:
                     scal = [new_leaves[li] for li, f in enumerate(is_flat)
                             if not f]
                     key_base = squant.chunk_key(
-                        scal[squant.step_scalar_idx], jnp.uint32(jn))
+                        scal[squant.step_scalar_idx],
+                        jnp.uint32(sr_tags[jn]))
                 if squant is None:
                     if skip_bad:
                         new_p = jnp.where(overflow, pm, new_p)
@@ -1809,7 +1936,8 @@ class DeepSpeedEngine:
                 to_dev=lambda x: jax.device_put(x, dev_sharding),
                 to_host=lambda x: jax.device_put(x, host_big),
                 quant=squant, res_masters=res_masters,
-                res_group_leaves=res_group_leaves)
+                res_group_leaves=res_group_leaves,
+                prefetch_depth=self._offload_prefetch_depth)
             if len(out) == 5:
                 (new_masters, new_group_leaves, _, new_resm,
                  new_resf) = out
@@ -1849,7 +1977,19 @@ class DeepSpeedEngine:
                              segments.sizes)
             sq = jnp.float32(0.0)
             finite = jnp.asarray(True)
-            tok2 = tok1 = jnp.float32(0.0)  # depth-2: see update loop
+            # Spill token chains: depth-2 PER GROUP under overlap — each
+            # group's host gradient buffer then depends only on its own
+            # spill writes (plus the grad leaves it covers), so the
+            # streamed update's reads of group g can be scheduled as
+            # soon as g's spill drains, while other groups are still
+            # spilling mid-backward: the optimizer stream starts hot.
+            # (When clipping or fp16 overflow detection is on, the
+            # global sq/finite reductions below re-impose the full
+            # drain — a mathematical barrier, not a scheduling one.)
+            # The serialized control keeps ONE global depth-2 chain.
+            toks = {gi: (jnp.float32(0.0), jnp.float32(0.0))
+                    for gi in range(len(bounds))}
+            glob = (jnp.float32(0.0), jnp.float32(0.0))
             for gi in reversed(range(len(bounds))):
                 gr0, grc = bounds[gi]
                 for r0, rc in reversed(_chunks(grc)):
@@ -1878,6 +2018,8 @@ class DeepSpeedEngine:
                     if cursor < end:  # trailing dp-padding rows
                         parts.append(jnp.zeros(
                             ((end - cursor) * LANES,), jnp.float32))
+                    tok2, tok1 = (toks[gi] if self._offload_overlap
+                                  else glob)
                     parts = _after(tok2, parts)
                     chunk = (parts[0] if len(parts) == 1
                              else jnp.concatenate(parts)).reshape(rc, LANES)
@@ -1886,7 +2028,10 @@ class DeepSpeedEngine:
                     if skip_bad:
                         finite = jnp.logical_and(
                             finite, jnp.all(jnp.isfinite(chunk)))
-                    tok2, tok1 = tok1, chunk[0, 0]
+                    if self._offload_overlap:
+                        toks[gi] = (tok1, chunk[0, 0])
+                    else:
+                        glob = (tok1, chunk[0, 0])
                     hostgs[gi] = jax.lax.dynamic_update_slice(
                         hostgs[gi], jax.device_put(chunk, host_grad_big),
                         (r0, 0))
@@ -3230,10 +3375,11 @@ class DeepSpeedEngine:
             padded = self.flat.repad_unpadded(np.asarray(arr).reshape(-1))
         if type(like) is tuple:
             return tuple(
-                jax.device_put(padded[r0:r0 + rc].astype(g.dtype),
-                               g.sharding)
+                self.flat.home_host(padded[r0:r0 + rc].astype(g.dtype),
+                                    g.sharding)
                 for (r0, rc), g in zip(self.flat.host_group_bounds, like))
-        return jax.device_put(padded.astype(like.dtype), like.sharding)
+        return self.flat.home_host(padded.astype(like.dtype),
+                                   like.sharding)
 
     def _restore_tree_like(self, tree, host_dict):
         """Place host arrays into a pytree matching ``tree``'s structure and
@@ -3252,8 +3398,8 @@ class DeepSpeedEngine:
                 # the current row groups
                 padded = self.flat.repad_unpadded(arr.reshape(-1))
                 leaves.append(tuple(
-                    jax.device_put(padded[r0:r0 + rc].astype(g.dtype),
-                                   g.sharding)
+                    self.flat.home_host_like(
+                        padded[r0:r0 + rc].astype(g.dtype), g)
                     for (r0, rc), g in zip(self.flat.host_group_bounds,
                                            leaf)))
                 continue
@@ -3268,10 +3414,13 @@ class DeepSpeedEngine:
                     f"optimizer state {key}: checkpoint shape {arr.shape} != "
                     f"current {leaf.shape} (DP degree changed); resetting to "
                     f"zeros")
-                leaves.append(jax.device_put(
-                    np.zeros(leaf.shape, leaf.dtype),
-                    getattr(leaf, "sharding", None)))
+                leaves.append(self.flat.home_host_like(
+                    np.zeros(leaf.shape, leaf.dtype), leaf))
                 continue
-            sharding = getattr(leaf, "sharding", None)
-            leaves.append(jax.device_put(arr.astype(leaf.dtype), sharding))
+            # every restored leaf is DONATED by the next step: re-home
+            # through the coordinator so no numpy-owned memory is ever
+            # donated (the two-live-engine / 8-device-dryrun glibc
+            # corruption — see FlatParamCoordinator.home_host)
+            leaves.append(self.flat.home_host_like(
+                arr.astype(leaf.dtype), leaf))
         return jax.tree_util.tree_unflatten(treedef, leaves)

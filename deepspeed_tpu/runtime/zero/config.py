@@ -92,6 +92,30 @@ class DeepSpeedZeroConfig:
             raise ValueError(
                 f"offload_chunk_mb must be a non-negative integer (MB; 0 "
                 f"disables chunking), got {self.offload_chunk_mb!r}")
+        self.offload_overlap = get_scalar_param(
+            d, C.ZERO_OFFLOAD_OVERLAP, C.ZERO_OFFLOAD_OVERLAP_DEFAULT)
+        # identity checks like offload_uniform_chunks: 0/1 must not
+        # alias the booleans through int equality
+        if not (self.offload_overlap is True
+                or self.offload_overlap is False
+                or self.offload_overlap == "auto"):
+            raise ValueError(
+                f"offload_overlap must be true, false, or \"auto\", got "
+                f"{self.offload_overlap!r}")
+        self.offload_prefetch_depth = get_scalar_param(
+            d, C.ZERO_OFFLOAD_PREFETCH_DEPTH,
+            C.ZERO_OFFLOAD_PREFETCH_DEPTH_DEFAULT)
+        if (isinstance(self.offload_prefetch_depth, bool)
+                or not isinstance(self.offload_prefetch_depth, int)
+                or self.offload_prefetch_depth < 1):
+            raise ValueError(
+                f"offload_prefetch_depth must be an integer >= 1 (chunks "
+                f"in flight; 1 = serialized), got "
+                f"{self.offload_prefetch_depth!r}")
+        if self.offload_overlap is True and not self.cpu_offload:
+            raise ValueError(
+                "offload_overlap: true requires cpu_offload: true (it "
+                "schedules the streamed host<->device update pipeline)")
         self.elastic_checkpoint = get_scalar_param(d, C.ZERO_ELASTIC_CHECKPOINT,
                                                    C.ZERO_ELASTIC_CHECKPOINT_DEFAULT)
         self.offload_state_dtype = self._parse_state_dtype(
@@ -216,6 +240,8 @@ class DeepSpeedZeroConfig:
                     offload_chunk_mb=self.offload_chunk_mb,
                     offload_gradients=self.offload_gradients,
                     offload_uniform_chunks=self.offload_uniform_chunks,
+                    offload_overlap=self.offload_overlap,
+                    offload_prefetch_depth=self.offload_prefetch_depth,
                     offload_state_dtype=self.offload_state_dtype,
                     elastic_checkpoint=self.elastic_checkpoint)
 

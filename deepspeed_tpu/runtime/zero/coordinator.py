@@ -33,6 +33,7 @@ shards to the device for the update (reference analog: ``stage2.py:326-342``
 host-kernel path.
 """
 
+import functools
 import os
 
 import jax
@@ -99,6 +100,20 @@ def derive_group_bytes(total_bytes, families):
             MAX_HOST_BUFFERS)
         out = HOST_GROUP_BYTES_MAX
     return out
+
+
+def _identity_copy(x):
+    return x + jnp.zeros((), x.dtype)
+
+
+@functools.lru_cache(maxsize=None)
+def _rehome_jit(sharding):
+    """One cached jitted identity-copy per output sharding (a fresh
+    ``jax.jit(lambda ...)`` per call would re-trace/re-compile for
+    every buffer: jit's cache keys on the function object)."""
+    if sharding is None:
+        return jax.jit(_identity_copy)
+    return jax.jit(_identity_copy, out_shardings=sharding)
 
 
 def split_rows_balanced(total_rows, rows_per, align):
@@ -264,6 +279,52 @@ class FlatParamCoordinator:
                           memory_kind=self._host_memory_kind)
             if cpu_offload else None)
 
+    def home_host(self, buf, sharding=None):
+        """``device_put`` a numpy staging buffer into a (pinned-)host
+        sharding, RE-HOMED through a jitted copy on single-memory-space
+        backends.
+
+        The step programs DONATE every offloaded host buffer, and on
+        CPU a ``device_put`` of numpy can alias the numpy arena —
+        donating that alias lets XLA free (and reuse) memory the numpy
+        allocator still owns.  One live engine usually gets away with
+        it; the second does not: glibc ``corrupted size vs. prev_size``
+        / ``double free`` aborts, observed with two live offload
+        engines in one process and as the 8-device multichip dryrun
+        crash (the elastic leg builds engine #2 while the offload
+        leg's buffers are still registered).  The PR 8 fix laundered
+        the non-offload multi-axis master this way; round 12 routes
+        EVERY numpy-staged host buffer (master, opt-state zeros,
+        gradients, residuals, checkpoint restores) through here.
+
+        On TPU (``memory_spaces`` True) the put crosses into the real
+        ``pinned_host`` space — a fresh allocation, no alias — and a
+        jitted copy would round-trip the state through device memory,
+        re-imposing the init HBM ceiling the host-side flatten removed;
+        so only the aliasing-prone single-space backends launder (the
+        copy is host→host there: zero device cost)."""
+        sharding = sharding if sharding is not None else self.master_sharding
+        out = jax.device_put(buf, sharding)
+        if not self.memory_spaces:
+            with self.mesh:
+                out = _rehome_jit(sharding)(out)
+        return out
+
+    def home_host_like(self, buf, like):
+        """:meth:`home_host` targeting an existing array's sharding —
+        the checkpoint-restore form (restored leaves are DONATED by the
+        next step exactly like freshly initialized ones)."""
+        sharding = getattr(like, "sharding", None)
+        if sharding is None:
+            # scalar/unsharded leaf: still re-home through the jitted
+            # copy so the donated buffer is XLA-owned, not numpy-owned
+            out = jax.device_put(buf)
+            if not self.memory_spaces:
+                with self.mesh:
+                    out = _rehome_jit(None)(out)
+            return out
+        return self.home_host(buf, sharding)
+
     def host_buffer_layout(self):
         """(row-group bounds, buffers-per-family) of the pinned-host
         layout — what the memory observability host-buffer registry
@@ -279,7 +340,7 @@ class FlatParamCoordinator:
         ``offload_gradients``."""
         bounds = self.host_group_bounds or ((0, self.segments.rows),)
         grps = tuple(
-            jax.device_put(np.zeros((rc, LANES), np.float32),
+            self.home_host(np.zeros((rc, LANES), np.float32),
                            self.grad_host_sharding)
             for _, rc in bounds)
         return grps if self.host_group_bounds is not None else grps[0]
@@ -374,7 +435,7 @@ class FlatParamCoordinator:
                 # write-back mechanisms start from the same rounded
                 # point; residuals, when enabled, zero-init)
                 buf = buf.astype(np_master)
-            groups.append(jax.device_put(buf, self.master_sharding))
+            groups.append(self.home_host(buf))
             groups[-1].block_until_ready()
         del bufs, flat_views
         if self.host_group_bounds is None:
@@ -424,10 +485,9 @@ class FlatParamCoordinator:
             # residual when that mechanism is on)
             padded = padded.astype(np_master)
         if self.host_group_bounds is not None:
-            return tuple(jax.device_put(padded[r0:r0 + rc],
-                                        self.master_sharding)
+            return tuple(self.home_host(padded[r0:r0 + rc])
                          for r0, rc in self.host_group_bounds)
-        return jax.device_put(padded, self.master_sharding)
+        return self.home_host(padded)
 
     # -- traced (inside jit) --
     def _flatten_traced(self, tree, dtype=jnp.float32):

@@ -27,15 +27,6 @@ count grows.
 
 What the scan form trades away, deliberately:
 
-- **Round-robin DMA/compute overlap.**  A ``while`` loop executes one
-  iteration at a time; the unrolled form's depth-2 token chain let
-  group A's loads stream during group B's update.  At the sizes where
-  the scan engages (``UNIFORM_MIN_CHUNKS``, default 24 chunks ≈ >12 GB
-  of state at the default chunk size) the round-robin build was itself
-  pathological (19.5 s/step at gpt2-xl vs 5.16 sequential — PERF.md),
-  so the measured status quo there is sequential anyway.  Smaller
-  states keep the round-5 unrolled round-robin path and its measured
-  1.30 s/step at 0.77B.
 - **The folded param cast** (``want_cast``).  ``lax.scan`` can only
   return per-chunk outputs as one stacked array — a full flat
   compute-dtype copy on device, exactly the ~2 bytes/param the round-4
@@ -43,6 +34,28 @@ What the scan form trades away, deliberately:
   instead re-reads the master through the (cheap, 2-ops-per-chunk)
   leaf-direct streamed cast, or composes with ZeRO-3 where no resident
   param copy exists at all.
+
+**Double-buffered pipelining** (round 12, ``prefetch_depth >= 2``):
+the serialized scan body pays the full host wire as step latency by
+construction — iteration *k*'s loads chain behind its own update and
+write-back, so the wire sits idle during compute and vice versa.  With
+``prefetch_depth = d`` the scan carry additionally holds a queue of
+``d-1`` chunks already fetched to device: iteration *k* consumes the
+queue head (fetched ``d-1`` iterations ago), ISSUES the fetch of job
+``k+d-1``, updates, and writes back — and because the fetch, the
+update, and the write-back are mutually independent dataflow within
+one loop body, XLA schedules the next chunk's host→device DMA and this
+chunk's device→host write-back concurrently with the update compute.
+Device peak grows by exactly ``d-1`` chunk states.  The MATH is
+untouched: every chunk consumes the same host values (jobs never share
+rows, so fetching early reads identical data) with the same
+stochastic-rounding tags (keyed by consumed-job index), which is why
+the overlapped and serialized schedules are bit-identical — CI-pinned
+by ``tests/unit/test_offload_overlap.py``.  The last ``d-1``
+iterations have nothing left to prefetch; their fetch is masked by a
+``lax.cond`` (false branch: zeros, no host read), so the pipeline
+moves exactly one sweep of each buffer per step at every depth —
+``host_state_bytes_per_step`` keeps its meaning unchanged.
 
 The three round-4/5 load-bearing invariants survive structurally:
 chunks stay CHAINED (the scan carry serializes iterations — XLA cannot
@@ -70,6 +83,21 @@ import jax.numpy as jnp
 # it compile time is the binding constraint, not step time.
 UNIFORM_MIN_CHUNKS = 24
 
+# Chunk count past which the UNROLLED streamed update stops round-robin
+# interleaving host groups and issues group-sequentially instead.  The
+# round-5 capacity ladder measured the pathology this guards (PERF.md):
+# round-robin was faster at gpt2-large (18 chunks, 2 groups) but
+# collapsed at gpt2-xl (37 chunks: 19.5 s/step vs 5.16 sequential) —
+# interleaving spreads each group's in-place DUS write-back chain
+# across the whole unrolled program, so past the scheduler's buffer-
+# forwarding window XLA materializes host-buffer copies per chunk
+# instead of updating in place.  Sequential order keeps each group's
+# chain contiguous.  The breakpoint sits between the two measured
+# points; tied to UNIFORM_MIN_CHUNKS because the same wall calibrates
+# both (past it the scan form is the default anyway — the unrolled
+# form only reaches this size under offload_uniform_chunks: false).
+ROUND_ROBIN_MAX_CHUNKS = UNIFORM_MIN_CHUNKS
+
 
 def uniform_chunk_jobs(group_bounds, chunk_rows):
     """Round-robin (group, rel_row, abs_row) job list over uniform chunks.
@@ -93,6 +121,20 @@ def uniform_chunk_jobs(group_bounds, chunk_rows):
     return jobs
 
 
+def sr_chunk_tags(jobs):
+    """Issue-order-invariant stochastic-rounding tags: each job's rank
+    among all jobs sorted by absolute row start.  Both streamed forms
+    (this scan and the engine's unrolled chunk loop) key their SR
+    streams with these, so reordering the ISSUE schedule (round-robin /
+    sequential / pipelined) can never change a rounding draw — the
+    bit-identical-schedules contract."""
+    order = sorted(range(len(jobs)), key=lambda j: jobs[j][-1])
+    tags = [0] * len(jobs)
+    for rank, j in enumerate(order):
+        tags[j] = rank
+    return tags
+
+
 def uniform_geometry_ok(group_bounds, chunk_rows):
     """True when every group tiles exactly into ``chunk_rows`` chunks."""
     if not chunk_rows:
@@ -105,7 +147,8 @@ def uniform_scan_update(*, masters, group_leaves, is_flat, opt_treedef,
                         update_fn, hp, overflow, skip_bad, jobs, chunk_rows,
                         lanes, g=None, g_groups=None, coef=None,
                         to_dev=None, to_host=None,
-                        quant=None, res_masters=None, res_group_leaves=None):
+                        quant=None, res_masters=None, res_group_leaves=None,
+                        prefetch_depth=1):
     """Scan the uniform-chunk offload update over ``jobs``.
 
     Args:
@@ -139,6 +182,13 @@ def uniform_scan_update(*, masters, group_leaves, is_flat, opt_treedef,
       res_masters / res_group_leaves: per-group residual buffers for
         the master and for the reduced flat leaves (aligned with
         ``quant.res_leaf_lis``); only with ``quant.error_feedback``.
+      prefetch_depth: chunks in flight (see the module docstring).  1 =
+        the serialized schedule (fetch -> update -> write-back chained
+        per iteration); d >= 2 = software-pipelined double buffering —
+        the carry holds d-1 device-resident prefetched chunks, so each
+        iteration's fetch/update/write-back are mutually independent
+        and the scheduler overlaps wire with compute.  Clamped to the
+        job count.  NUMERICS ARE IDENTICAL at every depth.
 
     Returns ``(new_masters, new_group_leaves, new_scalars[,
     new_res_masters, new_res_group_leaves])`` with the same group
@@ -168,47 +218,82 @@ def uniform_scan_update(*, masters, group_leaves, is_flat, opt_treedef,
             res_slot_by_fi[flat_pos.index(li)] = k
     sr_keys = quant is not None and quant._key0 is not None
 
-    gi_arr = jnp.asarray([j[0] for j in jobs], jnp.int32)
-    r0_arr = jnp.asarray([j[1] for j in jobs], jnp.int32)
-    abs_arr = jnp.asarray([j[2] for j in jobs], jnp.int32)
-    xs = (gi_arr, r0_arr, abs_arr)
-    if sr_keys:
-        xs = xs + (jnp.arange(len(jobs), dtype=jnp.uint32),)
+    n_jobs = len(jobs)
+    depth = max(1, min(int(prefetch_depth or 1), n_jobs))
 
-    def body(carry, xs_c):
-        masters_c, flats_c, _, resm_c, resf_c = carry
-        if sr_keys:
-            gi, r0, r0a, jid = xs_c
-        else:
-            gi, r0, r0a = xs_c
+    xs = {"gi": jnp.asarray([j[0] for j in jobs], jnp.int32),
+          "r0": jnp.asarray([j[1] for j in jobs], jnp.int32),
+          "abs": jnp.asarray([j[2] for j in jobs], jnp.int32)}
+    if sr_keys:
+        # stochastic-rounding tag: the chunk's CANONICAL rank by
+        # absolute row (not the issue-order position), so the pipelined
+        # and serialized schedules — and any unrolled-form job order at
+        # the same geometry — draw identical rounding directions
+        xs["jid"] = jnp.asarray(sr_chunk_tags(jobs), jnp.uint32)
+    if depth > 1:
+        # prefetch indices: iteration k issues job k+d-1's fetch.  The
+        # last d-1 iterations have nothing left to prefetch; their slot
+        # is MASKED (pvalid) — a lax.cond whose false branch returns
+        # zeros, so the tail issues no host reads at all (a scan body
+        # is traced once; peeling the tail would re-trace it, and an
+        # unmasked wrap-around fetch would be redundant wire)
+        pidx = [min(k + depth - 1, n_jobs - 1) for k in range(n_jobs)]
+        xs["pgi"] = jnp.asarray([jobs[p][0] for p in pidx], jnp.int32)
+        xs["pr0"] = jnp.asarray([jobs[p][1] for p in pidx], jnp.int32)
+        xs["pvalid"] = jnp.asarray(
+            [k + depth - 1 < n_jobs for k in range(n_jobs)], bool)
+
+    def fetch(bufs, gi_, r0_):
+        """One chunk's host slices -> device: ``(pm, flats, resm, resf,
+        gg)`` with empty tuples for absent families.  Reading any job's
+        rows commutes with writes to OTHER jobs' rows (jobs never share
+        rows), which is what makes early fetch value-identical."""
+        masters_x, flats_x, resm_x, resf_x = bufs
 
         def read(i):
             def branch(r):
                 pm = jax.lax.dynamic_slice(
-                    masters_c[i], (r, 0), (chunk_rows, lanes))
+                    masters_x[i], (r, 0), (chunk_rows, lanes))
                 fl = tuple(jax.lax.dynamic_slice(
-                    flats_c[i][k], (r, 0), (chunk_rows, lanes))
+                    flats_x[i][k], (r, 0), (chunk_rows, lanes))
                     for k in range(len(flat_pos)))
                 rm = ((jax.lax.dynamic_slice(
-                    resm_c[i], (r, 0), (chunk_rows, lanes)),)
+                    resm_x[i], (r, 0), (chunk_rows, lanes)),)
                     if has_resm else ())
                 rf = tuple(jax.lax.dynamic_slice(
-                    resf_c[i][k], (r, 0), (chunk_rows, lanes))
+                    resf_x[i][k], (r, 0), (chunk_rows, lanes))
                     for k in range(n_resf))
-                if g_on_host:
-                    gg = jax.lax.dynamic_slice(
-                        g_groups[i], (r, 0), (chunk_rows, lanes))
-                    return pm, fl, rm, rf, gg
-                return pm, fl, rm, rf
+                gg = ((jax.lax.dynamic_slice(
+                    g_groups[i], (r, 0), (chunk_rows, lanes)),)
+                    if g_on_host else ())
+                return pm, fl, rm, rf, gg
             return branch
 
-        got = jax.lax.switch(gi, [read(i) for i in range(n_g)], r0)
-        pm_q = to_dev(got[0])
-        chunk_flat_q = [to_dev(x) for x in got[1]]
-        rm_q = tuple(to_dev(x) for x in got[2])
-        rf_q = tuple(to_dev(x) for x in got[3])
+        got = jax.lax.switch(gi_, [read(i) for i in range(n_g)], r0_)
+        return jax.tree_util.tree_map(to_dev, got)
+
+    def body(carry, xs_c):
+        masters_c, flats_c, _, resm_c, resf_c, queue = carry
+        gi, r0, r0a = xs_c["gi"], xs_c["r0"], xs_c["abs"]
+        jid = xs_c.get("jid")
+        bufs = (masters_c, flats_c, resm_c, resf_c)
+        if depth > 1:
+            # consume the chunk fetched d-1 iterations ago; issue the
+            # next fetch NOW — independent of this iteration's update
+            # and write-back, so the DMA overlaps the compute.  Tail
+            # iterations (pvalid False) skip the host reads entirely
+            head = queue[0]
+            fetched = jax.lax.cond(
+                xs_c["pvalid"],
+                lambda: fetch(bufs, xs_c["pgi"], xs_c["pr0"]),
+                lambda: jax.tree_util.tree_map(jnp.zeros_like, head))
+            queue = queue[1:] + (fetched,)
+        else:
+            head = fetch(bufs, gi, r0)
+        pm_q, chunk_flat_tup, rm_q, rf_q, gg_q = head
+        chunk_flat_q = list(chunk_flat_tup)
         if g_on_host:
-            gc = to_dev(got[4]) * coef
+            gc = gg_q[0] * coef
         else:
             gc = jax.lax.dynamic_slice(g, (r0a, 0), (chunk_rows, lanes))
 
@@ -312,17 +397,24 @@ def uniform_scan_update(*, masters, group_leaves, is_flat, opt_treedef,
             gi, [write(i) for i in range(n_g)],
             (r0, new_p_h, tuple(new_flat_h), new_rm_h, new_rf_h))
         return (masters_n, flats_n, tuple(new_scalars), resm_n,
-                resf_n), None
+                resf_n, queue), None
 
     flats0 = tuple(tuple(group_leaves[gi][li] for li in flat_pos)
                    for gi in range(n_g))
     resm0 = tuple(res_masters) if has_resm else ()
     resf0 = (tuple(tuple(res_group_leaves[gi][k] for k in range(n_resf))
                    for gi in range(n_g)) if n_resf else ())
+    # pipeline fill: jobs 0..d-2 fetch from the INITIAL buffers before
+    # the scan starts (no prior write can touch their rows)
+    bufs0 = (tuple(masters), flats0, resm0, resf0)
+    queue0 = tuple(
+        fetch(bufs0, jnp.int32(jobs[j][0]), jnp.int32(jobs[j][1]))
+        for j in range(depth - 1))
     # scalar carry slot: pre-seeded with the originals so an (impossible)
     # empty job list degrades to "no update" rather than garbage
-    carry0 = (tuple(masters), flats0, tuple(scalars0), resm0, resf0)
-    (masters_n, flats_n, scalars_n, resm_n, resf_n), _ = jax.lax.scan(
+    carry0 = (tuple(masters), flats0, tuple(scalars0), resm0, resf0,
+              queue0)
+    (masters_n, flats_n, scalars_n, resm_n, resf_n, _), _ = jax.lax.scan(
         body, carry0, xs)
 
     new_group_leaves = []

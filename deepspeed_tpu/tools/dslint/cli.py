@@ -125,17 +125,19 @@ def lint_config_files(paths) -> List[Diagnostic]:
 
 
 def lint_program_dirs(run_dirs):
-    """(diagnostics, artifact count): DSP6xx verification of dumped
+    """(diagnostics, artifacts): DSP6xx verification of dumped
     program artifacts (see ``tools/dslint/programs.py``).  Raises
     FileNotFoundError when a run dir holds no artifacts (usage error,
-    exit 2)."""
+    exit 2).  The artifacts come back too: the baseline's exposed-wire
+    metric ratchet (DSO704) re-analyzes them against the recorded
+    figures."""
     diags: List[Diagnostic] = []
-    checked = 0
+    artifacts = []
     for run_dir in run_dirs:
-        artifacts = programs.load_run_artifacts(run_dir)
-        checked += len(artifacts)
-        diags.extend(programs.verify_artifacts(artifacts))
-    return diags, checked
+        loaded = programs.load_run_artifacts(run_dir)
+        artifacts.extend(loaded)
+        diags.extend(programs.verify_artifacts(loaded))
+    return diags, artifacts
 
 
 # ---------------------------------------------------------------------------
@@ -162,6 +164,14 @@ def baseline_key(d: Diagnostic) -> str:
 
 
 def load_baseline(path) -> Counter:
+    return load_baseline_data(path)[0]
+
+
+def load_baseline_data(path):
+    """(violation Counter, metrics dict).  ``metrics`` holds the
+    ratcheted per-program figures (``<programs>|exposed_wire_seconds|
+    <name>`` -> seconds) that ``--update-baseline`` records and the
+    DSO704 exposed-wire ratchet checks."""
     with open(path, "r", encoding="utf-8") as f:
         data = json.load(f)
     violations = data.get("violations") if isinstance(data, dict) else None
@@ -171,19 +181,34 @@ def load_baseline(path) -> Counter:
         raise ValueError(
             f"baseline {path}: 'violations' must be an object of "
             f"key -> count, got {type(violations).__name__}")
+    metrics = data.get("metrics") if isinstance(data, dict) else None
+    if metrics is None:
+        metrics = {}
+    if not isinstance(metrics, dict):
+        raise ValueError(
+            f"baseline {path}: 'metrics' must be an object of "
+            f"key -> number, got {type(metrics).__name__}")
     try:
-        return Counter({str(k): int(v) for k, v in violations.items()})
+        metrics = {str(k): float(v) for k, v in metrics.items()}
+    except (TypeError, ValueError) as e:
+        raise ValueError(
+            f"baseline {path}: metric values must be numbers "
+            f"({e})") from e
+    try:
+        return Counter({str(k): int(v)
+                        for k, v in violations.items()}), metrics
     except (TypeError, ValueError) as e:
         raise ValueError(
             f"baseline {path}: violation counts must be integers "
             f"({e})") from e
 
 
-def write_baseline(path, fail) -> dict:
+def write_baseline(path, fail, metrics=None) -> dict:
     data = {
         "schema_version": BASELINE_SCHEMA_VERSION,
         "violations": dict(sorted(Counter(
             baseline_key(d) for d in fail).items())),
+        "metrics": dict(sorted((metrics or {}).items())),
     }
     with open(path, "w", encoding="utf-8") as f:
         json.dump(data, f, indent=2, sort_keys=True)
@@ -345,11 +370,12 @@ def main(argv=None) -> int:
         return 2
     diags.extend(lint_config_files(args.config))
     try:
-        prog_diags, programs_checked = lint_program_dirs(args.programs)
+        prog_diags, prog_artifacts = lint_program_dirs(args.programs)
     except (FileNotFoundError, OSError, ValueError) as e:
         print(f"dslint: cannot load program artifacts: {e}",
               file=sys.stderr)
         return 2
+    programs_checked = len(prog_artifacts)
     if select:
         prog_diags = [d for d in prog_diags if d.rule_id in select]
     if ignore:
@@ -364,19 +390,32 @@ def main(argv=None) -> int:
     baselined = 0
     if args.baseline:
         if args.update_baseline:
-            write_baseline(args.baseline, fail)
+            write_baseline(args.baseline, fail,
+                           metrics=programs.exposure_metrics(
+                               prog_artifacts))
             print(f"dslint: baseline updated: {len(fail)} violation(s) "
                   f"recorded to {args.baseline}")
             baseline = Counter(baseline_key(d) for d in fail)
             fail, baselined = [], len(fail)
         else:
             try:
-                baseline = load_baseline(args.baseline)
+                baseline, base_metrics = load_baseline_data(args.baseline)
             except (OSError, ValueError) as e:
                 print(f"dslint: cannot read --baseline {args.baseline}: "
                       f"{e}", file=sys.stderr)
                 return 2
             fail, baselined = apply_baseline(fail, baseline)
+            # exposed-wire metric ratchet (DSO704): recorded figures
+            # only tighten — growth past tolerance is a NEW violation
+            # the violations baseline cannot absolve
+            ratchet = programs.check_exposure_ratchet(prog_artifacts,
+                                                      base_metrics)
+            if select:
+                ratchet = [d for d in ratchet if d.rule_id in select]
+            if ignore:
+                ratchet = [d for d in ratchet if d.rule_id not in ignore]
+            diags.extend(ratchet)
+            fail.extend(ratchet)
 
     for d in diags:
         if d.suppressed and not args.show_suppressed:

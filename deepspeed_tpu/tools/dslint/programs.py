@@ -185,6 +185,23 @@ register_rule(Rule(
                  "async copy-start/copy-done pairs."))
 
 register_rule(Rule(
+    id="DSO704", name="exposed-wire-regression", severity="warning",
+    summary="a program's exposed wire grew past the baseline-recorded "
+            "figure — the stream is re-serializing",
+    rationale="DSO702 only fires when a host stream is FULLY "
+              "serialized; a change that keeps the pipelined schedule "
+              "but quietly grows its exposed fraction (fewer chunks, a "
+              "shrunk prefetch queue, compute moved off the hiding "
+              "window) would pass it.  The baseline's recorded "
+              "exposed_wire_seconds metric is the ratchet: current "
+              "exposure beyond the recorded value (+tolerance) fails "
+              "CI even though every node still classifies as "
+              "partially overlapped.",
+    autofix_hint="Restore the overlap (offload_overlap/prefetch "
+                 "depth), or re-record with --update-baseline if the "
+                 "growth is intended and reviewed."))
+
+register_rule(Rule(
     id="DSO703", name="overlap-model-drift", severity="warning",
     summary="recorded overlap summary drifts from the HLO re-analysis "
             "beyond tolerance",
@@ -316,6 +333,11 @@ class ProgramArtifact:
     # round trips that run BETWEEN dispatches, invisible in this
     # program's HLO) — producers set it only on update programs
     host_state_wire_bytes: Optional[int] = None
+    # the declared ISSUE SCHEDULE of that stream ({overlap,
+    # prefetch_depth, chunks, groups, form, ...}): how the engine
+    # actually sequences the chunk transfers — what the overlap
+    # analyzer prices exposure from (None = serialized by construction)
+    host_stream_schedule: Optional[dict] = None
     # device_kind string the roofline/wire tables resolve against
     device_kind: Optional[str] = None
 
@@ -347,6 +369,7 @@ class ProgramArtifact:
             "comm": self.comm,
             "master_provenance": self.master_provenance,
             "host_state_wire_bytes": self.host_state_wire_bytes,
+            "host_stream_schedule": self.host_stream_schedule,
             "device_kind": self.device_kind,
         }
 
@@ -399,6 +422,10 @@ def load_run_artifacts(run_dir: str) -> List[ProgramArtifact]:
                 host_state_wire_bytes=(
                     int(side["host_state_wire_bytes"])
                     if side.get("host_state_wire_bytes") is not None
+                    else None),
+                host_stream_schedule=(
+                    dict(side["host_stream_schedule"])
+                    if isinstance(side.get("host_stream_schedule"), dict)
                     else None),
                 device_kind=side.get("device_kind")))
         except (TypeError, ValueError) as e:
@@ -577,11 +604,73 @@ def program_overlap(artifact: ProgramArtifact):
                 device_kind=artifact.device_kind or "",
                 declared_host_wire_bytes=(
                     artifact.host_state_wire_bytes or 0),
+                declared_host_stream=artifact.host_stream_schedule,
                 max_nodes=None)
         except Exception:
             summary = None
         artifact.__dict__["_overlap_summary"] = summary
     return artifact.__dict__["_overlap_summary"]
+
+
+# relative growth of a program's exposed_wire_seconds beyond its
+# baseline-recorded metric that trips DSO704 (generous: the figure is
+# model-derived and roofline-table sensitive, same rationale as the
+# bench_diff exposed_wire_seconds gate)
+EXPOSED_WIRE_RATCHET_TOL = 0.25
+# absolute floor on the ratchet ceiling: a recorded metric at (or
+# rounding to) 0.0 must not make every epsilon of cost-model noise a
+# CI failure — 10 µs of exposure is below anything worth gating
+EXPOSED_WIRE_RATCHET_EPS = 1e-5
+
+
+def exposure_metric_key(name: str) -> str:
+    """Baseline ``metrics`` key for one program's exposed wire."""
+    return f"<programs>|exposed_wire_seconds|{name}"
+
+
+def exposure_metrics(artifacts) -> dict:
+    """``{metric key: exposed_wire_seconds}`` for every artifact that
+    declares a host stream — what ``--update-baseline`` records so a
+    later run can ratchet against it (``check_exposure_ratchet``)."""
+    out = {}
+    for artifact in artifacts:
+        if not artifact.host_state_wire_bytes:
+            continue
+        summary = program_overlap(artifact)
+        if summary is None:
+            continue
+        out[exposure_metric_key(artifact.name)] = round(
+            float(summary["exposed_wire_seconds"]), 9)
+    return out
+
+
+def check_exposure_ratchet(artifacts, baseline_metrics) -> List[Diagnostic]:
+    """DSO704: programs whose re-analyzed exposed wire exceeds the
+    baseline-recorded metric by more than the tolerance.  Programs
+    without a recorded metric are not checked (the ratchet only ever
+    tightens what a reviewer recorded)."""
+    out: List[Diagnostic] = []
+    if not baseline_metrics:
+        return out
+    for artifact in artifacts:
+        recorded = baseline_metrics.get(exposure_metric_key(artifact.name))
+        if recorded is None:
+            continue
+        summary = program_overlap(artifact)
+        if summary is None:
+            continue
+        current = float(summary["exposed_wire_seconds"])
+        ceiling = (float(recorded) * (1.0 + EXPOSED_WIRE_RATCHET_TOL)
+                   + EXPOSED_WIRE_RATCHET_EPS)
+        if current > ceiling:
+            out.append(_pdiag(
+                artifact, "DSO704",
+                f"exposed_wire_seconds grew {float(recorded):.6f} -> "
+                f"{current:.6f} (+{EXPOSED_WIRE_RATCHET_TOL:.0%} "
+                "tolerance exceeded): the offload stream is "
+                "re-serializing — restore the overlapped schedule or "
+                "re-record with --update-baseline"))
+    return out
 
 
 def check_overlap(artifact: ProgramArtifact) -> List[Diagnostic]:

@@ -42,6 +42,39 @@ def test_round6_reduced_precision_fields():
     assert field_type("offload_gpt2_27b_host_state_dtype") is str
 
 
+def test_round12_overlap_row_validates_and_gates():
+    """The overlap-mode record (``bench_offload_capacity.py overlap``):
+    the new ``gpt2_large_overlap`` row rides the existing
+    ``offload_<row>_<field>`` pattern, so its ms/step and exposed-wire
+    receipts are schema-legal AND regression-gated by ``bench_diff``
+    with the standard offload thresholds — a future change that slows
+    the overlapped row or re-grows its exposure trips CI."""
+    from deepspeed_tpu.tools.bench_schema import threshold_for
+
+    record = {
+        "metric": "offload_overlap",
+        "device": "cpu",
+        "offload_gpt2_large_ms_per_step": 660.0,
+        "offload_gpt2_large_exposed_wire_seconds": 0.66,
+        "offload_gpt2_large_overlap_fraction": 0.0,
+        "offload_gpt2_large_overlap_ms_per_step": 480.0,
+        "offload_gpt2_large_overlap_exposed_wire_seconds": 0.012,
+        "offload_gpt2_large_overlap_overlap_fraction": 0.98,
+        "offload_gpt2_large_overlap_host_state_bytes_per_step":
+            9299493376,
+        "offload_gpt2_large_overlap_note": "dryrun",
+    }
+    assert validate_record(record) == []
+    # the bench_diff gate rows the satellite asked for
+    assert threshold_for("offload_gpt2_large_overlap_ms_per_step") == (
+        "lower", 0.10)
+    assert threshold_for(
+        "offload_gpt2_large_overlap_exposed_wire_seconds") == (
+        "lower", 0.25)
+    assert threshold_for(
+        "offload_gpt2_large_overlap_overlap_fraction") == ("higher", 0.10)
+
+
 def test_unknown_and_mistyped_fields_are_flagged():
     probs = validate_record({
         "offload_gpt2_large_host_state_bytes_per_step": "lots",

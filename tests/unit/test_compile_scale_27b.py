@@ -1,0 +1,110 @@
+"""Compile-only 2.7B lowering guard (PR 5/6 claim, CI-pinned).
+
+The round-5 capacity blocker at gpt2-2.7B was COMPILE WALL TIME: the
+unrolled streamed-update program grew linearly with chunk count and the
+fused step stopped compiling inside 30 minutes.  Rounds 5/6 fixed it by
+program shape (the uniform-chunk ``lax.scan`` update traced once), and
+PERF.md claims "the 2.7B program now lowers at gpt2-large's size".
+This file makes that claim a regression test instead of prose: the
+streamed update core LOWERS (trace + StableHLO emission — no buffers
+materialize, so a 32 GB state fits a CI box) at the REAL 2.7B offload
+geometry — the coordinator's own group/chunk layout, the bench config's
+512 MB chunks — in seconds, with program text within a small factor of
+the gpt2-large lowering despite >3× the chunks.  Keeping this green
+keeps ROADMAP item 2's measured capacity ladder (2.7B → 4B → 6B on the
+bench attachment) unblocked from the compile side.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.ops.adam.fused_adam import FusedAdam
+from deepspeed_tpu.ops.op_common import LANES
+from deepspeed_tpu.parallel import make_mesh
+from deepspeed_tpu.runtime.zero import coordinator as coord
+from deepspeed_tpu.runtime.zero import stream
+
+# analytic GPT-2 parameter counts (capacity.GPT2_PRESETS geometry)
+GPT2_LARGE_PARAMS = 774_030_080
+GPT2_27B_PARAMS = 2_649_000_000
+CHUNK_ROWS = (512 << 20) // (LANES * 4)  # the bench row's 512 MB chunks
+
+
+def _lower_update_core(params, cpu_devices):
+    """Lower the uniform-chunk scan update at the real offload layout
+    for ``params`` parameters; returns (jobs, groups, text_len,
+    lower_seconds).  Abstract avals only — nothing state-sized exists.
+    """
+    tmpl = {"w": jax.ShapeDtypeStruct((params,), jnp.float32)}
+    mesh = make_mesh({"data": 1}, devices=cpu_devices[:1])
+    flat = coord.FlatParamCoordinator(
+        mesh, tmpl, stage=2, dp_size=1, cpu_offload=True,
+        uniform_chunk_rows=CHUNK_ROWS, uniform_min_chunks=1)
+    gb = flat.host_group_bounds or ((0, flat.segments.rows),)
+    jobs = stream.uniform_chunk_jobs(gb, CHUNK_ROWS)
+    opt = FusedAdam()
+    st = jax.eval_shape(
+        opt.init_state,
+        jax.ShapeDtypeStruct((gb[0][1], LANES), jnp.float32))
+    leaves, treedef = jax.tree_util.tree_flatten(st)
+    is_flat = [getattr(l, "ndim", 0) == 2 for l in leaves]
+
+    def mk(rc):
+        return jax.ShapeDtypeStruct((rc, LANES), jnp.float32)
+
+    masters = [mk(rc) for _, rc in gb]
+    gls = [[mk(rc) if f else jax.ShapeDtypeStruct(l.shape, l.dtype)
+            for f, l in zip(is_flat, leaves)] for _, rc in gb]
+    g = jax.ShapeDtypeStruct((flat.segments.rows, LANES), jnp.float32)
+
+    def run(ms, gl, gg):
+        m, l, _ = stream.uniform_scan_update(
+            masters=ms, group_leaves=gl, is_flat=is_flat,
+            opt_treedef=treedef, update_fn=opt.update,
+            hp=opt.hyperparams(), overflow=jnp.asarray(False),
+            skip_bad=False, jobs=jobs, chunk_rows=CHUNK_ROWS,
+            lanes=LANES, g=gg, prefetch_depth=2)
+        return m, l
+
+    t0 = time.perf_counter()
+    lowered = jax.jit(run).lower(masters, gls, g)
+    return (len(jobs), len(gb), len(lowered.as_text()),
+            time.perf_counter() - t0)
+
+
+@pytest.fixture
+def injit(monkeypatch):
+    # in-jit placement: the real grouped pinned-host layout on CPU
+    monkeypatch.setenv("DS_OFFLOAD_FORCE_INJIT", "1")
+
+
+def test_27b_update_lowers_at_gpt2_large_size(injit, cpu_devices):
+    jobs_l, groups_l, text_l, secs_l = _lower_update_core(
+        GPT2_LARGE_PARAMS, cpu_devices)
+    jobs_x, groups_x, text_x, secs_x = _lower_update_core(
+        GPT2_27B_PARAMS, cpu_devices)
+    # the real geometries, not toys: 2.7B has >3x the chunks and a
+    # multi-group pinned-host layout (the buffer-count-capped 3584 MB
+    # groups)
+    assert jobs_x >= 3 * jobs_l
+    assert groups_x > groups_l >= 1
+    # THE claim: program size is O(groups) with a tiny constant, NOT
+    # O(chunks) — 2.7B's lowering stays within 2x of gpt2-large's text
+    # (measured ~1.2x; the margin covers group-switch branches)
+    assert text_x <= 2 * text_l, (
+        f"2.7B streamed-update lowering grew to {text_x} chars vs "
+        f"{text_l} at gpt2-large — the O(1)-compile scan property "
+        "regressed (the round-5 >30-min-compile blocker is back)")
+    # lowering is seconds, not minutes — the compile-wall guard
+    assert secs_x < 60, f"2.7B lowering took {secs_x:.1f}s"
+
+
+def test_27b_geometry_streams_grouped(injit, cpu_devices):
+    """The 2.7B layout exercises the multi-group switch (the program
+    shape the bench attachment will compile), and every group tiles
+    exactly into uniform chunks."""
+    _, groups, _, _ = _lower_update_core(GPT2_27B_PARAMS, cpu_devices)
+    assert groups >= 2

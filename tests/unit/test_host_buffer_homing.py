@@ -1,0 +1,113 @@
+"""Donated host buffers must be XLA-owned, never numpy-owned.
+
+The shipped bug this pins (round 12, found while landing overlapped
+streaming; the same family as the PR 8 donated-staging finding): on
+single-memory-space backends a ``jax.device_put`` of a numpy staging
+buffer can ALIAS the numpy arena, and the step programs donate every
+offloaded/flat state buffer — donating the alias lets XLA free (and
+reuse) memory the numpy allocator still owns.  One live engine usually
+got away with it; the second didn't: glibc ``corrupted size vs.
+prev_size`` / ``corrupted double-linked list`` aborts, reproduced with
+(a) two live offload engines in one process and (b) a checkpoint
+restore followed by building another engine — exactly the 8-device
+``dryrun_multichip`` crash after the elastic leg (flagged pre-existing
+in PR 11).  The fix routes every numpy-staged donated buffer through
+``FlatParamCoordinator.home_host`` / ``home_host_like`` (a jitted copy
+re-homes it in the XLA allocator on single-space backends; TPU
+pinned-host puts are real cross-space copies and stay direct).
+
+These tests are the in-tier-1 reproducers: before the fix each aborted
+the interpreter (uncatchable), so them RUNNING TO COMPLETION is the
+assertion that matters; the numeric checks just keep them honest.
+"""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu as deepspeed
+import deepspeed_tpu.runtime.zero.coordinator as coord
+from deepspeed_tpu.parallel import make_mesh
+
+from .simple_model import SimpleModel, base_config, random_batches
+
+HIDDEN = 64
+
+
+def _zero2_engine(cpu_devices, dp):
+    mesh = make_mesh({"data": dp}, devices=cpu_devices[:dp])
+    engine, *_ = deepspeed.initialize(
+        model=SimpleModel(HIDDEN, nlayers=2),
+        config=base_config(zero_optimization={"stage": 2}), mesh=mesh)
+    return engine
+
+
+def _steps(engine, n=2):
+    batch = random_batches(1, engine.train_micro_batch_size_per_gpu(),
+                           HIDDEN, seed=0)[0]
+    return [float(np.asarray(engine.train_batch(iter([batch]))))
+            for _ in range(n)]
+
+
+def test_restore_then_third_engine_does_not_corrupt_heap(
+        cpu_devices, tmp_path):
+    """The 8-device dryrun crash shape, minimized: train → checkpoint →
+    restore into a second engine (different dp) → run → build a THIRD
+    engine and run.  Before the home_host_like fix the restored opt
+    state was a donated alias of checkpoint numpy arrays and the third
+    engine's allocations hit the corrupted arena (glibc abort after
+    the elastic leg, before the record printed)."""
+    e1 = _zero2_engine(cpu_devices, 2)
+    _steps(e1)
+    e1.save_checkpoint(str(tmp_path), tag="t", sync=True)
+    e2 = _zero2_engine(cpu_devices, 1)
+    path, _ = e2.load_checkpoint(str(tmp_path), tag="t")
+    assert path is not None
+    losses2 = _steps(e2, 3)
+    e3 = _zero2_engine(cpu_devices, 2)
+    losses3 = _steps(e3, 3)
+    assert np.all(np.isfinite(losses2)) and np.all(np.isfinite(losses3))
+
+
+def test_two_live_offload_engines_coexist(cpu_devices, monkeypatch):
+    """Two live streamed-offload engines (the other pre-fix abort):
+    each trains independently with finite losses and identical
+    trajectories — no cross-engine host-buffer corruption.  (The
+    overlap parity suite builds engine pairs too; this is the minimal
+    named reproducer.)"""
+    monkeypatch.setenv("DS_OFFLOAD_FORCE_INJIT", "1")
+    monkeypatch.setattr(coord, "HOST_GROUP_BYTES", 2 << 20)
+
+    def make():
+        mesh = make_mesh({"data": 1}, devices=cpu_devices[:1])
+        engine, *_ = deepspeed.initialize(
+            model=SimpleModel(256, nlayers=8),
+            config=base_config(zero_optimization={
+                "stage": 2, "cpu_offload": True, "offload_chunk_mb": 1,
+                "offload_uniform_chunks": False,
+                "offload_state_dtype": "bf16"}), mesh=mesh)
+        return engine
+
+    e1, e2 = make(), make()
+    batch = random_batches(1, e1.train_micro_batch_size_per_gpu(),
+                           256, seed=0)[0]
+    l1 = [float(np.asarray(e1.train_batch(iter([batch]))))
+          for _ in range(4)]
+    l2 = [float(np.asarray(e2.train_batch(iter([batch]))))
+          for _ in range(4)]
+    assert l1 == l2 and np.all(np.isfinite(l1))
+
+
+def test_home_host_rehomes_numpy_staging(cpu_devices):
+    """The mechanism itself: on a single-memory-space backend the
+    homed buffer is a fresh XLA allocation — mutating (or freeing) the
+    numpy staging array afterwards cannot change it."""
+    mesh = make_mesh({"data": 1}, devices=cpu_devices[:1])
+    flat = coord.FlatParamCoordinator(
+        mesh, {"w": np.zeros((64, 64), np.float32)}, stage=2, dp_size=1)
+    staging = np.full((4, 1024), 7.0, np.float32)
+    homed = flat.home_host(staging)
+    homed.block_until_ready()
+    staging.fill(-1.0)
+    assert float(np.asarray(homed)[0, 0]) == 7.0
+    del staging
+    np.testing.assert_array_equal(np.asarray(homed), 7.0)

@@ -1,0 +1,242 @@
+"""Overlapped chunk streaming (round 12): the double-buffered pipeline
+is a SCHEDULE change, not a numerics change.
+
+The load-bearing contract: ``offload_overlap: on`` reorders when the
+host↔device transfers are ISSUED (prefetch chunk k+1 while chunk k
+updates, write-back overlapping the next fetch) but every chunk still
+consumes the same host values with the same canonical stochastic-
+rounding tags, so the overlapped and serialized schedules produce
+BIT-IDENTICAL masters, optimizer state, and error-feedback residuals —
+asserted here exactly (``assert_array_equal``, no tolerance) over ≥20
+steps on the CPU-forced streamed path (``DS_OFFLOAD_FORCE_INJIT``), for
+both reduced host-state forms:
+
+- bf16 + stochastic rounding (the default wire-halving layout), and
+- fp16 (m, v) + error feedback (the residual-carrying layout).
+
+Also pinned: the canonical SR tags make the UNROLLED form's job order
+issue-invariant (round-robin vs the sequential order the gpt2-xl scale
+pathology guard switches to — PERF.md capacity ladder), and the engine
+declares the schedule it actually built.
+"""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu as deepspeed
+import deepspeed_tpu.runtime.zero.coordinator as coord
+from deepspeed_tpu.parallel import make_mesh
+from deepspeed_tpu.runtime.zero import stream
+
+from .simple_model import SimpleModel, base_config, random_batches
+
+HIDDEN = 256
+NLAYERS = 8
+PARITY_STEPS = 20
+
+BF16_SR = {"master": "bf16", "momentum": "bf16", "variance": "bf16"}
+FP16_EF = {"momentum": "fp16", "variance": "fp16",
+           "error_feedback": True}
+
+
+@pytest.fixture
+def force_injit(monkeypatch):
+    monkeypatch.setenv("DS_OFFLOAD_FORCE_INJIT", "1")
+    monkeypatch.setattr(coord, "HOST_GROUP_BYTES", 2 << 20)
+
+
+def _engine(cpu_devices, overlap, uniform=True, state_dtype=None,
+            prefetch_depth=None, offload_gradients=False):
+    zero = {"stage": 2, "cpu_offload": True, "offload_chunk_mb": 1,
+            "offload_uniform_chunks": uniform,
+            "offload_overlap": overlap,
+            "offload_gradients": offload_gradients}
+    if state_dtype is not None:
+        zero["offload_state_dtype"] = dict(state_dtype)
+    if prefetch_depth is not None:
+        zero["offload_prefetch_depth"] = prefetch_depth
+    mesh = make_mesh({"data": 1}, devices=cpu_devices[:1])
+    engine, *_ = deepspeed.initialize(
+        model=SimpleModel(HIDDEN, nlayers=NLAYERS),
+        config=base_config(zero_optimization=zero), mesh=mesh)
+    return engine
+
+
+def _run_steps(engine, steps=PARITY_STEPS):
+    batches = random_batches(steps, engine.train_micro_batch_size_per_gpu(),
+                             HIDDEN, seed=0)
+    return [float(np.asarray(engine.train_batch(iter([b]))))
+            for b in batches]
+
+
+def _state_snapshot(engine):
+    """Every persistent training buffer, bit-for-bit: master (exact
+    fp32 upcast of the storage dtype), flat optimizer leaves, scalars,
+    and error-feedback residuals."""
+    import jax
+
+    snap = {"master": engine.flat.gather_master_unpadded(
+        engine.state["master"])}
+    for li, leaf in enumerate(jax.tree_util.tree_leaves(
+            engine.state["opt"])):
+        if type(leaf) is tuple:
+            for gi, part in enumerate(leaf):
+                snap[f"opt{li}g{gi}"] = np.asarray(jax.device_get(part))
+        else:
+            snap[f"opt{li}"] = np.asarray(jax.device_get(leaf))
+    for name, buf in (engine.state.get("qres") or {}).items():
+        parts = buf if type(buf) is tuple else (buf,)
+        for gi, part in enumerate(parts):
+            snap[f"qres.{name}.g{gi}"] = np.asarray(jax.device_get(part))
+    return snap
+
+
+def _assert_bit_identical(a, b):
+    assert a.keys() == b.keys()
+    for key in a:
+        np.testing.assert_array_equal(a[key], b[key], err_msg=key)
+
+
+@pytest.mark.parametrize("state_dtype", [BF16_SR, FP16_EF],
+                         ids=["bf16_sr", "fp16_ef"])
+def test_overlap_bit_identical_scan_form(force_injit, cpu_devices,
+                                         state_dtype):
+    """THE round-12 contract: 20 steps of the pipelined scan equal 20
+    steps of the serialized scan bit-for-bit — masters, moments, step
+    counters, and (fp16+EF) residuals, not just losses."""
+    eng_on = _engine(cpu_devices, overlap=True, state_dtype=state_dtype)
+    eng_off = _engine(cpu_devices, overlap=False, state_dtype=state_dtype)
+    assert eng_on._offload_overlap and not eng_off._offload_overlap
+    assert eng_on._offload_prefetch_depth >= 2
+    assert eng_off._offload_prefetch_depth == 1
+    losses_on = _run_steps(eng_on)
+    losses_off = _run_steps(eng_off)
+    assert losses_on == losses_off  # exact, not allclose
+    _assert_bit_identical(_state_snapshot(eng_on),
+                          _state_snapshot(eng_off))
+    # fresh random batch per step (stronger parity coverage than one
+    # repeated batch; training PROGRESS is test_offload_stream's job)
+    assert np.all(np.isfinite(losses_on))
+
+
+def test_overlap_bit_identical_deeper_prefetch(force_injit, cpu_devices):
+    """Depth is a scheduling knob too: a 4-deep prefetch queue equals
+    the serialized schedule bit-for-bit."""
+    eng_d4 = _engine(cpu_devices, overlap=True, state_dtype=BF16_SR,
+                     prefetch_depth=4)
+    eng_off = _engine(cpu_devices, overlap=False, state_dtype=BF16_SR)
+    assert eng_d4._offload_prefetch_depth == 4
+    assert _run_steps(eng_d4, 6) == _run_steps(eng_off, 6)
+    _assert_bit_identical(_state_snapshot(eng_d4),
+                          _state_snapshot(eng_off))
+
+
+def test_overlap_bit_identical_unrolled_form(force_injit, cpu_devices):
+    """The unrolled (round-robin) form: overlap off serializes the
+    token chain and issue order, and still matches bit-for-bit — the
+    canonical SR tags are issue-order invariant."""
+    eng_on = _engine(cpu_devices, overlap=True, uniform=False,
+                     state_dtype=BF16_SR)
+    eng_off = _engine(cpu_devices, overlap=False, uniform=False,
+                      state_dtype=BF16_SR)
+    assert not eng_on._offload_uniform
+    assert _run_steps(eng_on, 6) == _run_steps(eng_off, 6)
+    _assert_bit_identical(_state_snapshot(eng_on),
+                          _state_snapshot(eng_off))
+
+
+def test_overlap_composes_with_offload_gradients(force_injit,
+                                                 cpu_devices):
+    """The gradient spill's per-group token chains (the hot-start hook
+    at the grad-flatten point) change scheduling only: parity with the
+    serialized spill, and with fp32 state the schedules are exactly
+    equal by construction."""
+    eng_on = _engine(cpu_devices, overlap=True, offload_gradients=True)
+    eng_off = _engine(cpu_devices, overlap=False, offload_gradients=True)
+    assert eng_on._offload_grads and eng_off._offload_grads
+    assert _run_steps(eng_on, 6) == _run_steps(eng_off, 6)
+    _assert_bit_identical(_state_snapshot(eng_on),
+                          _state_snapshot(eng_off))
+
+
+def test_round_robin_auto_disables_past_breakpoint(force_injit,
+                                                   cpu_devices,
+                                                   monkeypatch):
+    """The gpt2-xl scale pathology guard (PERF.md: 19.5 s/step
+    round-robin vs 5.16 sequential at 37 chunks): past
+    ROUND_ROBIN_MAX_CHUNKS the unrolled form issues group-sequentially.
+    The order switch is observable (the one-shot log latch) and — the
+    point of canonical SR tags — bit-identical to the interleaved
+    order below the breakpoint."""
+    eng_rr = _engine(cpu_devices, overlap=True, uniform=False,
+                     state_dtype=BF16_SR)
+    assert not getattr(eng_rr, "_rr_disabled_logged", False)
+    monkeypatch.setattr(stream, "ROUND_ROBIN_MAX_CHUNKS", 1)
+    eng_seq = _engine(cpu_devices, overlap=True, uniform=False,
+                      state_dtype=BF16_SR)
+    losses_seq = _run_steps(eng_seq, 6)
+    assert eng_seq._rr_disabled_logged
+    losses_rr = _run_steps(eng_rr, 6)
+    assert losses_rr == losses_seq
+    _assert_bit_identical(_state_snapshot(eng_rr),
+                          _state_snapshot(eng_seq))
+
+
+def test_engine_declares_the_schedule_it_built(force_injit, cpu_devices):
+    """The DSO7xx receipt chain starts at the engine's declaration —
+    it must describe the program actually traced."""
+    eng = _engine(cpu_devices, overlap="auto", state_dtype=BF16_SR)
+    sched = eng.host_stream_schedule()
+    assert sched["overlap"] is True
+    assert sched["form"] == "scan" and sched["prefetch_depth"] == 2
+    assert sched["chunks"] >= 2 and sched["groups"] >= 1
+    assert "grad_wire_bytes" not in sched  # no offload_gradients here
+    ctx = eng.program_verify_context()
+    assert ctx["host_stream_schedule"] == sched
+    eng_g = _engine(cpu_devices, overlap=True, offload_gradients=True)
+    sched_g = eng_g.host_stream_schedule()
+    assert sched_g["grad_wire_bytes"] == (
+        2 * eng_g.segments.rows * 1024 * 4)
+
+
+def test_prefetch_depth_one_is_the_serialized_schedule(force_injit,
+                                                       cpu_devices):
+    """The documented knob contract: an explicit depth of 1 under
+    "auto" selects the serialized control exactly like
+    offload_overlap: false — it must not be silently clamped to 2."""
+    eng = _engine(cpu_devices, overlap="auto", state_dtype=BF16_SR,
+                  prefetch_depth=1)
+    assert not eng._offload_overlap
+    assert eng._offload_prefetch_depth == 1
+    assert eng.host_stream_schedule()["overlap"] is False
+    # and the contradiction (overlap FORCED true at depth 1) is loud
+    with pytest.raises(ValueError, match="contradicts"):
+        _engine(cpu_devices, overlap=True, state_dtype=BF16_SR,
+                prefetch_depth=1)
+
+
+def test_forced_overlap_without_streaming_raises(cpu_devices):
+    """offload_overlap: true on a non-streaming (one-shot) offload
+    config is a contradiction the engine must refuse loudly, not
+    silently ignore."""
+    mesh = make_mesh({"data": 1}, devices=cpu_devices[:1])
+    cfg = base_config(zero_optimization={
+        "stage": 2, "cpu_offload": True, "offload_overlap": True})
+    with pytest.raises(ValueError, match="does not stream"):
+        deepspeed.initialize(model=SimpleModel(32, nlayers=1),
+                             config=cfg, mesh=mesh)
+
+
+def test_config_rejects_bad_overlap_keys():
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+    with pytest.raises(ValueError, match="offload_overlap"):
+        DeepSpeedConfig({"train_batch_size": 1, "zero_optimization": {
+            "stage": 2, "cpu_offload": True, "offload_overlap": 1}})
+    with pytest.raises(ValueError, match="offload_prefetch_depth"):
+        DeepSpeedConfig({"train_batch_size": 1, "zero_optimization": {
+            "stage": 2, "cpu_offload": True,
+            "offload_prefetch_depth": 0}})
+    with pytest.raises(ValueError, match="requires cpu_offload"):
+        DeepSpeedConfig({"train_batch_size": 1, "zero_optimization": {
+            "stage": 2, "offload_overlap": True}})

@@ -528,3 +528,208 @@ def test_step_overlap_stepwise_aggregation():
     assert abs(step["wire_seconds"] - 2 * single["wire_seconds"]) < 1e-9
     assert step["exposed_wire_seconds"] == step["wire_seconds"]
     assert step["overlap_fraction"] == 0.0
+
+
+# --------------------------------- round 12: pipelined declared stream
+def test_declared_stream_pipelined_schedule_lowers_exposure():
+    """The declared-schedule model: the same declared bytes classify as
+    one fully serialized node without a schedule, and as a
+    fill/drain-exposed PARTIAL node under the double-buffered schedule
+    — exposure strictly lower, wire identical (the pipeline moves the
+    same one sweep each way)."""
+    declared = 64 << 20
+    base = ov.analyze_hlo(COMPUTE_ONLY, device_kind="TPU v5e",
+                          declared_host_wire_bytes=declared)
+    piped = ov.analyze_hlo(
+        COMPUTE_ONLY, device_kind="TPU v5e",
+        declared_host_wire_bytes=declared,
+        declared_host_stream={"overlap": True, "chunks": 16,
+                              "prefetch_depth": 2, "form": "scan"})
+    assert piped["wire_seconds"] == base["wire_seconds"]
+    assert piped["exposed_wire_seconds"] < base["exposed_wire_seconds"]
+    assert piped["overlap_fraction"] > base["overlap_fraction"]
+    (node,) = [n for n in piped["nodes"] if n["source"] == "declared"]
+    # fill/drain (one chunk's round trip) is always exposed — the model
+    # never claims a free lunch
+    secs = declared / (V5E["host_gbps"] * 1e9)
+    assert node["seconds"] - node["hidden_seconds"] >= secs / 16 - 1e-12
+    assert node["classification"] == ov.PARTIAL
+    # overlap: false (or a single chunk) keeps the serialized verdict
+    ser = ov.analyze_hlo(
+        COMPUTE_ONLY, device_kind="TPU v5e",
+        declared_host_wire_bytes=declared,
+        declared_host_stream={"overlap": False, "chunks": 16})
+    (snode,) = [n for n in ser["nodes"] if n["source"] == "declared"]
+    assert snode["classification"] == ov.SERIALIZED
+
+
+def test_declared_stream_hiding_is_budgeted_by_compute():
+    """Components share ONE compute budget: a declared stream whose
+    steady state exceeds the program's compute stays mostly exposed —
+    the model can never hide more wire than the program holds."""
+    huge = 8 << 30  # ~0.57 s of host wire vs ~17 ms of compute
+    s = ov.analyze_hlo(
+        COMPUTE_ONLY, device_kind="TPU v5e",
+        declared_host_wire_bytes=huge,
+        declared_host_stream={"overlap": True, "chunks": 64,
+                              "prefetch_depth": 2})
+    (node,) = [n for n in s["nodes"] if n["source"] == "declared"]
+    assert node["hidden_seconds"] <= s["compute_seconds"] + 1e-12
+    assert node["hidden_seconds"] > 0
+
+
+def test_declared_grad_stream_rides_the_schedule():
+    """offload_gradients declares its spill+reload wire as a second
+    component; it draws hiding budget AFTER the state stream and the
+    two components never hide more than the program's compute."""
+    sched = {"overlap": True, "chunks": 16, "prefetch_depth": 2,
+             "grad_wire_bytes": 32 << 20}
+    s = ov.analyze_hlo(COMPUTE_ONLY, device_kind="TPU v5e",
+                       declared_host_wire_bytes=64 << 20,
+                       declared_host_stream=sched)
+    declared = [n for n in s["nodes"] if n["source"] == "declared"]
+    assert sorted(n["op"] for n in declared) == ["grad-stream",
+                                                 "host-stream"]
+    hidden = sum(n["hidden_seconds"] for n in declared)
+    assert 0 < hidden <= s["compute_seconds"] + 1e-12
+    # without a schedule the grad stream is not declared at all (the
+    # engine only emits grad_wire_bytes inside a schedule)
+    s2 = ov.analyze_hlo(COMPUTE_ONLY, device_kind="TPU v5e",
+                        declared_host_wire_bytes=64 << 20)
+    assert [n["op"] for n in s2["nodes"]
+            if n["source"] == "declared"] == ["host-stream"]
+
+
+def test_dso702_not_fired_for_pipelined_declared_stream():
+    """The pipelined schedule's declared node is PARTIAL, so DSO702
+    (fully serialized host transfers) stays quiet — re-serializing
+    (schedule overlap False) brings it back."""
+    piped = _artifact(COMPUTE_ONLY, name="train_step",
+                      host_state_wire_bytes=64 << 20,
+                      host_stream_schedule={"overlap": True, "chunks": 8,
+                                            "prefetch_depth": 2})
+    assert "DSO702" not in rule_ids(dsp.verify_program(piped))
+    ser = _artifact(COMPUTE_ONLY, name="train_step",
+                    host_state_wire_bytes=64 << 20,
+                    host_stream_schedule={"overlap": False, "chunks": 8})
+    assert "DSO702" in rule_ids(dsp.verify_program(ser))
+
+
+def test_schedule_survives_the_sidecar_round_trip(tmp_path):
+    """The sidecar carries host_stream_schedule, so the offline
+    ``--programs`` re-analysis prices the SAME schedule the live hook
+    recorded (the DSO703 like-for-like contract)."""
+    sched = {"overlap": True, "chunks": 8, "prefetch_depth": 2,
+             "form": "scan", "groups": 2}
+    art = _artifact(COMPUTE_ONLY, name="train_step",
+                    host_state_wire_bytes=64 << 20,
+                    host_stream_schedule=sched)
+    side = art.sidecar()
+    assert side["host_stream_schedule"] == sched
+    run_dir = _write_run_dir(tmp_path / "run", COMPUTE_ONLY,
+                             name="train_step",
+                             host_state_wire_bytes=64 << 20,
+                             host_stream_schedule=sched)
+    (loaded,) = dsp.load_run_artifacts(str(run_dir))
+    assert loaded.host_stream_schedule == sched
+    assert (dsp.program_overlap(loaded)["exposed_wire_seconds"]
+            == dsp.program_overlap(art)["exposed_wire_seconds"])
+
+
+# ------------------------------------------- DSO704: exposure ratchet
+def test_dso704_exposure_ratchet():
+    """check_exposure_ratchet: growth past the recorded metric's
+    tolerance fires; within-tolerance and unrecorded programs stay
+    quiet."""
+    art = _artifact(COMPUTE_ONLY, name="train_step",
+                    host_state_wire_bytes=64 << 20,
+                    host_stream_schedule={"overlap": True, "chunks": 8,
+                                          "prefetch_depth": 2})
+    metrics = dsp.exposure_metrics([art])
+    key = dsp.exposure_metric_key("train_step")
+    assert list(metrics) == [key] and metrics[key] > 0
+    # within tolerance: quiet
+    assert dsp.check_exposure_ratchet([art], metrics) == []
+    # recorded figure far below current: DSO704 fires
+    tight = {key: metrics[key] / 10.0}
+    diags = dsp.check_exposure_ratchet([art], tight)
+    assert rule_ids(diags) == ["DSO704"]
+    assert "re-serializing" in diags[0].message
+    # unrecorded program: the ratchet only tightens what was recorded
+    assert dsp.check_exposure_ratchet(
+        [_artifact(COMPUTE_ONLY, name="other",
+                   host_state_wire_bytes=64 << 20)], metrics) == []
+
+
+def test_cli_baseline_metrics_ratchet(tmp_path):
+    """End-to-end: --update-baseline records the exposed-wire metric;
+    a later run whose exposure grew past tolerance exits 1 with a
+    DSO704 finding the violations baseline cannot absolve."""
+    sched_on = {"overlap": True, "chunks": 8, "prefetch_depth": 2}
+    run_on = _write_run_dir(tmp_path / "on", COMPUTE_ONLY,
+                            name="train_step",
+                            host_state_wire_bytes=64 << 20,
+                            host_stream_schedule=sched_on)
+    baseline = tmp_path / "baseline.json"
+    with redirect_stdout(io.StringIO()):
+        assert dslint_main(["--programs", str(run_on), "--baseline",
+                            str(baseline), "--update-baseline"]) == 0
+        assert dslint_main(["--programs", str(run_on), "--baseline",
+                            str(baseline)]) == 0
+    data = json.loads(baseline.read_text())
+    key = dsp.exposure_metric_key("train_step")
+    assert data["violations"] == {} and key in data["metrics"]
+    # the regression: the same program re-dumped with a serialized
+    # schedule — exposure grows ~8x past the 25% tolerance
+    run_off = _write_run_dir(tmp_path / "off", COMPUTE_ONLY,
+                             name="train_step",
+                             host_state_wire_bytes=64 << 20,
+                             host_stream_schedule={"overlap": False,
+                                                   "chunks": 8})
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = dslint_main(["--programs", str(run_off), "--baseline",
+                          str(baseline)])
+    assert rc == 1
+    out = buf.getvalue()
+    assert "DSO704" in out and "re-serializing" in out
+
+
+def test_declared_grad_stream_reduced_by_hlo_excess():
+    """TPU lowerings can materialize the grad spill as real HLO host
+    transfers; HLO-accounted bytes beyond the state declaration reduce
+    the declared grad component so nothing is double-counted."""
+    sched = {"overlap": True, "chunks": 8, "prefetch_depth": 2,
+             "grad_wire_bytes": 32 << 20}
+    # SERIAL_HOST_COPY carries one 32 MiB HLO host transfer; declare
+    # 16 MiB of state -> 16 MiB of HLO excess absorbs half the grads
+    s = ov.analyze_hlo(SERIAL_HOST_COPY, device_kind="TPU v5e",
+                       declared_host_wire_bytes=16 << 20,
+                       declared_host_stream=sched)
+    grad = [n for n in s["nodes"] if n["op"] == "grad-stream"]
+    assert len(grad) == 1 and grad[0]["wire_bytes"] == 16 << 20
+    # no HLO transfers at all (CPU form): the full grad declaration
+    s2 = ov.analyze_hlo(COMPUTE_ONLY, device_kind="TPU v5e",
+                        declared_host_wire_bytes=16 << 20,
+                        declared_host_stream=sched)
+    (grad2,) = [n for n in s2["nodes"] if n["op"] == "grad-stream"]
+    assert grad2["wire_bytes"] == 32 << 20
+    # HLO excess >= grad declaration: the grad node disappears
+    s3 = ov.analyze_hlo(SERIAL_HOST_COPY, device_kind="TPU v5e",
+                        declared_host_wire_bytes=0,
+                        declared_host_stream={**sched,
+                                              "grad_wire_bytes": 1 << 20})
+    assert not [n for n in s3["nodes"] if n["op"] == "grad-stream"]
+
+
+def test_dso704_ratchet_has_an_absolute_floor():
+    """A recorded metric of 0.0 must not turn cost-model epsilons into
+    CI failures: the ceiling carries an absolute 10 µs floor."""
+    art = _artifact(COMPUTE_ONLY, name="train_step",
+                    host_state_wire_bytes=1 << 10,
+                    host_stream_schedule={"overlap": True, "chunks": 64,
+                                          "prefetch_depth": 2})
+    cur = dsp.program_overlap(art)["exposed_wire_seconds"]
+    assert 0 < cur < dsp.EXPOSED_WIRE_RATCHET_EPS
+    key = dsp.exposure_metric_key("train_step")
+    assert dsp.check_exposure_ratchet([art], {key: 0.0}) == []
