@@ -150,6 +150,36 @@ def comm_receipts(record, engine, prefix=None):
               file=sys.stderr)
 
 
+def attribution_receipts(record, engine, prefix=None):
+    """Step-time attribution receipts for one bench row (fail-soft):
+    the reconciled budget's predicted step seconds and — once steps
+    have run — the unexplained fraction of the measured p50
+    (``profiling/attribution.py``; the doctor CLI replays the same
+    reconciliation from the run artifacts)."""
+    try:
+        tag = (lambda f: f"{prefix}_{f}") if prefix else (lambda f: f)
+        rec = engine.attribution_receipt()
+        if rec is None:
+            return
+        record[tag("predicted_step_seconds")] = float(
+            rec["predicted_step_seconds"])
+        if rec["step_unexplained_fraction"] is not None:
+            record[tag("step_unexplained_fraction")] = float(
+                rec["step_unexplained_fraction"])
+        check = rec.get("flops_check")
+        if check and check.get("disagrees"):
+            factor = ("" if check.get("ratio") is None
+                      else f"x{check['ratio']:.1f} ")
+            print(f"bench: attribution flops cross-check disagrees "
+                  f"{factor}(jaxpr "
+                  f"{check['flops_compute_seconds']:.6f}s vs roofline "
+                  f"{check['roofline_compute_seconds']:.6f}s)",
+                  file=sys.stderr)
+    except Exception as e:  # pragma: no cover - receipts never gate rows
+        print(f"bench: attribution receipts unavailable: {e!r:.200}",
+              file=sys.stderr)
+
+
 def dsp_receipts(record, engine, prefix=None):
     """Program-verification receipt for one bench row (fail-soft): the
     unsuppressed DSP6xx violation count over every compiled engine
@@ -318,6 +348,7 @@ def main():
     # assumed)
     memory_receipts(record, engine)
     comm_receipts(record, engine)
+    attribution_receipts(record, engine)
     dsp_receipts(record, engine)
 
     # HBM discipline: each engine holds ~5 GB of master+optimizer state for
@@ -487,6 +518,7 @@ def _measure_offload(record, deepspeed, mesh, rng):
                 engine.host_state_bytes_per_step())
             memory_receipts(record, engine, prefix=prefix)
             comm_receipts(record, engine, prefix=prefix)
+            attribution_receipts(record, engine, prefix=prefix)
             dsp_receipts(record, engine, prefix=prefix)
         else:
             record[f"{prefix}_error"] = f"non-finite loss {v}"
@@ -566,6 +598,7 @@ def _measure_offload_xl(record, deepspeed, mesh, rng):
             engine.flat.host_group_bounds or ((0, 0),))
         memory_receipts(record, engine, prefix="offload_gpt2_xl")
         comm_receipts(record, engine, prefix="offload_gpt2_xl")
+        attribution_receipts(record, engine, prefix="offload_gpt2_xl")
         dsp_receipts(record, engine, prefix="offload_gpt2_xl")
     else:
         record["offload_xl_error"] = f"non-finite loss {v}"

@@ -1,6 +1,9 @@
+from .attribution import (flops_cross_check, program_budget, reconcile,
+                          step_budget, straggler_explanation)
 from .comm import (CommLedger, collective_summary, fleet_skew,
                    parse_hlo_collectives, predicted_wire_bytes,
-                   publish_rank_latency, read_fleet_latencies)
+                   publish_rank_latency, read_fleet_latencies,
+                   step_program_weights)
 from .config import DeepSpeedFlopsProfilerConfig, DeepSpeedProfilingConfig
 from .flops_profiler import (FlopsProfiler, count_fn_flops, get_model_profile)
 from .memory import (HostBufferRegistry, MemoryLedger, device_memory_summary,
@@ -21,4 +24,6 @@ __all__ = ["CommLedger", "collective_summary", "parse_hlo_collectives",
            "device_memory_summary", "see_memory_usage", "PEAK_TFLOPS",
            "DEFAULT_PEAK_TFLOPS", "chip_peak_tflops", "chip_specs",
            "model_flops_utilization", "analyze_hlo",
-           "parse_hlo_transfers", "transfer_summary"]
+           "parse_hlo_transfers", "transfer_summary",
+           "step_program_weights", "program_budget", "step_budget",
+           "reconcile", "straggler_explanation", "flops_cross_check"]

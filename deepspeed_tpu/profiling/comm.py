@@ -218,6 +218,37 @@ def collective_summary(ops):
     return entry
 
 
+def step_program_weights(available, grad_accumulation_steps=1,
+                         prefer=None):
+    """``(program_label, [(name, multiplicity), ...])`` pricing ONE
+    optimizer step over the recorded program set ``available`` (any
+    container supporting ``in``).
+
+    The fused program (``train_step`` / ``train_step_compressed``) IS
+    the step when present — ``prefer`` names the one the engine is
+    CURRENTLY dispatching (a 1-bit Adam run holds both, and past
+    freeze_step the compressed one is the live step).  Otherwise the
+    step-wise programs are weighted by the micro-batch multiplicity
+    (``fwd_bwd``·acc + ``accum``·(acc-1) + ``apply_update`` +
+    ``cast_params``).  ``(None, [])`` when nothing priced yet.  The ONE
+    implementation behind :meth:`CommLedger.step_entry`,
+    :meth:`CommLedger.step_overlap`, and the attribution model's step
+    budget — the receipts must never disagree on what "one step" is."""
+    fused_order = ("train_step", "train_step_compressed")
+    if prefer is not None:
+        fused_order = (prefer,) + tuple(f for f in fused_order
+                                        if f != prefer)
+    for fused in fused_order:
+        if fused in available:
+            return fused, [(fused, 1)]
+    acc = max(int(grad_accumulation_steps), 1)
+    weights = [(name, mult) for name, mult in
+               (("fwd_bwd", acc), ("accum", acc - 1),
+                ("apply_update", 1), ("cast_params", 1))
+               if mult > 0 and name in available]
+    return ("stepwise", weights) if weights else (None, [])
+
+
 # ---------------------------------------------------------------------------
 # CommLedger: per-program compile-time collective accounting
 # ---------------------------------------------------------------------------
@@ -333,6 +364,30 @@ class CommLedger:
             e = self._entries.get(str(name))
         return json.loads(json.dumps(e)) if e else None
 
+    def _names(self, with_overlap=False):
+        """Recorded program names (non-None entries; ``with_overlap``
+        narrows to entries carrying an overlap summary) — membership
+        for :func:`step_program_weights` without deep-copying every
+        entry on each print-cadence receipt."""
+        with self._lock:
+            return {n for n, e in self._entries.items()
+                    if e is not None
+                    and (not with_overlap or e.get("overlap"))}
+
+    def overlap_entries(self):
+        """``{name: {"overlap": summary}}`` with the per-node list
+        dropped — the attribution step budget reads only the aggregate
+        fields, and the node list is the bulk of an entry (this runs at
+        the print cadence; see :meth:`_names` for the same rationale)."""
+        out = {}
+        with self._lock:
+            for name, e in self._entries.items():
+                if e is not None and e.get("overlap"):
+                    slim = {k: v for k, v in e["overlap"].items()
+                            if k != "nodes"}
+                    out[name] = {"overlap": json.loads(json.dumps(slim))}
+        return out
+
     def entries(self):
         with self._lock:
             names = list(self._entries)
@@ -355,31 +410,17 @@ class CommLedger:
         (``fwd_bwd``·acc + ``accum``·(acc-1) + ``apply_update`` +
         ``cast_params``), so the receipt prices the whole step, not one
         micro-batch.  None when nothing has compiled yet."""
-        fused_order = ("train_step", "train_step_compressed")
-        if prefer is not None:
-            fused_order = (prefer,) + tuple(f for f in fused_order
-                                            if f != prefer)
-        for fused in fused_order:
-            e = self.entry(fused)
-            if e is not None:
-                return {"program": fused,
-                        "collectives": e["collectives"],
-                        "payload_bytes": e["payload_bytes"],
-                        "wire_bytes": e["wire_bytes"]}
-        acc = max(int(grad_accumulation_steps), 1)
-        weights = {"fwd_bwd": acc, "accum": acc - 1, "apply_update": 1,
-                   "cast_params": 1}
-        totals = {"program": "stepwise", "collectives": 0,
+        program, weights = step_program_weights(
+            self._names(), grad_accumulation_steps, prefer=prefer)
+        if program is None:
+            return None
+        totals = {"program": program, "collectives": 0,
                   "payload_bytes": 0, "wire_bytes": 0}
-        seen = False
-        for name, mult in weights.items():
+        for name, mult in weights:
             e = self.entry(name)
-            if e is not None and mult > 0:
-                seen = True
-                for field in ("collectives", "payload_bytes",
-                              "wire_bytes"):
-                    totals[field] += e[field] * mult
-        return totals if seen else None
+            for field in ("collectives", "payload_bytes", "wire_bytes"):
+                totals[field] += e[field] * mult
+        return totals
 
     def step_wire_bytes(self, grad_accumulation_steps=1, prefer=None):
         """Predicted wire bytes of ONE optimizer step (see
@@ -393,33 +434,17 @@ class CommLedger:
         per-program overlap analyses (same fused-else-stepwise
         resolution as :meth:`step_entry`).  None until a program with
         an overlap summary has compiled."""
-        fused_order = ("train_step", "train_step_compressed")
-        if prefer is not None:
-            fused_order = (prefer,) + tuple(f for f in fused_order
-                                            if f != prefer)
-        for fused in fused_order:
-            e = self.entry(fused)
-            if e is not None and e.get("overlap"):
-                ov = e["overlap"]
-                return {"program": fused,
-                        "wire_seconds": ov["wire_seconds"],
-                        "exposed_wire_seconds":
-                            ov["exposed_wire_seconds"],
-                        "overlap_fraction": ov["overlap_fraction"]}
-        acc = max(int(grad_accumulation_steps), 1)
-        weights = {"fwd_bwd": acc, "accum": acc - 1, "apply_update": 1,
-                   "cast_params": 1}
-        wire = exposed = 0.0
-        seen = False
-        for name, mult in weights.items():
-            e = self.entry(name)
-            if e is not None and e.get("overlap") and mult > 0:
-                seen = True
-                wire += e["overlap"]["wire_seconds"] * mult
-                exposed += e["overlap"]["exposed_wire_seconds"] * mult
-        if not seen:
+        program, weights = step_program_weights(
+            self._names(with_overlap=True), grad_accumulation_steps,
+            prefer=prefer)
+        if program is None:
             return None
-        return {"program": "stepwise", "wire_seconds": wire,
+        wire = exposed = 0.0
+        for name, mult in weights:
+            ov = self.entry(name)["overlap"]
+            wire += ov["wire_seconds"] * mult
+            exposed += ov["exposed_wire_seconds"] * mult
+        return {"program": program, "wire_seconds": wire,
                 "exposed_wire_seconds": exposed,
                 "overlap_fraction": (1.0 - exposed / wire) if wire > 0
                 else 1.0}

@@ -1,0 +1,208 @@
+"""Offline step-time doctor: replay a run dir into a reconciled
+per-rank attribution verdict.
+
+``python -m deepspeed_tpu.profiling.doctor <run_dir>`` composes the
+artifacts a telemetry-enabled run already left behind —
+
+- ``<run_dir>/programs/`` sidecars (``profiling.program_dump``): the
+  compiled programs' overlap analyses, re-analyzed from the dumped HLO
+  (full node set, never the telemetry-truncated summary);
+- ``events-rank*.jsonl``: per-rank measured step latency (median of
+  the last window of ``comm``/``latency`` snapshots) and the per-rank
+  driver seconds from ``attribution`` events;
+- ``latency-rank*.json``: the skew-exchange files, as the measured
+  fallback for runs whose event streams are gone —
+
+into one fleet-wide verdict: a per-rank phase table (compute / exposed
+collective / host stream / driver / **unexplained**), per-rank
+predicted-vs-measured drift, and a straggler explanation naming the
+phase the slowest rank's extra time sits in.  Exit 0 on a verdict, 2
+when the run dir holds no usable artifacts (usage error, same
+convention as ``dslint --programs``).
+
+Also reachable as ``telemetry report --doctor`` (one section of the
+run report).  All host work on static artifacts — runnable anywhere
+the run dir is mounted.
+"""
+
+import argparse
+import json
+import sys
+
+from . import attribution
+
+
+def _artifact_summaries(run_dir):
+    """{name: overlap summary} re-analyzed from the run dir's dumped
+    program artifacts.  Raises FileNotFoundError/ValueError like the
+    dslint ``--programs`` loader (usage errors, never tracebacks)."""
+    from ..tools.dslint import programs as dsp
+
+    summaries = {}
+    for artifact in dsp.load_run_artifacts(str(run_dir)):
+        summary = dsp.program_overlap(artifact)
+        if summary is not None:
+            summaries[artifact.name] = summary
+    return summaries
+
+
+def _measured_and_driver(run_dir, window):
+    """(measured {stream: p50 seconds}, driver {stream: seconds},
+    flops_checks {stream: dict}) from the run dir's event streams, with
+    the latency-rank files as the measured fallback."""
+    from ..telemetry import events as ev
+    from ..telemetry.report import measured_latencies
+
+    records = ev.read_events(str(run_dir))
+    measured = measured_latencies(records, window=window)
+    driver = {}
+    flops_checks = {}
+    for rec in records:
+        if rec.get("type") != ev.EVENT_ATTRIBUTION:
+            continue
+        stream = str(rec.get("_stream"))
+        data = rec.get("data", {})
+        phases = data.get("phases") or {}
+        if phases.get(attribution.PHASE_DRIVER) is not None:
+            driver[stream] = float(phases[attribution.PHASE_DRIVER])
+        if data.get("flops_check"):
+            flops_checks[stream] = data["flops_check"]
+    if not measured:
+        from . import comm as comm_prof
+
+        # relative staleness guard (fresh_fleet_snapshots): dead ranks
+        # from an earlier, larger life must not enter the verdict
+        fleet = attribution.fresh_fleet_snapshots(
+            comm_prof.read_fleet_latencies(str(run_dir)))
+        measured = {f"rank{rank}": float(snap["p50"])
+                    for rank, snap in fleet.items()
+                    if snap.get("p50") and float(snap["p50"]) > 0}
+    return measured, driver, flops_checks
+
+
+def doctor_run_dir(run_dir, grad_accumulation_steps=1,
+                   window=attribution.DEFAULT_MEASURED_WINDOW):
+    """The full doctor verdict for one run dir (see module docstring).
+
+    Raises ``FileNotFoundError``/``ValueError`` when the run dir holds
+    no program artifacts (the CLI maps both to exit 2)."""
+    summaries = _artifact_summaries(run_dir)
+    entries = {name: {"overlap": s} for name, s in summaries.items()}
+    measured, driver, flops_checks = _measured_and_driver(run_dir, window)
+    ranks = {}
+    for stream in sorted(measured):
+        budget = attribution.step_budget(
+            entries, grad_accumulation_steps,
+            driver_seconds=driver.get(stream, 0.0))
+        if budget is None:
+            continue
+        rec = attribution.reconcile(budget, measured[stream])
+        if stream in flops_checks:
+            rec["flops_check"] = flops_checks[stream]
+        ranks[stream] = rec
+    # measured-less verdict: the budget alone (predicted receipts with
+    # no latency evidence — still worth printing, never a silent {})
+    budget = attribution.step_budget(entries, grad_accumulation_steps)
+    return {
+        "run_dir": str(run_dir),
+        "programs": sorted(summaries),
+        "budget": budget,
+        "ranks": ranks,
+        "straggler": attribution.straggler_explanation(ranks),
+    }
+
+
+def _ms(v):
+    return "-" if v is None else f"{v * 1e3:9.3f}"
+
+
+def format_verdict(verdict):
+    """Human-readable doctor section (shared with ``telemetry report
+    --doctor``)."""
+    lines = []
+    budget = verdict.get("budget")
+    if budget is None:
+        return ["  (no program with an overlap analysis — enable "
+                "profiling.program_dump)"]
+    lines.append(
+        f"  step program: {budget['program']} — predicted "
+        f"{budget['predicted_step_seconds'] * 1e3:.3f} ms/step "
+        f"(critical path {budget['critical_path_seconds'] * 1e3:.3f} ms)")
+    ranks = verdict.get("ranks") or {}
+    if not ranks:
+        lines.append("  (no measured step latency in this run dir — "
+                     "predicted budget only)")
+        return lines
+    head = (f"  {'rank':<10} {'measured':>9} {'predicted':>9} "
+            + " ".join(f"{p:>17}" for p in attribution.PHASES)
+            + f" {'unexpl%':>8}")
+    lines.append(head)
+    for stream in sorted(ranks):
+        rec = ranks[stream]
+        frac = rec["step_unexplained_fraction"]
+        cells = " ".join(
+            f"{_ms(rec['phases'].get(p)):>15}ms" for p in attribution.PHASES)
+        lines.append(
+            f"  {stream:<10} {_ms(rec['measured_step_seconds'])}"
+            f" {_ms(rec['predicted_step_seconds'])} {cells} "
+            + ("-" if frac is None else f"{frac:7.1%}"))
+    for stream in sorted(ranks):
+        check = ranks[stream].get("flops_check")
+        if check and check.get("disagrees"):
+            factor = ("" if check.get("ratio") is None
+                      else f"x{check['ratio']:.1f} ")
+            lines.append(
+                f"  WARNING [{stream}]: flops profiler and HLO roofline "
+                f"disagree {factor}on the compute term "
+                f"(jaxpr {check['flops_compute_seconds'] * 1e3:.3f} ms "
+                f"vs roofline "
+                f"{check['roofline_compute_seconds'] * 1e3:.3f} ms)")
+    straggler = verdict.get("straggler")
+    if straggler is not None:
+        lines.append(
+            f"  straggler: rank {straggler['slowest_rank']} runs "
+            f"{straggler['extra_seconds'] * 1e3:.3f} ms over the fleet "
+            f"median ({straggler['median_seconds'] * 1e3:.3f} ms) — "
+            f"extra time attributed to "
+            f"{straggler['attributed_phase']} "
+            f"({straggler['attributed_seconds'] * 1e3:+.3f} ms vs fleet)")
+    return lines
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.profiling.doctor",
+        description="Reconcile a run dir's predicted step budget "
+                    "(program sidecars) against its measured per-rank "
+                    "latency (telemetry events) into a per-phase "
+                    "attribution verdict.")
+    ap.add_argument("run_dir", help="telemetry run directory (holds "
+                                    "programs/ sidecars + event streams)")
+    ap.add_argument("--grad-accum", type=int, default=1,
+                    help="micro-batch multiplicity for step-wise "
+                         "program sets (fused step programs ignore it)")
+    ap.add_argument("--window", type=int,
+                    default=attribution.DEFAULT_MEASURED_WINDOW,
+                    help="measured latency = median of the last N "
+                         "latency snapshots per rank")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the verdict as JSON")
+    args = ap.parse_args(argv)
+    try:
+        verdict = doctor_run_dir(args.run_dir,
+                                 grad_accumulation_steps=args.grad_accum,
+                                 window=args.window)
+    except (FileNotFoundError, OSError, ValueError) as e:
+        print(f"doctor: cannot load run artifacts: {e}", file=sys.stderr)
+        return 2
+    if args.as_json:
+        json.dump(verdict, sys.stdout, indent=1, sort_keys=True)
+        sys.stdout.write("\n")
+        return 0
+    print(f"step-time attribution: {verdict['run_dir']}")
+    print("\n".join(format_verdict(verdict)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -706,6 +706,14 @@ def analyze_hlo(hlo_text, total_devices=1, device_kind="",
         hlo_excess_bytes=max(hlo_host_bytes - declared_state, 0)))
     wire = sum(n["seconds"] for n in nodes)
     exposed = sum(n["seconds"] - n["hidden_seconds"] for n in nodes)
+    # per-kind exposed split over the FULL node set (the attribution
+    # model's phase table needs "exposed collective wire" apart from
+    # "exposed host stream", and the truncated per-node list below
+    # cannot reconstruct it)
+    exposed_by_kind = {KIND_COLLECTIVE: 0.0, KIND_HOST: 0.0,
+                       KIND_P2P: 0.0}
+    for n in nodes:
+        exposed_by_kind[n["kind"]] += n["seconds"] - n["hidden_seconds"]
     summary = {
         "overlap_schema_version": OVERLAP_SCHEMA_VERSION,
         "device_kind": specs["device_kind"],
@@ -715,6 +723,7 @@ def analyze_hlo(hlo_text, total_devices=1, device_kind="",
         "compute_seconds": compute_total,
         "wire_seconds": wire,
         "exposed_wire_seconds": exposed,
+        "exposed_by_kind": exposed_by_kind,
         "overlap_fraction": (1.0 - exposed / wire) if wire > 0 else 1.0,
         "collectives": _bucket(nodes, KIND_COLLECTIVE),
         "host_transfers": _bucket(nodes, KIND_HOST),

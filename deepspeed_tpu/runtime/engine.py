@@ -769,13 +769,21 @@ class DeepSpeedEngine:
                         f"micro_steps={self.micro_steps}"),
                     on_fire=self._telemetry_watchdog_fire).start()
             log_dist(f"resilience enabled: {rcfg}", ranks=[0])
-        if self._step_latencies is None and self.telemetry.enabled:
-            # no watchdog armed, but telemetry wants the per-rank
-            # step-latency/skew export: the ring self-tracks beats
-            # (watchdog.beat feeds it otherwise — see _step_beat)
-            from ..profiling.step_profiler import StepLatencyRing
+        from ..profiling.step_profiler import StepLatencyRing
 
+        if self._step_latencies is None:
+            # no watchdog armed: the ring self-tracks beats
+            # (watchdog.beat feeds it otherwise — see _step_beat).
+            # Always on since round 13 (O(1) host work per step): the
+            # telemetry skew export AND the attribution receipt's
+            # measured side both read it, and bench/dryrun engines run
+            # with telemetry off
             self._step_latencies = StepLatencyRing()
+        # host-side driver seconds per step (batch fetch through the
+        # async dispatch enqueue; the blocking scalar fetch is device
+        # time, not driver), recorded by a perf_counter bracket the
+        # train path already pays — the attribution driver phase
+        self._driver_latencies = StepLatencyRing()
 
         if self._config.dump_state:
             self._config.print("DeepSpeedEngine configuration")
@@ -955,6 +963,85 @@ class DeepSpeedEngine:
         return self.comm_ledger.step_overlap(
             self.gradient_accumulation_steps(),
             prefer=self._active_step_program())
+
+    def driver_seconds_per_step(self):
+        """Steady-state host-side driver seconds per step (batch fetch
+        through dispatch enqueue) — the attribution model's driver
+        phase.  MIN over the recent window, not the median: the first
+        dispatch of each program traces+compiles inside the same
+        bracket, and on short runs (2-step dryrun legs) that spike
+        would dominate any averaging estimator; a genuinely slow input
+        pipeline raises every sample, so the min still carries the
+        straggler signal.  0.0 until a step has run."""
+        vals = self._driver_latencies.recent()
+        return float(min(vals)) if vals else 0.0
+
+    def attribution_receipt(self):
+        """Reconciled step-time attribution (``profiling/attribution``):
+        the predicted per-step budget — roofline compute, exposed
+        collective wire, declared host stream (all from the comm
+        ledger's compile-time overlap analyses), host driver time —
+        next to the measured per-step p50 from the latency ring, with
+        the residual as the ``unexplained`` phase and
+        ``step_unexplained_fraction``.  Host arithmetic on
+        already-captured scalars: ZERO device syncs (covered by the
+        device_get-counting telemetry test).  None until a step program
+        with an overlap analysis has compiled or with the ledger off.
+
+        When the flops profiler has run, the receipt also carries
+        ``flops_check`` — the jaxpr-counted compute term as an
+        independent cross-check on the HLO roofline (>2x disagreement
+        flagged)."""
+        from ..profiling import attribution as attr_prof
+
+        if not self.comm_ledger.enabled:
+            return None
+        budget = attr_prof.step_budget(
+            self.comm_ledger.overlap_entries(),
+            self.gradient_accumulation_steps(),
+            prefer=self._active_step_program(),
+            driver_seconds=self.driver_seconds_per_step())
+        if budget is None:
+            return None
+        snap = self._step_latencies.latency_snapshot()
+        receipt = attr_prof.reconcile(
+            budget, snap["p50"] if snap["n"] else None)
+        prof = (self.flops_profiler.profile
+                if self.flops_profiler is not None else None)
+        if prof is not None and prof.flops:
+            from ..profiling.utilization import chip_specs
+
+            specs = chip_specs(getattr(self.mesh.devices.flat[0],
+                                       "device_kind", ""))
+            receipt["flops_check"] = attr_prof.flops_cross_check(
+                budget, prof.flops, specs["peak_tflops"] * 1e12)
+        return receipt
+
+    def _sample_attribution(self):
+        """Attribution gauges + EVENT_ATTRIBUTION at the
+        steps_per_print cadence.  Host arithmetic on already-recorded
+        floats only — ZERO added per-step syncs (the device_get-counting
+        telemetry test covers an attribution-enabled run)."""
+        if not self.telemetry.enabled:
+            return
+        receipt = self.attribution_receipt()
+        if receipt is None or receipt["measured_step_seconds"] is None:
+            return
+        from ..profiling import attribution as attr_prof
+
+        for phase in attr_prof.PHASES:
+            val = receipt["phases"].get(phase)
+            if val is not None:
+                self.telemetry.gauge(f"attribution/{phase}_seconds").set(
+                    float(val))
+        self.telemetry.gauge("attribution/predicted_step_seconds").set(
+            float(receipt["predicted_step_seconds"]))
+        self.telemetry.gauge("attribution/measured_step_seconds").set(
+            float(receipt["measured_step_seconds"]))
+        self.telemetry.gauge("attribution/unexplained_fraction").set(
+            float(receipt["step_unexplained_fraction"]))
+        self.telemetry.emit(TEL.EVENT_ATTRIBUTION,
+                            step=self.global_steps, **receipt)
 
     # ------------------------------------------------------------------
     # program verification (deepspeed_tpu/profiling/verify, DSP6xx)
@@ -2790,6 +2877,7 @@ class DeepSpeedEngine:
             }, skipped=int(stats["skipped"]))
             self._sample_memory_watermarks()
             self._sample_comm_skew()
+            self._sample_attribution()
         self._losses = []
         if self._config.memory_breakdown:
             from .utils import see_memory_usage
@@ -2886,7 +2974,8 @@ class DeepSpeedEngine:
             # path, which handles them at the cost of a retrace
             if self.wall_clock_breakdown():
                 self.timers("train_batch").stop(sync=False)
-            return self._train_batch_stepwise(micro_batches)
+            return self._train_batch_stepwise(micro_batches,
+                                              t_host0=t_host0)
         sharding = NamedSharding(self.mesh, P(None, DATA_AXIS, None))
         if jax.process_count() > 1:
             packed = {k: jax.make_array_from_process_local_data(sharding, v)
@@ -2919,6 +3008,13 @@ class DeepSpeedEngine:
                               self.state["ustep"], self._module_params,
                               packed, spec, hp,
                               self._segment_ids, self._extra_kwargs())
+        # host-side driver seconds: everything from the step's start to
+        # the end of the (async) dispatch enqueue — batch fetch, pack,
+        # device_put, trace-or-lookup.  The blocking scalar fetch below
+        # is deliberately EXCLUDED: device_get waits on the device, so
+        # its duration is device time the budget's compute/wire phases
+        # already predict, not driver overhead
+        self._driver_latencies.record(time.perf_counter() - t_host0)
         # the regular step carries a trailing sparse-overflow counter dict
         # and the donated hostgrad buffer; the 1-bit compressed program
         # (no sparse exchange, no offload) does not
@@ -3018,6 +3114,7 @@ class DeepSpeedEngine:
             }, skipped=int(stats["skipped"]))
             self._sample_memory_watermarks()
             self._sample_comm_skew()
+            self._sample_attribution()
         if self.wall_clock_breakdown():
             # the fused program has no forward/step boundary to time
             # separately; report the whole fused step
@@ -3040,14 +3137,24 @@ class DeepSpeedEngine:
         self._step_beat()
         return loss
 
-    def _train_batch_stepwise(self, micro_batches):
+    def _train_batch_stepwise(self, micro_batches, t_host0=None):
         """Per-micro-batch path for batches the fused program cannot take
-        (ragged shapes); same semantics, more dispatches."""
+        (ragged shapes); same semantics, more dispatches.  ``t_host0``
+        is the caller's step-start perf_counter, so the attribution
+        driver bracket covers batch fetch + pack like the fused path's
+        (a smaller stepwise sample would win the min-window estimator
+        and under-report the driver phase)."""
+        # driver bracket for the attribution model: fetch/pack + the
+        # fwd/bwd loop are host work (shard/put + async enqueues);
+        # step()'s blocking scalar fetch stays excluded, same split as
+        # the fused path
+        t_drv = t_host0 if t_host0 is not None else time.perf_counter()
         losses = []
         for batch in micro_batches:
             loss = self.forward(batch)
             self.backward(loss)
             losses.append(loss)
+        self._driver_latencies.record(time.perf_counter() - t_drv)
         self.step()
         self.tput_timer.stop()
         return jnp.mean(jnp.stack(losses))

@@ -380,6 +380,10 @@ class PipelineEngine(DeepSpeedEngine):
         self.micro_steps += self.micro_batches - 1
         self.global_samples += (self.train_micro_batch_size_per_gpu()
                                 * self.dp_world_size * (self.micro_batches - 1))
+        # attribution driver bracket: stack/put + async dispatch are
+        # host driver work; step()'s blocking scalar fetch is device
+        # time and stays excluded (same split as the fused path)
+        self._driver_latencies.record(time.perf_counter() - t_host0)
         self.step()
         self.tput_timer.stop()
         if self.telemetry.enabled:

@@ -65,6 +65,12 @@ EVENT_MEMORY = "memory"
 # ring summary, exported only at the steps_per_print cadence), "skew"
 # (the fleet slowest-vs-median straggler snapshot)
 EVENT_COMM = "comm"
+# step-time attribution (profiling/attribution): the reconciled
+# per-step budget — phases (compute / exposed_collective / host_stream
+# / driver / unexplained) summing to the measured p50, the predicted
+# step seconds, and the unexplained fraction — exported only at the
+# steps_per_print cadence from scalars the engine already holds
+EVENT_ATTRIBUTION = "attribution"
 # elastic resize-on-failure loop (launcher/launch.py elastic supervisor
 # + engine elastic restore): ``phase`` selects the payload shape —
 # "plan" (the HCN planner's re-plan after a failure: surviving device
@@ -98,6 +104,9 @@ EVENT_TYPES = {
     EVENT_COMPILE: ("duration_secs",),
     EVENT_MEMORY: ("kind",),
     EVENT_COMM: ("kind",),
+    EVENT_ATTRIBUTION: ("program", "phases", "predicted_step_seconds",
+                        "measured_step_seconds",
+                        "step_unexplained_fraction"),
     EVENT_ELASTIC: ("phase",),
 }
 

@@ -22,7 +22,14 @@ per-rank event streams (``events-rank*.jsonl``) and metric snapshots
   with a slowest-vs-median skew column, and the straggler verdicts;
 - with ``--prometheus``, a Prometheus text-exposition dump of the merged
   metric snapshots (for scraping a finished or running job's artifacts);
-- with ``--json``, the merged event list as JSON (for tooling);
+- with ``--doctor``, the step-time attribution section: the reconciled
+  per-rank phase budget (compute / exposed wire / host stream / driver /
+  unexplained vs the measured p50) and the straggler explanation
+  (``profiling/doctor.py`` — needs the run's ``programs/`` sidecars);
+- with ``--json``, a machine-readable report document — summary, comm,
+  elastic, and (with ``--doctor``) doctor sections, plus the merged
+  event list under ``events`` — so CI and the bench harness consume
+  verdicts without scraping text;
 - with ``--diff OLD NEW``, a threshold-gated diff of two
   ``BENCH_r*.json`` driver artifacts (``tools/bench_diff.py`` — the
   bench regression gate; ``run_dir`` is optional in this mode).
@@ -288,14 +295,62 @@ def comm_skew_table(records):
     return lines
 
 
+# measured latency = median over the LAST this-many latency snapshots
+# per stream.  "Last snapshot wins" misstated the verdict whenever a
+# resized/respawned rank's stale first-life snapshot sorted last
+# (cross-life clock skew); the window median shrugs one outlier off.
+MEASURED_LATENCY_WINDOW = 5
+
+
+def _median(values):
+    vals = sorted(float(v) for v in values)
+    if not vals:
+        return None
+    mid = len(vals) // 2
+    return (vals[mid] if len(vals) % 2
+            else 0.5 * (vals[mid - 1] + vals[mid]))
+
+
+def _median_of_window(values, window):
+    """``attribution.median_of_window`` when importable (the canonical
+    estimator DSO705 and the doctor also use — one implementation, so
+    the report verdict and the recorded ratchet ceiling cannot
+    desynchronize); an equivalent local fallback keeps the report
+    readable in environments without the profiling package."""
+    try:
+        from ..profiling.attribution import median_of_window
+
+        return median_of_window(values, window=window)
+    except ImportError:
+        return _median([float(v) for v in values
+                        if v and float(v) > 0.0][-max(int(window), 1):])
+
+
+def measured_latencies(records, window=MEASURED_LATENCY_WINDOW):
+    """{stream: p50 seconds} — the median of each stream's last
+    ``window`` ``comm``/``latency`` snapshots (ts order), shared by the
+    comm summary, the ``--json`` document, and the attribution
+    doctor."""
+    by_stream = {}
+    for rec in records:                        # records are ts-sorted
+        data = rec.get("data", {})
+        if (rec.get("type") == ev.EVENT_COMM
+                and data.get("kind") == "latency" and data.get("p50")
+                and float(data["p50"]) > 0):
+            by_stream.setdefault(str(rec.get("_stream")), []).append(
+                float(data["p50"]))
+    return {stream: _median_of_window(vals, window)
+            for stream, vals in by_stream.items()}
+
+
 def comm_summary(records):
     """Predicted-vs-measured closing lines: the step program's predicted
-    wire bytes next to each rank's measured p50 step latency, plus any
-    straggler verdicts."""
+    wire bytes next to each rank's measured p50 step latency (median of
+    the last snapshot window), plus any straggler verdicts."""
     lines = []
     wire = {}
-    measured = {}
     exposure = {}
+    measured = measured_latencies(records)
     for rec in records:
         data = rec.get("data", {})
         if rec.get("type") != ev.EVENT_COMM:
@@ -312,8 +367,6 @@ def comm_summary(records):
             wire[stream] = data.get("wire_bytes")
             if data.get("overlap"):
                 exposure[stream] = data["overlap"]
-        elif data.get("kind") == "latency" and data.get("p50"):
-            measured[stream] = float(data["p50"])   # last snapshot wins
     for stream in sorted(set(wire) | set(measured)):
         w, m = wire.get(stream), measured.get(stream)
         ov = exposure.get(stream)
@@ -339,7 +392,35 @@ def format_comm_section(records):
     return out
 
 
-def generate_report(run_dir, strict=False, comm=False):
+def doctor_verdict(run_dir, grad_accumulation_steps=1):
+    """The step-time attribution doctor's verdict for ``run_dir``
+    (``profiling/doctor.py``), or ``{"error": ...}`` when the run
+    never dumped program artifacts — the report section says why
+    instead of vanishing.  ``grad_accumulation_steps`` (CLI:
+    ``--grad-accum``) weights step-wise program sets; fused step
+    programs ignore it."""
+    try:
+        from ..profiling.doctor import doctor_run_dir
+
+        return doctor_run_dir(
+            run_dir, grad_accumulation_steps=grad_accumulation_steps)
+    except (FileNotFoundError, OSError, ValueError, ImportError) as e:
+        return {"error": str(e)}
+
+
+def format_doctor_section(verdict):
+    out = ["step-time attribution (doctor):"]
+    if verdict.get("error"):
+        out.append(f"  unavailable: {verdict['error']}")
+        return out
+    from ..profiling.doctor import format_verdict
+
+    out.extend(format_verdict(verdict))
+    return out
+
+
+def generate_report(run_dir, strict=False, comm=False, doctor=False,
+                    grad_accumulation_steps=1):
     """Full text report for ``run_dir``; returns (text, events)."""
     records = ev.read_events(run_dir, strict=strict)
     problems = []
@@ -363,6 +444,10 @@ def generate_report(run_dir, strict=False, comm=False):
     if comm:
         out.append("")
         out.extend(format_comm_section(records))
+    if doctor:
+        out.append("")
+        out.extend(format_doctor_section(doctor_verdict(
+            run_dir, grad_accumulation_steps=grad_accumulation_steps)))
     out.append("")
     out.append("metrics:")
     out.extend(format_metrics(load_metrics(run_dir)))
@@ -371,6 +456,68 @@ def generate_report(run_dir, strict=False, comm=False):
         out.append("schema problems:")
         out.extend(f"  {p}" for p in problems)
     return "\n".join(out) + "\n", records
+
+
+# version of the ``report --json`` document (bumped on breaking change;
+# round 13 turned the bare merged-event list into this structured doc —
+# the list lives on under the ``events`` key)
+REPORT_JSON_SCHEMA_VERSION = 1
+
+
+def report_json(run_dir, strict=False, doctor=False,
+                grad_accumulation_steps=1):
+    """Machine-readable report document: summary / comm / elastic
+    sections (+ the doctor verdict with ``doctor=True``) so CI and the
+    bench harness consume verdicts without scraping text.  The merged
+    event list rides under ``events``."""
+    records = ev.read_events(run_dir, strict=strict)
+    streams = sorted({str(r.get("_stream")) for r in records})
+    steps = [r.get("step") for r in records
+             if r.get("type") == ev.EVENT_STEP_METRICS
+             and r.get("step") is not None]
+    by_type = {}
+    for rec in records:
+        by_type[str(rec.get("type"))] = by_type.get(
+            str(rec.get("type")), 0) + 1
+    wire = {}
+    stragglers = []
+    for rec in records:
+        data = rec.get("data", {})
+        if (rec.get("type") == ev.EVENT_COMM
+                and data.get("kind") == "program"
+                and data.get("program") in ("train_step",
+                                            "train_step_compressed")):
+            wire[str(rec.get("_stream"))] = data.get("wire_bytes")
+        elif (rec.get("type") == ev.EVENT_ANOMALY
+                and data.get("kind") == "straggler"):
+            stragglers.append({"step": rec.get("step"),
+                               "rank": rec.get("rank"),
+                               "detail": data.get("detail")})
+    doc = {
+        "report_schema_version": REPORT_JSON_SCHEMA_VERSION,
+        "run_dir": str(run_dir),
+        "summary": {
+            "events": len(records),
+            "streams": streams,
+            "events_by_type": by_type,
+            "step_range": ([min(steps), max(steps)] if steps else None),
+        },
+        "comm": {
+            "step_wire_bytes": wire,
+            "measured_p50_seconds": measured_latencies(records),
+            "stragglers": stragglers,
+        },
+        "elastic": [
+            {"rank": rec.get("rank"), "step": rec.get("step"),
+             **rec.get("data", {})}
+            for rec in align_records(records)
+            if rec.get("type") == ev.EVENT_ELASTIC],
+        "events": records,
+    }
+    if doctor:
+        doc["doctor"] = doctor_verdict(
+            run_dir, grad_accumulation_steps=grad_accumulation_steps)
+    return doc
 
 
 def prometheus_dump(run_dir):
@@ -393,13 +540,25 @@ def main(argv=None):
                      help="emit a Prometheus text dump instead of the "
                           "human report")
     rep.add_argument("--json", action="store_true", dest="as_json",
-                     help="emit the merged event list as JSON")
+                     help="emit the machine-readable report document "
+                          "(summary/comm/elastic sections + the merged "
+                          "event list under 'events'; add --doctor for "
+                          "the attribution verdict)")
     rep.add_argument("--strict", action="store_true",
                      help="fail on undecodable event lines")
     rep.add_argument("--comm", action="store_true",
                      help="include the communication section: per-program "
                           "collective-bytes table, per-step cross-rank "
                           "skew, straggler verdicts")
+    rep.add_argument("--doctor", action="store_true",
+                     help="include the step-time attribution doctor "
+                          "section: reconciled per-rank phase budget + "
+                          "straggler explanation (needs the run's "
+                          "programs/ sidecars)")
+    rep.add_argument("--grad-accum", type=int, default=1,
+                     help="micro-batch multiplicity for the doctor's "
+                          "step-wise program weighting (fused step "
+                          "programs ignore it)")
     rep.add_argument("--diff", nargs=2, metavar=("OLD", "NEW"),
                      help="diff two BENCH_r*.json driver artifacts with "
                           "the bench_schema regression thresholds")
@@ -439,12 +598,15 @@ def main(argv=None):
         sys.stdout.write(prometheus_dump(args.run_dir))
         return 1 if diff_regressed else 0
     if args.as_json:
-        records = ev.read_events(args.run_dir, strict=args.strict)
-        json.dump(records, sys.stdout, indent=1)
+        doc = report_json(args.run_dir, strict=args.strict,
+                          doctor=args.doctor,
+                          grad_accumulation_steps=args.grad_accum)
+        json.dump(doc, sys.stdout, indent=1)
         sys.stdout.write("\n")
-        return 0
+        return 1 if diff_regressed else 0
     text, records = generate_report(args.run_dir, strict=args.strict,
-                                    comm=args.comm)
+                                    comm=args.comm, doctor=args.doctor,
+                                    grad_accumulation_steps=args.grad_accum)
     sys.stdout.write(text)
     # a regressed --diff gates the combined form too (CI relies on it)
     return 1 if (diff_regressed or not records) else 0

@@ -14,6 +14,13 @@ Classification per shared numeric field (direction + rel_tol from
 - **ok** — within tolerance;
 - **info** — no threshold registered (diffed, never gated).
 
+Direction ``zero`` handles SIGNED optimum-at-zero metrics
+(``step_unexplained_fraction``: negative = over-prediction, positive =
+under-prediction, 0 = perfect reconciliation): the gate compares
+MAGNITUDES with the tolerance as an absolute band — ``|new| - |old| >
+tol`` regresses, ``< -tol`` improves.  A relative lower-is-better gate
+would flag -0.10 → 0.0 as a regression and wave -0.10 → -0.50 through.
+
 Added/removed fields and non-numeric changes are reported as such.
 Exit code 1 when any field regressed (``--no-fail`` suppresses), 0
 otherwise.  ``--self-check A B C ...`` diffs each consecutive pair and
@@ -95,6 +102,16 @@ def diff_records(old, new):
             row["rel_change"] = rel
             if direction is None:
                 row["status"] = "info"
+            elif direction == "zero":
+                # optimum-at-zero signed metric: gate |new| vs |old|
+                # with the tolerance as an ABSOLUTE band
+                drift = abs(n) - abs(o)
+                if drift > rel_tol:
+                    row["status"] = "regressed"
+                elif drift < -rel_tol:
+                    row["status"] = "improved"
+                else:
+                    row["status"] = "ok"
             else:
                 signed = rel if direction == "higher" else -rel
                 if signed < -rel_tol:
@@ -128,9 +145,13 @@ def format_diff(diffs, old_name="old", new_name="new", verbose=False):
         shown += 1
         rel = ("" if d["rel_change"] is None
                else f" ({d['rel_change']:+.1%})")
-        gate = ("" if d["direction"] is None
-                else f" [{d['direction']}-is-better, tol "
-                     f"{d['rel_tol']:.0%}]")
+        if d["direction"] is None:
+            gate = ""
+        elif d["direction"] == "zero":
+            gate = f" [zero-is-better, abs band {d['rel_tol']:g}]"
+        else:
+            gate = (f" [{d['direction']}-is-better, tol "
+                    f"{d['rel_tol']:.0%}]")
         lines.append(f"  {d['status'].upper():<10} {d['field']}: "
                      f"{_fmt_val(d['old'])} -> {_fmt_val(d['new'])}"
                      f"{rel}{gate}")

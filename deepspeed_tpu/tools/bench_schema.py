@@ -90,6 +90,17 @@ FIELDS = {
     "overlap_fraction": (numbers.Real,
                          "hidden/total wire seconds, 0..1 (1.0 = fully "
                          "hidden or no wire)"),
+    # attribution receipts (round 13, profiling/attribution): the
+    # reconciled step budget — the predicted step seconds the compiled
+    # programs + declared streams + driver account for, and the
+    # fraction of the MEASURED step the model cannot explain.  The
+    # doctor CLI replays the same reconciliation offline
+    "predicted_step_seconds": (numbers.Real,
+                               "attribution budget: compute + exposed "
+                               "wire + host stream + driver, s/step"),
+    "step_unexplained_fraction": (numbers.Real,
+                                  "(measured p50 - predicted)/measured "
+                                  "(negative = model over-predicts)"),
     # program-verification receipt (round 10, profiling/verify +
     # tools/dslint/programs): unsuppressed DSP6xx violations over every
     # compiled engine program — donation aliases materialized,
@@ -139,6 +150,9 @@ _LEG_FIELDS = {
     # overlap receipts (round 11)
     "exposed_wire_seconds": numbers.Real,
     "overlap_fraction": numbers.Real,
+    # attribution receipts (round 13)
+    "predicted_step_seconds": numbers.Real,
+    "step_unexplained_fraction": numbers.Real,
     "error": str,
     "note": str,
 }
@@ -169,6 +183,9 @@ _OFFLOAD_ROW_FIELDS = {
     # overlap receipts (round 11)
     "exposed_wire_seconds": numbers.Real,
     "overlap_fraction": numbers.Real,
+    # attribution receipts (round 13)
+    "predicted_step_seconds": numbers.Real,
+    "step_unexplained_fraction": numbers.Real,
     "error": str,
     "note": str,
 }
@@ -216,6 +233,15 @@ THRESHOLDS = {
     # the absolute exposed seconds generously for the same reason
     "exposed_wire_seconds": ("lower", 0.25),
     "overlap_fraction": ("higher", 0.10),
+    # attribution quality is CI-ratcheted like exposure: a predicted
+    # step that grows is a budget regression (generous tol: the figure
+    # is roofline-table sensitive), and the unexplained fraction is a
+    # SIGNED optimum-at-zero metric (negative = over-prediction), so it
+    # gates on magnitude with an absolute band — direction "zero",
+    # wide (measured-latency noisy; DSO705's baseline ratchet is the
+    # tighter per-program gate)
+    "predicted_step_seconds": ("lower", 0.25),
+    "step_unexplained_fraction": ("zero", 0.25),
     # any new program-verifier violation is a gated regression (zero
     # tolerance: the receipt exists to pin this at 0)
     "dsp_violations": ("lower", 0.0),
@@ -231,6 +257,8 @@ _LEG_FIELD_THRESHOLDS = {
     "dsp_violations": ("lower", 0.0),
     "exposed_wire_seconds": ("lower", 0.25),
     "overlap_fraction": ("higher", 0.10),
+    "predicted_step_seconds": ("lower", 0.25),
+    "step_unexplained_fraction": ("zero", 0.25),
 }
 
 # thresholds for the pattern-based offload_<row>_<field> family
@@ -244,6 +272,8 @@ _OFFLOAD_FIELD_THRESHOLDS = {
     "dsp_violations": ("lower", 0.0),
     "exposed_wire_seconds": ("lower", 0.25),
     "overlap_fraction": ("higher", 0.10),
+    "predicted_step_seconds": ("lower", 0.25),
+    "step_unexplained_fraction": ("zero", 0.25),
 }
 
 

@@ -125,19 +125,22 @@ def lint_config_files(paths) -> List[Diagnostic]:
 
 
 def lint_program_dirs(run_dirs):
-    """(diagnostics, artifacts): DSP6xx verification of dumped
+    """(diagnostics, artifacts, by_dir): DSP6xx verification of dumped
     program artifacts (see ``tools/dslint/programs.py``).  Raises
     FileNotFoundError when a run dir holds no artifacts (usage error,
-    exit 2).  The artifacts come back too: the baseline's exposed-wire
-    metric ratchet (DSO704) re-analyzes them against the recorded
-    figures."""
+    exit 2).  The artifacts come back too: the baseline's metric
+    ratchets (DSO704 exposed wire, DSO705 attribution) re-analyze them
+    against the recorded figures — DSO705 per run dir, because the
+    measured-latency evidence lives next to the sidecars."""
     diags: List[Diagnostic] = []
     artifacts = []
+    by_dir = []
     for run_dir in run_dirs:
         loaded = programs.load_run_artifacts(run_dir)
         artifacts.extend(loaded)
+        by_dir.append((run_dir, loaded))
         diags.extend(programs.verify_artifacts(loaded))
-    return diags, artifacts
+    return diags, artifacts, by_dir
 
 
 # ---------------------------------------------------------------------------
@@ -370,7 +373,8 @@ def main(argv=None) -> int:
         return 2
     diags.extend(lint_config_files(args.config))
     try:
-        prog_diags, prog_artifacts = lint_program_dirs(args.programs)
+        prog_diags, prog_artifacts, prog_by_dir = lint_program_dirs(
+            args.programs)
     except (FileNotFoundError, OSError, ValueError) as e:
         print(f"dslint: cannot load program artifacts: {e}",
               file=sys.stderr)
@@ -390,9 +394,11 @@ def main(argv=None) -> int:
     baselined = 0
     if args.baseline:
         if args.update_baseline:
-            write_baseline(args.baseline, fail,
-                           metrics=programs.exposure_metrics(
-                               prog_artifacts))
+            metrics = programs.exposure_metrics(prog_artifacts)
+            for run_dir, dir_artifacts in prog_by_dir:
+                metrics.update(programs.attribution_metrics(
+                    dir_artifacts, run_dir=run_dir))
+            write_baseline(args.baseline, fail, metrics=metrics)
             print(f"dslint: baseline updated: {len(fail)} violation(s) "
                   f"recorded to {args.baseline}")
             baseline = Counter(baseline_key(d) for d in fail)
@@ -405,11 +411,14 @@ def main(argv=None) -> int:
                       f"{e}", file=sys.stderr)
                 return 2
             fail, baselined = apply_baseline(fail, baseline)
-            # exposed-wire metric ratchet (DSO704): recorded figures
-            # only tighten — growth past tolerance is a NEW violation
-            # the violations baseline cannot absolve
+            # metric ratchets: recorded figures only tighten — growth
+            # (DSO704 exposed wire) or reconciliation drift (DSO705
+            # attribution) past tolerance is a NEW violation the
+            # violations baseline cannot absolve
             ratchet = programs.check_exposure_ratchet(prog_artifacts,
                                                       base_metrics)
+            ratchet.extend(programs.check_attribution_ratchet(
+                prog_by_dir, base_metrics))
             if select:
                 ratchet = [d for d in ratchet if d.rule_id in select]
             if ignore:

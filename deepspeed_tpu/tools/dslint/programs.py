@@ -202,6 +202,23 @@ register_rule(Rule(
                  "growth is intended and reviewed."))
 
 register_rule(Rule(
+    id="DSO705", name="attribution-drift", severity="warning",
+    summary="the reconciled step budget drifts from the "
+            "baseline-recorded attribution metrics beyond tolerance",
+    rationale="The attribution model's worth is that its predicted "
+              "budget stays reconciled with reality: a re-analyzed "
+              "predicted_step_seconds drifting from the recorded "
+              "figure means the declared budget (schedule, roofline "
+              "inputs, stream declaration) changed without review, "
+              "and a measured run whose step_unexplained_fraction "
+              "exceeds the recorded ceiling means the model no longer "
+              "explains where the step goes — either way the receipts "
+              "bench/multichip quote are unaudited.",
+    autofix_hint="Re-reconcile (fix the declaration or the model), or "
+                 "re-record with --update-baseline if the drift is "
+                 "intended and reviewed."))
+
+register_rule(Rule(
     id="DSO703", name="overlap-model-drift", severity="warning",
     summary="recorded overlap summary drifts from the HLO re-analysis "
             "beyond tolerance",
@@ -670,6 +687,149 @@ def check_exposure_ratchet(artifacts, baseline_metrics) -> List[Diagnostic]:
                 "tolerance exceeded): the offload stream is "
                 "re-serializing — restore the overlapped schedule or "
                 "re-record with --update-baseline"))
+    return out
+
+
+# two-sided drift band on the re-analyzed predicted_step_seconds vs the
+# baseline-recorded figure (model-derived and deterministic per
+# toolchain, so a generous band only catches real declaration drift)
+PREDICTED_STEP_RATCHET_TOL = 0.25
+PREDICTED_STEP_RATCHET_EPS = 1e-5
+# absolute headroom over the recorded step_unexplained_fraction ceiling
+# (the fraction is measured-latency-derived, hence noisy)
+UNEXPLAINED_RATCHET_MARGIN = 0.05
+
+
+def predicted_step_metric_key(name: str) -> str:
+    """Baseline ``metrics`` key for one program's predicted step
+    seconds (the attribution budget's deterministic half)."""
+    return f"<programs>|predicted_step_seconds|{name}"
+
+
+def unexplained_metric_key(name: str) -> str:
+    """Baseline ``metrics`` key for one program's reconciled
+    unexplained-fraction ceiling (the measured half; recorded only
+    when the run dir carries latency evidence)."""
+    return f"<programs>|step_unexplained_fraction|{name}"
+
+
+def program_attribution(artifact: ProgramArtifact):
+    """The attribution phase budget (profiling/attribution) of one
+    artifact's re-analyzed overlap summary; None when the analyzer is
+    unavailable or the text holds no computation."""
+    summary = program_overlap(artifact)
+    if summary is None:
+        return None
+    try:
+        from ...profiling import attribution as attr_prof
+    except Exception:
+        return None
+    return attr_prof.program_budget(summary)
+
+
+def _run_dir_measured_p50(run_dir):
+    """Fleet-median measured p50 seconds from a run dir's
+    ``latency-rank*.json`` skew-exchange files (the offline CLI's
+    measured evidence); None when the dir holds none or the profiling
+    package is unavailable."""
+    if not run_dir:
+        return None
+    try:
+        from ...profiling import attribution as attr_prof
+        from ...profiling import comm as comm_prof
+    except Exception:
+        return None
+    # relative staleness guard: an elastic run leaves dead ranks' last
+    # publishes behind, and offline analysis cannot use wall-clock age
+    fleet = attr_prof.fresh_fleet_snapshots(
+        comm_prof.read_fleet_latencies(str(run_dir)))
+    vals = [float(snap["p50"]) for snap in fleet.values()
+            if snap.get("p50") and float(snap["p50"]) > 0]
+    return attr_prof.median_of_window(vals, window=max(len(vals), 1))
+
+
+def attribution_metrics(artifacts, run_dir=None) -> dict:
+    """Attribution metric entries for ``--update-baseline``: per
+    host-stream-declaring program (the same gating as
+    :func:`exposure_metrics` — the offload step is the canonical CI
+    anchor), the re-analyzed ``predicted_step_seconds`` and — when the
+    run dir carries measured latency — the reconciled
+    ``step_unexplained_fraction`` as the recorded ceiling.
+
+    Metric keys are PROGRAM-NAME-scoped (the DSO704 exposure-metric
+    convention): recording over multiple ``--programs`` dirs that dump
+    the same program name collapses to one figure (last dir wins).
+    The checked-in baseline anchors exactly one run dir; keep it that
+    way, or name programs distinctly across dirs."""
+    out = {}
+    measured = _run_dir_measured_p50(run_dir)
+    for artifact in artifacts:
+        if not artifact.host_state_wire_bytes:
+            continue
+        budget = program_attribution(artifact)
+        if budget is None:
+            continue
+        predicted = float(budget["predicted_seconds"])
+        out[predicted_step_metric_key(artifact.name)] = round(predicted, 9)
+        if measured and measured > 0:
+            out[unexplained_metric_key(artifact.name)] = round(
+                (measured - predicted) / measured, 6)
+    return out
+
+
+def check_attribution_ratchet(artifacts_by_dir,
+                              baseline_metrics) -> List[Diagnostic]:
+    """DSO705 over ``[(run_dir, artifacts)]``: programs whose
+    re-analyzed predicted step drifts beyond the two-sided band around
+    the recorded figure, or whose reconciled unexplained fraction (when
+    the run dir carries measured latency) exceeds the recorded ceiling
+    plus margin.  Programs without a recorded metric are not checked —
+    the ratchet only ever tightens what a reviewer recorded."""
+    out: List[Diagnostic] = []
+    if not baseline_metrics:
+        return out
+    for run_dir, artifacts in artifacts_by_dir:
+        measured = None
+        measured_resolved = False
+        for artifact in artifacts:
+            rec_pred = baseline_metrics.get(
+                predicted_step_metric_key(artifact.name))
+            rec_ceil = baseline_metrics.get(
+                unexplained_metric_key(artifact.name))
+            if rec_pred is None and rec_ceil is None:
+                continue
+            budget = program_attribution(artifact)
+            if budget is None:
+                continue
+            predicted = float(budget["predicted_seconds"])
+            if rec_pred is not None:
+                band = (abs(float(rec_pred)) * PREDICTED_STEP_RATCHET_TOL
+                        + PREDICTED_STEP_RATCHET_EPS)
+                if abs(predicted - float(rec_pred)) > band:
+                    out.append(_pdiag(
+                        artifact, "DSO705",
+                        f"predicted_step_seconds drifted "
+                        f"{float(rec_pred):.6f} -> {predicted:.6f} "
+                        f"(±{PREDICTED_STEP_RATCHET_TOL:.0%} band "
+                        "exceeded): the declared budget changed — "
+                        "re-reconcile or re-record with "
+                        "--update-baseline"))
+            if rec_ceil is None:
+                continue
+            if not measured_resolved:
+                measured = _run_dir_measured_p50(run_dir)
+                measured_resolved = True
+            if not measured:
+                continue
+            fraction = (measured - predicted) / measured
+            if fraction > float(rec_ceil) + UNEXPLAINED_RATCHET_MARGIN:
+                out.append(_pdiag(
+                    artifact, "DSO705",
+                    f"step_unexplained_fraction {fraction:.4f} exceeds "
+                    f"the recorded ceiling {float(rec_ceil):.4f} "
+                    f"(+{UNEXPLAINED_RATCHET_MARGIN} margin): the "
+                    "budget no longer explains the measured step — "
+                    "re-reconcile or re-record with --update-baseline"))
     return out
 
 

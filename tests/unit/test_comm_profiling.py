@@ -412,6 +412,39 @@ def test_report_clock_aligns_respawned_rank(tmp_path):
     assert "t=+  301.000s" not in text
 
 
+def test_comm_summary_measured_uses_median_of_last_window(tmp_path):
+    """The respawned-rank fixture, latency edition: a resized rank's
+    stream holds two lives, and cross-life clock skew can sort the
+    dying first life's stale (huge) snapshot LAST.  "Last snapshot
+    wins" quoted exactly that outlier as the measured verdict; the
+    median over the last window must shrug it off."""
+    t0 = 1_700_000_000.0
+    rows = [{"schema_version": 1, "seq": 0, "rank": 0, "ts": t0,
+             "type": "run_start", "step": 0, "data": {"world_size": 1}}]
+    # second life: healthy ~2ms snapshots...
+    for i, p50 in enumerate((0.002, 0.0021, 0.0019, 0.002)):
+        rows.append({"schema_version": 1, "seq": i + 1, "rank": 0,
+                     "ts": t0 + 10 + i, "type": "comm", "step": i + 1,
+                     "data": {"kind": "latency", "n": 4, "steps": 4,
+                              "last": p50, "mean": p50, "p50": p50,
+                              "p95": p50, "max": p50}})
+    # ...then the first life's stale 30s snapshot (its clock ran ahead,
+    # so it merges AFTER the healthy ones)
+    rows.append({"schema_version": 1, "seq": 99, "rank": 0,
+                 "ts": t0 + 20, "type": "comm", "step": 1,
+                 "data": {"kind": "latency", "n": 1, "steps": 1,
+                          "last": 30.0, "mean": 30.0, "p50": 30.0,
+                          "p95": 30.0, "max": 30.0}})
+    with open(tmp_path / "events-rank0.jsonl", "w") as f:
+        f.write("\n".join(json.dumps(r) for r in rows) + "\n")
+    records = read_events(tmp_path)
+    measured = report_mod.measured_latencies(records)
+    # median of the last-5 window [2, 2.1, 1.9, 2, 30000] ms = 2 ms
+    assert abs(measured["rank0"] - 0.002) < 1e-9
+    lines = "\n".join(report_mod.comm_summary(records))
+    assert "2.00ms" in lines and "30000" not in lines
+
+
 # ------------------------------------- MULTICHIP record + bench_diff CI
 def test_load_bench_record_extracts_multichip_tail(tmp_path):
     from deepspeed_tpu.tools.bench_diff import load_bench_record
