@@ -141,7 +141,7 @@ def capture_engine_snapshot(engine, tag, client_state=None, save_latest=True):
     small = {}
     for path, leaf in flat_opt:
         key = tree_path_key(path)
-        if type(leaf) is tuple or leaf.shape == engine.segments.shape:
+        if type(leaf) is tuple or leaf.shape == engine.flat.flat_shape:
             optim_states[f"opt/{key}"] = engine.flat.gather_master_unpadded(
                 leaf)
         else:

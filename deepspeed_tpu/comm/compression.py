@@ -55,29 +55,53 @@ def _compress(buf, error):
     return sign_bits, scale, new_error
 
 
+def padded_size(n, world):
+    """Smallest size >= ``n`` divisible by ``8*world`` — the alignment
+    the packed-sign chunking needs (8 signs per uint8, one equal chunk
+    per server rank).  Callers allocate their persistent error buffers
+    at this size; :func:`compressed_allreduce` pads and trims the data
+    buffer internally."""
+    q = 8 * int(world)
+    return -(-int(n) // q) * q
+
+
 def compressed_allreduce(buf, worker_error, server_error, axis_name):
     """1-bit error-feedback mean-allreduce of ``buf`` over ``axis_name``.
 
     Args:
-        buf: [n] fp32, n divisible by 8·world.
-        worker_error: [n] fp32 worker residual (carried across steps).
-        server_error: [n/world] fp32 server residual for this rank's chunk.
+        buf: [n] fp32, ANY size — padded internally to
+            ``padded_size(n, world)`` with zeros and trimmed on return
+            (real flat-gradient sizes are rarely divisible by 8·world).
+        worker_error: [padded_size(n, world)] fp32 worker residual
+            (carried across steps; error feedback accumulates on the
+            PADDED buffer, so its tail keeps the pad lanes' residual).
+        server_error: [padded_size(n, world)/world] fp32 server residual
+            for this rank's chunk.
         axis_name: mesh axis to reduce over (must be in manual shard_map).
 
-    Returns ``(out, new_worker_error, new_server_error)`` with ``out`` the
-    compressed approximation of ``mean(buf)`` — identical on all ranks.
+    Returns ``(out, new_worker_error, new_server_error)`` with ``out``
+    the [n] compressed approximation of ``mean(buf)`` — identical on
+    all ranks; the error buffers stay padded-size.
     """
     world = compat_axis_size(axis_name)
     n = buf.shape[0]
-    assert n % (8 * world) == 0, (
-        f"buffer size {n} must be divisible by 8*world ({8 * world})")
+    n_pad = padded_size(n, world)
+    assert worker_error.shape[0] == n_pad, (
+        f"worker_error size {worker_error.shape[0]} must be "
+        f"padded_size(n={n}, world={world}) = {n_pad}")
+    assert server_error.shape[0] * world == n_pad, (
+        f"server_error size {server_error.shape[0]} must be "
+        f"padded_size(n={n}, world={world})/world = {n_pad // world}")
+    if n_pad != n:
+        buf = jnp.concatenate(
+            [buf, jnp.zeros((n_pad - n,), buf.dtype)])
 
     # -- worker compression (reference :118-127) --
     sign_bits, worker_scale, new_worker_error = _compress(buf, worker_error)
 
     # -- phase 1: signs chunked to server ranks (reference igather :146-165) --
-    packed = pack_signs(sign_bits)  # [n/8] uint8
-    chunks = packed.reshape(world, n // 8 // world)
+    packed = pack_signs(sign_bits)  # [n_pad/8] uint8
+    chunks = packed.reshape(world, n_pad // 8 // world)
     # all_to_all: rank r ends up with [world, chunk] = everyone's chunk r
     recv = jax.lax.all_to_all(chunks[None], axis_name, split_axis=1,
                               concat_axis=0, tiled=False)[:, 0]
@@ -90,12 +114,12 @@ def compressed_allreduce(buf, worker_error, server_error, axis_name):
                                                          server_error)
 
     # -- phase 2: broadcast compressed server chunks (reference :202-214) --
-    srv_packed = pack_signs(srv_bits)  # [n/8/world] uint8
-    all_packed = jax.lax.all_gather(srv_packed, axis_name)  # [world, n/8/world]
+    srv_packed = pack_signs(srv_bits)  # [n_pad/8/world] uint8
+    all_packed = jax.lax.all_gather(srv_packed, axis_name)  # [world, n_pad/8/world]
     all_scales = jax.lax.all_gather(server_scale, axis_name)  # [world]
-    out_signs = jax.vmap(unpack_signs)(all_packed)  # [world, n/world]
-    out = (out_signs * all_scales[:, None]).reshape(n)
-    return out, new_worker_error, new_server_error
+    out_signs = jax.vmap(unpack_signs)(all_packed)  # [world, n_pad/world]
+    out = (out_signs * all_scales[:, None]).reshape(n_pad)
+    return out[:n], new_worker_error, new_server_error
 
 
 def compressed_allreduce_reference(bufs, worker_errors, server_errors):

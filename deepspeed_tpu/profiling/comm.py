@@ -291,6 +291,7 @@ class CommLedger:
                     logger.debug("comm ledger: overlap context "
                                  "unavailable: %s", e)
             is_update = str(name) in overlap_prof.UPDATE_PROGRAMS
+            is_exchange = str(name) in overlap_prof.EXCHANGE_PROGRAMS
             declared = (int(ctx.get("host_state_wire_bytes") or 0)
                         if is_update else 0)
             return overlap_prof.analyze_hlo(
@@ -298,7 +299,10 @@ class CommLedger:
                 device_kind=ctx.get("device_kind") or "",
                 declared_host_wire_bytes=declared,
                 declared_host_stream=(ctx.get("host_stream_schedule")
-                                      if is_update else None))
+                                      if is_update else None),
+                declared_collective_schedule=(
+                    ctx.get("collective_schedule")
+                    if is_exchange else None))
         except Exception as e:  # pragma: no cover - fail-soft by design
             logger.debug("comm ledger: overlap analysis failed for %r: "
                          "%s", name, e)

@@ -60,6 +60,17 @@ OVERLAP_SCHEMA_VERSION = 1
 # program's HLO) attaches to these and only these
 UPDATE_PROGRAMS = ("train_step", "train_step_compressed", "apply_update")
 
+# programs that carry (part of) the ZeRO-2 data-parallel gradient
+# exchange: the engine's DECLARED collective schedule (overlap_comm
+# bucket geometry) attaches to these and only these — the fused step
+# holds both sides, the step-wise programs one each
+EXCHANGE_PROGRAMS = ("train_step", "fwd_bwd", "apply_update",
+                     "cast_params")
+
+# the bucketed-exchange collective ops a declared schedule re-prices
+# (the loss pmean / gnorm psum land as all-reduce and stay untouched)
+_SCHEDULE_OPS = ("reduce-scatter", "all-gather")
+
 # overlap classifications (per comm/transfer node)
 OVERLAPPED = "overlapped"
 PARTIAL = "partially_exposed"
@@ -625,9 +636,83 @@ def _declared_stream_nodes(declared_residual, schedule, compute_total,
     return nodes
 
 
+def _apply_collective_schedule(nodes, schedule, compute_total):
+    """Re-price the bucketed ZeRO-2 gradient exchange per the engine's
+    DECLARED collective schedule (``{overlap, rs_buckets, ag_buckets,
+    ...}``).
+
+    The CPU-mesh HLO shows only sync reduce-scatter / all-gather
+    instructions — no ``-start/-done`` machinery — so text-order
+    classification reads every bucket as serialized even though the
+    bucketed program's data dependencies are real (bucket *i*'s
+    reduce-scatter depends only on its leaves' backward; TPU's
+    latency-hiding scheduler overlaps them).  Like the PR 12 declared
+    host stream, the engine declares the schedule it built and this
+    prices it:
+
+    - ``overlap: true`` — steady-state buckets hide up to the
+      independent-compute window (each node's DAG window when known,
+      all sharing one ``compute_total`` budget — the model never claims
+      more hiding than the program holds), and the pipeline FILL/DRAIN
+      (one bucket's wire, ``W/B``) stays exposed.  Hiding is granted in
+      issue order so the drain-side nodes keep the exposure.
+    - ``overlap: false`` (the serialized control) — nothing hides, but
+      the matching nodes' windows record the POTENTIAL window
+      (``compute_total * (B-1)/B`` over the declared bucket count):
+      what the bucketed schedule COULD have hidden.  That is the
+      DSO701 message, and the reason the control trips it while the
+      overlapped program verifies clean.
+
+    Only sync HLO reduce-scatter/all-gather collective nodes are
+    touched (``source`` becomes ``hlo+declared``); all-reduces (loss
+    pmean, gnorm psum) and every transfer node keep their HLO-derived
+    classification."""
+    if not schedule:
+        return
+    matching = [n for n in nodes
+                if n["kind"] == KIND_COLLECTIVE
+                and n["op"] in _SCHEDULE_OPS
+                and n["source"] == "hlo"]
+    if not matching:
+        return
+    n_declared = (int(schedule.get("rs_buckets") or 0)
+                  + int(schedule.get("ag_buckets") or 0))
+    if not schedule.get("overlap"):
+        if n_declared > 1:
+            potential = max(
+                float(compute_total) * (n_declared - 1) / n_declared,
+                0.0)
+            for n in matching:
+                n["window_seconds"] = max(
+                    float(n.get("window_seconds") or 0.0), potential)
+                n["source"] = "hlo+declared"
+        return
+    B = len(matching)
+    if B <= 1:
+        return
+    total = sum(n["seconds"] for n in matching)
+    fill_drain = total / B
+    budget = max(float(compute_total), 0.0)
+    remaining = min(max(total - fill_drain, 0.0), budget)
+    for n in sorted(matching, key=lambda x: x["index"]):
+        cap = n.get("window_seconds")
+        grant = remaining if cap is None else min(remaining,
+                                                 max(float(cap), 0.0))
+        hidden = min(n["seconds"], grant)
+        remaining -= hidden
+        re = _classify(ins_op=n["op"], kind=n["kind"],
+                       wire_bytes=n["wire_bytes"], seconds=n["seconds"],
+                       hidden=hidden,
+                       window=(cap if cap is not None else budget),
+                       index=n["index"], name=n["name"],
+                       source="hlo+declared")
+        n.update(re)
+
+
 def analyze_hlo(hlo_text, total_devices=1, device_kind="",
                 declared_host_wire_bytes=0, max_nodes=32,
-                declared_host_stream=None):
+                declared_host_stream=None,
+                declared_collective_schedule=None):
     """Full overlap analysis of one compiled program.
 
     ``max_nodes`` caps the emitted per-node list (telemetry events must
@@ -704,6 +789,10 @@ def analyze_hlo(hlo_text, total_devices=1, device_kind="",
     nodes.extend(_declared_stream_nodes(
         declared_residual, declared_host_stream, compute_total, specs,
         hlo_excess_bytes=max(hlo_host_bytes - declared_state, 0)))
+    # declared bucketed-collective schedule (overlap_comm): re-price
+    # the HLO exchange nodes per the engine-declared issue schedule
+    _apply_collective_schedule(nodes, declared_collective_schedule,
+                               compute_total)
     wire = sum(n["seconds"] for n in nodes)
     exposed = sum(n["seconds"] - n["hidden_seconds"] for n in nodes)
     # per-kind exposed split over the FULL node set (the attribution

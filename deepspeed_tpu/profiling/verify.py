@@ -66,6 +66,19 @@ def _declared_host_schedule(ctx, name):
     return dict(sched) if sched else None
 
 
+def _declared_collective_schedule(ctx, name):
+    """The declared bucketed-collective schedule (overlap_comm), gated
+    to the gradient-exchange programs — the same gating the
+    CommLedger's recorded analysis uses, so the offline re-analysis
+    (DSO703) compares like with like."""
+    from .overlap import EXCHANGE_PROGRAMS
+
+    if str(name) not in EXCHANGE_PROGRAMS:
+        return None
+    sched = ctx.get("collective_schedule")
+    return dict(sched) if sched else None
+
+
 def build_engine_artifact(engine, name, compiled):
     """One :class:`ProgramArtifact` from a live compiled executable plus
     the engine's ledgers/metadata; None when the HLO text is
@@ -88,6 +101,7 @@ def build_engine_artifact(engine, name, compiled):
         master_provenance=ctx["master_provenance"],
         host_state_wire_bytes=_declared_host_wire(ctx, name),
         host_stream_schedule=_declared_host_schedule(ctx, name),
+        collective_schedule=_declared_collective_schedule(ctx, name),
         device_kind=ctx.get("device_kind"))
 
 
@@ -237,6 +251,7 @@ class ProgramDumper:
             master_provenance=ctx.get("master_provenance"),
             host_state_wire_bytes=_declared_host_wire(ctx, name),
             host_stream_schedule=_declared_host_schedule(ctx, name),
+            collective_schedule=_declared_collective_schedule(ctx, name),
             device_kind=ctx.get("device_kind"))
         try:
             os.makedirs(self.programs_dir, exist_ok=True)

@@ -135,8 +135,18 @@ ZERO_REDUCE_BUCKET_SIZE = "reduce_bucket_size"
 ZERO_REDUCE_BUCKET_SIZE_DEFAULT = 500000000
 ZERO_ALLGATHER_BUCKET_SIZE = "allgather_bucket_size"
 ZERO_ALLGATHER_BUCKET_SIZE_DEFAULT = 500000000
+# Bucketed gradient-collective overlap (round 14): split the ZeRO-2
+# data-parallel gradient exchange into reduce_bucket_size-bounded,
+# leaf-aligned buckets issued as explicit per-bucket psum_scatters in
+# backward-production order (and the master all-gather into
+# allgather_bucket_size groups), so the collectives overlap backward /
+# update compute instead of landing as one fused end-of-backward
+# exchange.  "auto" engages whenever supported (stage-2 pure-dp mesh,
+# flat Adam/AdamW, no cpu_offload/sparse_gradients); true raises on an
+# unsupported config; false keeps the GSPMD fused exchange — the
+# measured serialized control.
 ZERO_OVERLAP_COMM = "overlap_comm"
-ZERO_OVERLAP_COMM_DEFAULT = False
+ZERO_OVERLAP_COMM_DEFAULT = "auto"
 ZERO_CONTIGUOUS_GRADIENTS = "contiguous_gradients"
 ZERO_CONTIGUOUS_GRADIENTS_DEFAULT = False
 ZERO_CPU_OFFLOAD = "cpu_offload"

@@ -385,6 +385,25 @@ class DeepSpeedEngine:
             getattr(zc, "offload_state_reduced", False))
         host_families = (3 + (1 if zc.offload_gradients else 0)
                          + getattr(zc, "offload_state_residual_count", 0))
+        # -- bucketed gradient-collective overlap (overlap_comm, round
+        # 14): decide BEFORE the coordinator builds, because the
+        # overlapped exchange requires the shard-major sub-partition
+        # layout (zero/buckets.py) the coordinator owns.  "auto"
+        # engages whenever the bucketed exchange is supported; an
+        # explicit true raises on any unmet requirement; false keeps
+        # the GSPMD fused exchange (the serialized control).
+        self._comm_overlap, self._comm_overlap_unsupported = \
+            self._resolve_comm_overlap(zc, optimizer)
+        bucket_plan = None
+        if self._comm_overlap:
+            from .zero.buckets import BucketPlan
+
+            bucket_plan = BucketPlan(
+                [int(np.prod(x.shape))
+                 for x in jax.tree_util.tree_leaves(params0)],
+                dp=self.dp_world_size,
+                reduce_bucket_size=zc.reduce_bucket_size,
+                allgather_bucket_size=zc.allgather_bucket_size)
         self.flat = FlatParamCoordinator(
             mesh=self.mesh, params_template=params0, stage=self.zero_stage,
             dp_size=self.dp_world_size,
@@ -399,8 +418,18 @@ class DeepSpeedEngine:
                                 else UNIFORM_MIN_CHUNKS),
             host_families=host_families,
             master_dtype=(STATE_DTYPES[sd_cfg["master"]]
-                          if self._state_reduced else None))
+                          if self._state_reduced else None),
+            bucket_plan=bucket_plan)
         self.segments = self.flat.segments
+        if self._comm_overlap:
+            log_dist(
+                f"ZeRO-2 overlap_comm: bucketed gradient exchange — "
+                f"{bucket_plan.n_buckets} reduce bucket(s) "
+                f"(reduce_bucket_size={zc.reduce_bucket_size}), "
+                f"{len(bucket_plan.ag_groups)} all-gather group(s) "
+                f"(allgather_bucket_size={zc.allgather_bucket_size}), "
+                f"shard-major sub-partition layout over dp="
+                f"{self.dp_world_size}", ranks=[0])
 
         # master weights (flat fp32, sharded per stage)
         master0 = self.flat.flatten_to_master(params0)
@@ -833,6 +862,19 @@ class DeepSpeedEngine:
         fraction from.  None when the update does not stream."""
         return getattr(self, "_host_stream_schedule", None)
 
+    def collective_schedule(self):
+        """Declared issue schedule of the ZeRO-2 data-parallel gradient
+        exchange (``{overlap, rs_buckets, ag_buckets, ...}``) — what
+        the overlap analyzer prices the exposed collective wire from.
+        None when the bucketed exchange is unsupported on this
+        config/mesh (no claim either way)."""
+        return getattr(self, "_collective_schedule", None)
+
+    def comm_overlap_enabled(self):
+        """True when the bucketed overlapped gradient exchange
+        (``zero_optimization.overlap_comm``) is active."""
+        return bool(getattr(self, "_comm_overlap", False))
+
     def fp16_enabled(self):
         return self._config.fp16_enabled
 
@@ -1056,7 +1098,7 @@ class DeepSpeedEngine:
             # the flat fp32 master's footprint: the DSP611 "parameter-
             # sized payload" floor (reduced storage dtypes only shrink
             # host buffers; the flatten path stages fp32)
-            "param_bytes": int(np.prod(self.segments.shape)) * 4,
+            "param_bytes": int(np.prod(self.flat.flat_shape)) * 4,
             "master_provenance": getattr(self.flat, "master_provenance",
                                          None),
             # overlap-analysis context (profiling/overlap, DSO7xx):
@@ -1070,6 +1112,10 @@ class DeepSpeedEngine:
             # the exposed fraction from — None means serialized-by-
             # construction (pre-overlap engines / no streaming)
             "host_stream_schedule": self.host_stream_schedule(),
+            # the declared bucketed-collective schedule (overlap_comm):
+            # the gradient-exchange twin of the host-stream declaration,
+            # priced by the overlap analyzer on the exchange programs
+            "collective_schedule": self.collective_schedule(),
             "device_kind": getattr(self.mesh.devices.flat[0],
                                    "device_kind", ""),
         }
@@ -1292,18 +1338,69 @@ class DeepSpeedEngine:
                 self.mesh, self.flat.master_sharding, self.flat.replicated)
         opt_shape = jax.eval_shape(
             self.optimizer.init_state,
-            jax.ShapeDtypeStruct(self.segments.shape, jnp.float32))
+            jax.ShapeDtypeStruct(self.flat.flat_shape, jnp.float32))
         if self.flat.host_group_bounds is not None:
             # grouped state: one sharding per row-group buffer
             return jax.tree_util.tree_map(
                 lambda l: (tuple(self.flat.master_sharding
                                  for _ in self.flat.host_group_bounds)
-                           if l.shape == self.segments.shape
+                           if l.shape == self.flat.flat_shape
                            else self.flat.replicated),
                 opt_shape)
         return jax.tree_util.tree_map(
             lambda l: self.flat.master_sharding if l.ndim > 0 else self.flat.replicated,
             opt_shape)
+
+    def _resolve_comm_overlap(self, zc, client_optimizer):
+        """Resolve ``zero_optimization.overlap_comm`` (auto|true|false)
+        against what the bucketed exchange supports.  Returns
+        ``(enabled, unsupported_reason)``: ``unsupported_reason`` is
+        None exactly when the bucketed exchange COULD run here — the
+        engine still declares the (serialized) collective schedule for
+        the overlap analyzer in that case even when the answer is off,
+        so the A/B control carries its receipt."""
+        reason = None
+        shape = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+        if self.zero_stage != 2:
+            reason = (f"requires ZeRO stage 2 (the sharded-gradient "
+                      f"exchange; stage={self.zero_stage})")
+        elif self.dp_world_size <= 1:
+            reason = ("requires dp > 1 (a single data group has no "
+                      "gradient exchange to overlap)")
+        elif any(sz > 1 for ax, sz in shape.items() if ax != "data"):
+            reason = (f"requires a pure data-parallel mesh (got "
+                      f"{shape}); model/pipe/seq/expert axes keep the "
+                      f"GSPMD exchange")
+        elif zc.cpu_offload:
+            reason = ("does not compose with cpu_offload (the streamed "
+                      "update owns the flat chunk layout)")
+        elif self._config.sparse_gradients_enabled:
+            reason = ("does not compose with sparse_gradients (its "
+                      "shard_map step owns the gradient exchange)")
+        else:
+            if client_optimizer is not None:
+                opt_ok = (type(client_optimizer).__name__ == "FusedAdam"
+                          and not getattr(client_optimizer,
+                                          "needs_segment_ids", False))
+            else:
+                name = (self._config.optimizer_name
+                        or C.ADAM_OPTIMIZER).lower()
+                opt_ok = name in (C.ADAM_OPTIMIZER, "adamw")
+            if not opt_ok:
+                reason = ("requires the flat Adam/AdamW optimizer (the "
+                          "per-bucket update must be elementwise; LAMB "
+                          "trust ratios and segment-aware optimizers "
+                          "need the whole buffer)")
+        cfg = zc.overlap_comm
+        if cfg is False:
+            return False, reason
+        if cfg is True:
+            if reason is not None:
+                raise ValueError(
+                    f"zero_optimization.overlap_comm: true but the "
+                    f"bucketed exchange {reason}")
+            return True, None
+        return reason is None, reason
 
     def _configure_basic_optimizer(self, client_optimizer):
         if client_optimizer is not None:
@@ -1659,6 +1756,44 @@ class DeepSpeedEngine:
                     f"state wire bytes/step (fp32 layout: "
                     f"{host_state_bytes_per_step(segments.rows, LANES, None, n_flat_leaves=n_flat_leaves) / 2**30:.2f} GB)",
                     ranks=[0])
+
+        # Declared collective schedule (profiling/overlap, DSO7xx): the
+        # bucketed-exchange twin of the host-stream declaration above.
+        # Whenever the bucketed exchange is SUPPORTED here (stage-2
+        # pure-dp mesh, flat Adam, no offload/sparse) the engine
+        # declares the bucket geometry it would build — with
+        # ``overlap`` recording whether it actually did — so the
+        # overlap analyzer can price the exposed fraction: pipelined =
+        # fill/drain exposed and steady-state buckets hidden up to the
+        # independent-compute window; serialized control = the full
+        # wire exposed with the POTENTIAL window recorded (what the
+        # bucketed schedule could have hidden — the DSO701 message).
+        self._collective_schedule = None
+        if self._comm_overlap or self._comm_overlap_unsupported is None:
+            pplan = bucket_plan_decl = self.flat.bucket_plan
+            if bucket_plan_decl is None:
+                from .zero.buckets import BucketPlan
+
+                pplan = BucketPlan(
+                    list(self.segments.sizes), dp=self.dp_world_size,
+                    reduce_bucket_size=(
+                        self._config.zero_config.reduce_bucket_size),
+                    allgather_bucket_size=(
+                        self._config.zero_config.allgather_bucket_size))
+            sched = pplan.schedule()
+            sched["overlap"] = bool(self._comm_overlap)
+            # fp32 flat payloads: the reduce-scatter side moves the
+            # gradient buffer, the all-gather side the updated master
+            sched["grad_bytes"] = int(pplan.rows * LANES * 4)
+            sched["gather_bytes"] = int(pplan.rows * LANES * 4)
+            self._collective_schedule = sched
+            if self.telemetry.enabled:
+                self.telemetry.gauge("comm/overlap_comm_enabled").set(
+                    float(bool(self._comm_overlap)))
+                self.telemetry.gauge("comm/reduce_buckets").set(
+                    float(sched["rs_buckets"]))
+                self.telemetry.gauge("comm/allgather_groups").set(
+                    float(sched["ag_buckets"]))
 
         host_big = self.flat.master_sharding
 
@@ -2161,6 +2296,21 @@ class DeepSpeedEngine:
                     gnorm, qres, cast_list)
 
         def cast_params(master):
+            if self._comm_overlap:
+                # bucketed overlap_comm layout: per-allgather-group
+                # gathers in a manual region (helpers defined below in
+                # this scope; tracing happens after the whole builder
+                # ran, so the late binding is safe)
+                leaves = shard_map(
+                    lambda m: _gather_cast_leaves(m), mesh=mesh,
+                    in_specs=(P(DATA_AXIS),),
+                    out_specs=tuple(rep_spec for _ in ag_templates),
+                    axis_names={DATA_AXIS}, check_vma=False)(master)
+                params = jax.tree_util.tree_unflatten(param_treedef,
+                                                      list(leaves))
+                return jax.tree_util.tree_map(
+                    lambda x, s: jax.lax.with_sharding_constraint(x, s),
+                    params, param_shardings)
             # stage 3 skips the up-front full replication: each leaf's row
             # slice gathers lazily from the sharded master, so XLA can
             # schedule per-layer gathers and free them after last use
@@ -2304,10 +2454,179 @@ class DeepSpeedEngine:
             flat_g = jax.lax.with_sharding_constraint(flat_g, grad_sharding)
             return sloss * grad_acc / cur_scale, flat_g, drops
 
+        # -- bucketed gradient-collective overlap (overlap_comm) --------
+        # The GSPMD fused exchange concatenates every leaf's gradient
+        # and reduce-scatters the whole flat buffer at once: one
+        # collective that depends on the ENTIRE backward, so nothing
+        # can hide its wire (profiling/overlap classifies it
+        # serialized).  Under overlap_comm the exchange becomes one
+        # explicit psum_scatter per reduce_bucket_size-bounded,
+        # leaf-aligned bucket inside a manual shard_map region, issued
+        # in backward-production order (later layers' grads materialize
+        # first) — bucket i's reduce-scatter is data-independent of the
+        # still-running earlier-layer backward, so XLA's latency-hiding
+        # scheduler can overlap them.  The flat buffers live in the
+        # plan's shard-major sub-partition layout (zero/buckets.py):
+        # each rank owns its piece of every bucket, contiguous in its
+        # local shard, so the per-bucket update slices and the
+        # per-group master all-gathers (allgather_bucket_size) stay
+        # collective-free beyond the declared schedule.
+        comm_overlap = bool(self._comm_overlap)
+        bucket_plan = self.flat.bucket_plan
+        flat_shape = self.flat.flat_shape
+        rep_spec = P()
+        ag_templates = jax.tree_util.tree_leaves(self._param_template)
+        _, param_treedef = jax.tree_util.tree_flatten(self._param_template)
+
+        def bucketed_loss_and_flat_grads(params, batch, rng, cur_scale,
+                                         extra):
+            dp = self.dp_world_size
+
+            def body(batch_, rng_, cur_scale_, extra_, params_):
+                key = jax.random.fold_in(rng_,
+                                         jax.lax.axis_index(DATA_AXIS))
+
+                def scaled_loss(p):
+                    loss = self._loss_fn(p, batch_, rng=key, train=True,
+                                         **extra_)
+                    return (loss.astype(jnp.float32) * cur_scale_) / grad_acc
+
+                sloss, grads = jax.value_and_grad(scaled_loss)(params_)
+                leaves = jax.tree_util.tree_leaves(grads)
+                inv_dp = jnp.float32(1.0 / dp)
+                pieces = [None] * bucket_plan.n_buckets
+                # reversed = backward-production order: the backward
+                # frees later leaves first, so the first-issued bucket
+                # is ready while earlier layers still differentiate
+                for bi in reversed(range(bucket_plan.n_buckets)):
+                    block = bucket_plan.bucket_block_from_leaves(
+                        leaves, bi, jnp.float32)
+                    pieces[bi] = jax.lax.psum_scatter(
+                        block, DATA_AXIS, scatter_dimension=0,
+                        tiled=True) * inv_dp
+                local = jnp.concatenate(pieces, axis=0)
+                return jax.lax.pmean(sloss, DATA_AXIS), local
+
+            sloss, flat_g = shard_map(
+                body, mesh=mesh,
+                in_specs=(P(DATA_AXIS), rep_spec, rep_spec, rep_spec,
+                          rep_spec),
+                out_specs=(rep_spec, P(DATA_AXIS)),
+                axis_names={DATA_AXIS}, check_vma=False)(
+                batch, rng, cur_scale, extra, params)
+            return sloss * grad_acc / cur_scale, flat_g, {}
+
+        def _gather_cast_leaves(m_loc):
+            """Manual-region helper: my (piece_rows, LANES) master shard
+            -> every param leaf in compute dtype, ONE all_gather per
+            allgather_bucket_size group — each leaf then depends only on
+            its group's gather (and that gather only on its buckets'
+            updated pieces), so the gathers overlap the other buckets'
+            update compute."""
+            out = [None] * len(ag_templates)
+            for g_lo, g_hi in bucket_plan.ag_groups:
+                lo_b = bucket_plan.buckets[g_lo]
+                hi_b = bucket_plan.buckets[g_hi - 1]
+                piece = jax.lax.slice_in_dim(
+                    m_loc, lo_b.piece_start,
+                    hi_b.piece_start + hi_b.piece_rows)
+                full = jax.lax.all_gather(piece, DATA_AXIS, axis=0,
+                                          tiled=False)
+                off = 0
+                for bi in range(g_lo, g_hi):
+                    b = bucket_plan.buckets[bi]
+                    block = full[:, off:off + b.piece_rows].reshape(
+                        b.rows, LANES)
+                    off += b.piece_rows
+                    carved = bucket_plan.carve_bucket(
+                        block, bi, ag_templates, self.compute_dtype)
+                    for k, li in enumerate(range(b.leaf_lo, b.leaf_hi)):
+                        out[li] = carved[k]
+            return tuple(out)
+
+        def bucketed_update_and_cast(master, opt_state, g, hp, overflow,
+                                     want_cast):
+            """Per-bucket optimizer update + per-group master all-gather
+            in ONE manual region, so bucket b's gather depends only on
+            bucket b's update — the pipeline's drain side.  Elementwise
+            math on contiguous local slices; scalars (step counter)
+            update once."""
+            opt_leaves, opt_def = jax.tree_util.tree_flatten(opt_state)
+            flat_idx = [i for i, l in enumerate(opt_leaves)
+                        if getattr(l, "shape", None) == flat_shape]
+            flat_set = set(flat_idx)
+
+            def body(m_loc, flats_loc, g_loc, overflow_, hp_):
+                new_m = []
+                new_flats = [[] for _ in flat_idx]
+                scalars_out = None
+                for b in bucket_plan.buckets:
+                    lo, hi = b.piece_start, b.piece_start + b.piece_rows
+                    pm = jax.lax.slice_in_dim(m_loc, lo, hi)
+                    pg = jax.lax.slice_in_dim(g_loc, lo, hi)
+                    lv = list(opt_leaves)
+                    slices = {}
+                    for k, i in enumerate(flat_idx):
+                        slices[i] = jax.lax.slice_in_dim(
+                            flats_loc[k], lo, hi)
+                        lv[i] = slices[i]
+                    st_b = jax.tree_util.tree_unflatten(opt_def, lv)
+                    npm, nst = optimizer.update(st_b, pm, pg, hp_)
+                    n_lv = jax.tree_util.tree_leaves(nst)
+                    if skip_bad:
+                        npm = jnp.where(overflow_, pm, npm)
+                    new_m.append(npm)
+                    for k, i in enumerate(flat_idx):
+                        nv = n_lv[i]
+                        if skip_bad:
+                            nv = jnp.where(overflow_, slices[i], nv)
+                        new_flats[k].append(nv)
+                    if scalars_out is None:
+                        scalars_out = []
+                        for i, nv in enumerate(n_lv):
+                            if i in flat_set:
+                                continue
+                            if skip_bad:
+                                nv = jnp.where(overflow_, opt_leaves[i],
+                                               nv)
+                            scalars_out.append(nv)
+                m_out = jnp.concatenate(new_m, axis=0)
+                flats_out = tuple(jnp.concatenate(f, axis=0)
+                                  for f in new_flats)
+                cast = (_gather_cast_leaves(m_out) if want_cast else ())
+                return m_out, flats_out, tuple(scalars_out or ()), cast
+
+            n_scalars = len(opt_leaves) - len(flat_idx)
+            m_out, flats_out, scalars_out, cast_leaves = shard_map(
+                body, mesh=mesh,
+                in_specs=(P(DATA_AXIS),
+                          tuple(P(DATA_AXIS) for _ in flat_idx),
+                          P(DATA_AXIS), rep_spec, rep_spec),
+                out_specs=(P(DATA_AXIS),
+                           tuple(P(DATA_AXIS) for _ in flat_idx),
+                           tuple(rep_spec for _ in range(n_scalars)),
+                           tuple(rep_spec for _ in ag_templates)
+                           if want_cast else ()),
+                axis_names={DATA_AXIS}, check_vma=False)(
+                master, tuple(opt_leaves[i] for i in flat_idx), g,
+                overflow, hp)
+            lv = list(opt_leaves)
+            scal_iter = iter(scalars_out)
+            for i in range(len(lv)):
+                lv[i] = (flats_out[flat_idx.index(i)] if i in flat_set
+                         else next(scal_iter))
+            new_opt = jax.tree_util.tree_unflatten(opt_def, lv)
+            new_params = (jax.tree_util.tree_unflatten(
+                param_treedef, list(cast_leaves)) if want_cast else None)
+            return m_out, new_opt, new_params
+
         def loss_and_flat_grads(params, batch, rng, cur_scale, extra):
             if sparse_paths:
                 return sparse_loss_and_flat_grads(params, batch, rng,
                                                   cur_scale, extra)
+            if comm_overlap:
+                return bucketed_loss_and_flat_grads(params, batch, rng,
+                                                    cur_scale, extra)
 
             def scaled_loss(p):
                 loss = self._loss_fn(p, batch, rng=rng, train=True, **extra)
@@ -2375,6 +2694,27 @@ class DeepSpeedEngine:
             else:
                 gnorm = jnp.asarray(0.0, jnp.float32)
 
+            if comm_overlap:
+                # bucketed layout: per-bucket update + per-group master
+                # all-gather in one manual region (the overflow pick
+                # folds in per bucket).  The scalar reductions above
+                # (global gnorm/finiteness) are the mathematical
+                # barrier between the reduce-scatters and the updates —
+                # same caveat as the offload pipeline's clip note.
+                new_master, new_opt, cast_tree = bucketed_update_and_cast(
+                    master, opt_state, g, hp, overflow, want_cast)
+                if fp16 and dynamic:
+                    scale_state = update_scale_state(
+                        scale_state, overflow,
+                        scale_window=scale_args.get("scale_window", 1000),
+                        min_scale=scale_args.get("min_scale", 1.0),
+                        delayed_shift=scale_args.get("delayed_shift", 1))
+                if skip_bad:
+                    skipped = skipped + overflow.astype(jnp.int32)
+                base = (new_master, new_opt, scale_state, skipped,
+                        overflow, gnorm, qres)
+                return base + ((cast_tree,) if want_cast else ())
+
             if offload_stream:
                 # streamed offload: per-chunk fp16 pick happens inside
                 if offload_uniform:
@@ -2400,7 +2740,8 @@ class DeepSpeedEngine:
 
             master = to_device(master)
             opt_state = jax.tree_util.tree_map(
-                lambda l: to_device(l) if getattr(l, "shape", ()) == segments.shape
+                lambda l: to_device(l)
+                if getattr(l, "shape", ()) == self.flat.flat_shape
                 else l, opt_state)
 
             new_master, new_opt = optimizer.update(
@@ -2516,16 +2857,21 @@ class DeepSpeedEngine:
                 drops = {**drops0, **drops}
             else:
                 (flat_g, _, drops), losses = jax.lax.scan(
-                    micro, (jnp.zeros(segments.shape, jnp.float32),
+                    micro, (jnp.zeros(flat_shape, jnp.float32),
                             jnp.asarray(0, jnp.int32), drops0), batches)
 
             upd = apply_update(master, opt_state, scale_state, skipped,
                                flat_g, hp, segment_ids, qres=qres,
-                               want_cast=offload_stream)
+                               want_cast=offload_stream or comm_overlap)
             (master, opt_state, scale_state, skipped, overflow,
              gnorm, qres) = upd[:7]
             if stage3:
                 new_params = None
+            elif comm_overlap:
+                # params carved from the update region's own per-group
+                # all-gathers — bucket b's gather waited only on bucket
+                # b's update, not on the whole step
+                new_params = upd[7]
             elif offload_stream and upd[7] is not None:
                 # params assembled from the update's own device chunks —
                 # no post-update re-read of the host master
@@ -3477,7 +3823,7 @@ class DeepSpeedEngine:
         flat host buffer matching ``like``'s dtype/sharding/layout;
         ``arr=None`` zero-fills (residual reset)."""
         if arr is None:
-            padded = np.zeros(self.segments.shape, np.float32)
+            padded = np.zeros(self.flat.flat_shape, np.float32)
         else:
             padded = self.flat.repad_unpadded(np.asarray(arr).reshape(-1))
         if type(like) is tuple:
@@ -3510,7 +3856,7 @@ class DeepSpeedEngine:
                     for (r0, rc), g in zip(self.flat.host_group_bounds,
                                            leaf)))
                 continue
-            if arr.ndim == 1 and leaf.shape == self.segments.shape:
+            if arr.ndim == 1 and leaf.shape == self.flat.flat_shape:
                 # flat buffer saved unpadded (possibly different DP degree)
                 arr = self.flat.repad_unpadded(arr)
             elif arr.shape != leaf.shape:

@@ -79,11 +79,15 @@ class OnebitAdam:
         self.defaults = {"lr": lr, "betas": tuple(betas)}
 
     # error-buffer geometry: flat size padded so every rank serves an equal
-    # chunk of whole bytes (stage 0 does not pad rows to the dp degree)
+    # chunk of whole bytes (stage 0 does not pad rows to the dp degree);
+    # the alignment itself is owned by comm/compression.padded_size —
+    # compressed_allreduce pads/trims the DATA buffer internally, the
+    # optimizer only allocates the persistent error buffers at the
+    # padded size
     def _padded_n(self, flat_shape):
-        n = int(np.prod(flat_shape))
-        q = 8 * self.dp
-        return -(-n // q) * q
+        from ...comm.compression import padded_size
+
+        return padded_size(int(np.prod(flat_shape)), self.dp)
 
     def init_state(self, flat_master) -> OnebitAdamState:
         z = jnp.zeros_like(flat_master)
@@ -148,11 +152,8 @@ class OnebitAdam:
         momentum consensus is the 1-bit collective, and the dense gradient
         all-reduce never happens.  Signature mirrors the engine's fused
         ``train_step`` so the engine can switch host-side."""
-        dp = self.dp
         eps = self.eps
         segments = flat_coordinator.segments
-        n = int(np.prod(segments.shape))
-        n_pad = self._padded_n(segments.shape)
 
         def compressed_step(master, opt_state, scale_state, skipped, ustep,
                             params, packed, unpack_spec, hp, segment_ids,
@@ -193,11 +194,12 @@ class OnebitAdam:
                         jnp.arange(acc_steps))
 
                 # rank-local momentum; THE data-axis sync is 1-bit
+                # (compressed_allreduce pads to 8*world alignment and
+                # trims internally — real flat sizes just work)
                 m_local = beta1 * m + (1.0 - beta1) * flat_g
-                buf = jnp.pad(m_local.reshape(-1), (0, n_pad - n))
                 m_bar, new_we, new_se = compressed_allreduce(
-                    buf, we, se, DATA_AXIS)
-                m_bar = m_bar[:n].reshape(segments.shape)
+                    m_local.reshape(-1), we, se, DATA_AXIS)
+                m_bar = m_bar.reshape(segments.shape)
 
                 update = m_bar / (jnp.sqrt(v) + eps) + wd * master_
                 new_master = master_ - lr * update

@@ -1,0 +1,266 @@
+"""Bucketed gradient-exchange layout for ZeRO-2 ``overlap_comm``.
+
+The reference hides data-parallel gradient communication behind backward
+compute by filling fixed-size buckets as gradients arrive and reducing
+each bucket asynchronously (``stage2.py:583-738``,
+``reduce_bucket_size`` / ``allgather_bucket_size`` /
+``overlap_comm``).  Under GSPMD the repo's flat-buffer design emits ONE
+fused end-of-backward exchange instead: the whole (rows, LANES) flat
+gradient concatenates and reduce-scatters at once, so the collective
+depends on EVERY leaf's gradient and nothing can overlap it — the wire
+is exposed by construction (profiling/overlap classifies it
+``serialized``).
+
+This module is the layout half of the fix: split the flat space into
+**leaf-aligned buckets** of at most ``reduce_bucket_size`` elements and
+issue one explicit ``psum_scatter`` per bucket inside the engine's
+``shard_map`` region, in backward-production order (later layers'
+gradients materialize first), so bucket *i*'s reduce-scatter is
+data-independent of the still-running earlier-layer backward and XLA's
+latency-hiding scheduler can overlap them.  The ZeRO-2 master
+all-gather takes the same treatment via ``allgather_bucket_size``
+groups of buckets.
+
+**The sub-partition layout.**  A per-bucket ``psum_scatter`` hands rank
+*r* the *r*-th piece of every bucket — which is only a valid resident
+layout if the flat master/optimizer state adopts it too.  So under
+``overlap_comm`` the flat buffers store rows in **shard-major order**::
+
+    storage row order = [rank 0: bucket 0 piece 0, bucket 1 piece 0, ...]
+                        [rank 1: bucket 0 piece 1, bucket 1 piece 1, ...]
+                        ...
+
+which is exactly the reference ZeRO-1 design of "each rank owns a
+sub-partition of every communication interval"
+(``stage1.py:32-103``, comm-interval-aligned sub-partitions).  A plain
+``P("data")`` row sharding of the storage buffer then gives every rank
+precisely its bucket pieces, each contiguous in its local shard.  All
+elementwise math (Adam, clipping, overflow detection) is
+layout-agnostic; the ONLY places the permutation is visible are the
+leaf<->flat conversions this class centralizes.  Checkpoints remain
+canonical (unpadded 1-D, leaf order): :meth:`gather_unpadded` /
+:meth:`scatter_unpadded` convert at save/load, so bucketed and
+unbucketed engines (and different dp degrees — bucket padding depends
+on dp) restore each other's checkpoints bit-exactly.
+
+The canonical<->storage permutation is a pair of reshapes per bucket:
+a bucket's canonical block ``(rows_b, LANES)`` viewed as
+``(dp, rows_b/dp, LANES)`` stacks its per-rank pieces; concatenating
+every bucket's view along axis 1 and flattening the first two axes IS
+the shard-major order.
+"""
+
+from typing import List, NamedTuple, Tuple
+
+import numpy as np
+
+from ...ops.op_common import LANES
+
+
+class Bucket(NamedTuple):
+    index: int
+    leaf_lo: int                      # first leaf index (inclusive)
+    leaf_hi: int                      # last leaf index (exclusive)
+    rows: int                         # bucket rows, divisible by dp
+    piece_rows: int                   # rows // dp (one rank's piece)
+    start_row: int                    # first row in CANONICAL plan layout
+    piece_start: int                  # first row of the piece in a local shard
+    leaf_row_offsets: Tuple[int, ...]  # within-bucket row offset per leaf
+    elements: int                     # true (unpadded) elements covered
+
+
+class BucketPlan:
+    """Static bucketed layout over a flat parameter space.
+
+    Args:
+        sizes: true element count per leaf, in ``tree_leaves`` order.
+        dp: data-parallel degree (every bucket's rows pad to a multiple).
+        reduce_bucket_size: max elements per reduce-scatter bucket
+            (>= 1 leaf per bucket regardless — a single leaf larger than
+            the bucket size becomes its own bucket, reference behavior).
+        allgather_bucket_size: max elements per all-gather group of
+            consecutive buckets.
+        lanes: flat-buffer lane width (tests may shrink it).
+    """
+
+    def __init__(self, sizes, dp, reduce_bucket_size,
+                 allgather_bucket_size, lanes=LANES):
+        self.dp = int(dp)
+        self.lanes = int(lanes)
+        self.sizes = tuple(int(s) for s in sizes)
+        self.reduce_bucket_size = int(reduce_bucket_size)
+        self.allgather_bucket_size = int(allgather_bucket_size)
+        assert self.dp >= 1
+        row_counts = [-(-s // self.lanes) for s in self.sizes]
+
+        buckets: List[Bucket] = []
+        start_row = piece_start = 0
+        lo = 0
+        n = len(self.sizes)
+        while lo < n:
+            hi = lo + 1
+            elems = self.sizes[lo]
+            while (hi < n
+                   and elems + self.sizes[hi] <= self.reduce_bucket_size):
+                elems += self.sizes[hi]
+                hi += 1
+            offs, r = [], 0
+            for i in range(lo, hi):
+                offs.append(r)
+                r += row_counts[i]
+            rows = -(-max(r, 1) // self.dp) * self.dp  # pad to dp
+            buckets.append(Bucket(
+                index=len(buckets), leaf_lo=lo, leaf_hi=hi, rows=rows,
+                piece_rows=rows // self.dp, start_row=start_row,
+                piece_start=piece_start, leaf_row_offsets=tuple(offs),
+                elements=elems))
+            start_row += rows
+            piece_start += rows // self.dp
+            lo = hi
+        if not buckets:
+            buckets.append(Bucket(index=0, leaf_lo=0, leaf_hi=0,
+                                  rows=self.dp, piece_rows=1, start_row=0,
+                                  piece_start=0, leaf_row_offsets=(),
+                                  elements=0))
+        self.buckets: Tuple[Bucket, ...] = tuple(buckets)
+        self.rows = sum(b.rows for b in self.buckets)
+        self.piece_rows = self.rows // self.dp
+        self.shape = (self.rows, self.lanes)
+
+        # all-gather groups: consecutive buckets, greedy by element count
+        groups: List[Tuple[int, int]] = []
+        g_lo = 0
+        while g_lo < len(self.buckets):
+            g_hi = g_lo + 1
+            elems = self.buckets[g_lo].elements
+            while (g_hi < len(self.buckets)
+                   and elems + self.buckets[g_hi].elements
+                   <= self.allgather_bucket_size):
+                elems += self.buckets[g_hi].elements
+                g_hi += 1
+            groups.append((g_lo, g_hi))
+            g_lo = g_hi
+        self.ag_groups: Tuple[Tuple[int, int], ...] = tuple(groups)
+
+    @property
+    def n_buckets(self):
+        return len(self.buckets)
+
+    # -- leaf bookkeeping (canonical plan layout) ------------------------
+    def leaf_rows(self):
+        """Per-leaf ``(row_offset, row_count, size)`` in the CANONICAL
+        plan layout (bucket-padded concat) — the plan-space analog of
+        the Segments fields the unbucketed layout uses."""
+        out = []
+        row_counts = [-(-s // self.lanes) for s in self.sizes]
+        for b in self.buckets:
+            for k, i in enumerate(range(b.leaf_lo, b.leaf_hi)):
+                out.append((b.start_row + b.leaf_row_offsets[k],
+                            row_counts[i], self.sizes[i]))
+        return out
+
+    # -- canonical <-> storage permutation (host/numpy) ------------------
+    def storage_from_canonical(self, canon):
+        """(rows, lanes) canonical (bucket-concat) -> shard-major
+        storage order.  Pure reshape/concat — exact for any dtype."""
+        canon = np.asarray(canon).reshape(self.rows, self.lanes)
+        parts = [canon[b.start_row:b.start_row + b.rows].reshape(
+            self.dp, b.piece_rows, self.lanes) for b in self.buckets]
+        return np.concatenate(parts, axis=1).reshape(self.shape)
+
+    def canonical_from_storage(self, storage):
+        storage = np.asarray(storage).reshape(
+            self.dp, self.piece_rows, self.lanes)
+        parts = []
+        for b in self.buckets:
+            parts.append(storage[:, b.piece_start:b.piece_start
+                                 + b.piece_rows].reshape(b.rows,
+                                                         self.lanes))
+        return np.concatenate(parts, axis=0)
+
+    # -- checkpoint format (canonical unpadded 1-D) ----------------------
+    def gather_unpadded(self, storage):
+        """Storage-order host array -> true-sized 1-D fp32 (the
+        checkpoint format — identical bytes to the unbucketed layout's
+        ``gather_master_unpadded``)."""
+        canon = self.canonical_from_storage(storage)
+        if canon.dtype != np.float32:
+            canon = canon.astype(np.float32)
+        flat = canon.reshape(-1)
+        parts = [flat[ro * self.lanes:ro * self.lanes + sz]
+                 for ro, _, sz in self.leaf_rows()]
+        return (np.concatenate(parts) if parts
+                else np.zeros((0,), np.float32))
+
+    def scatter_unpadded(self, arr):
+        """True-sized 1-D buffer -> (rows, lanes) fp32 STORAGE order."""
+        arr = np.asarray(arr).reshape(-1)
+        canon = np.zeros((self.rows * self.lanes,), np.float32)
+        off = 0
+        for ro, _, sz in self.leaf_rows():
+            canon[ro * self.lanes:ro * self.lanes + sz] = arr[off:off + sz]
+            off += sz
+        assert off == arr.size, (
+            f"flat buffer has {arr.size} elements, expected {off}")
+        return self.storage_from_canonical(
+            canon.reshape(self.rows, self.lanes))
+
+    # -- traced helpers (inside jit / shard_map manual region) ----------
+    def bucket_block_from_leaves(self, leaves, b, dtype):
+        """Leaves ``[leaf_lo, leaf_hi)`` -> the bucket's canonical
+        ``(rows_b, lanes)`` block (per-leaf row padding + bucket dp-pad
+        zeros), traced."""
+        import jax.numpy as jnp
+
+        bucket = self.buckets[b]
+        parts = []
+        used = 0
+        for k, i in enumerate(range(bucket.leaf_lo, bucket.leaf_hi)):
+            fl = jnp.ravel(leaves[i]).astype(dtype)
+            rc = -(-self.sizes[i] // self.lanes)
+            pad = rc * self.lanes - self.sizes[i]
+            if pad:
+                fl = jnp.concatenate([fl, jnp.zeros((pad,), dtype)])
+            parts.append(fl)
+            used += rc
+            del k
+        tail = bucket.rows - used
+        if tail > 0:
+            parts.append(jnp.zeros((tail * self.lanes,), dtype))
+        if not parts:
+            return jnp.zeros((bucket.rows, self.lanes), dtype)
+        return jnp.concatenate(parts).reshape(bucket.rows, self.lanes)
+
+    def carve_bucket(self, block, b, templates, dtype):
+        """Canonical bucket block -> list of leaf arrays (bucket's
+        leaves, in order), traced.  ``templates`` indexes ALL leaves."""
+        bucket = self.buckets[b]
+        flat = block.reshape(-1)
+        out = []
+        for k, i in enumerate(range(bucket.leaf_lo, bucket.leaf_hi)):
+            start = bucket.leaf_row_offsets[k] * self.lanes
+            vals = flat[start:start + self.sizes[i]]
+            out.append(vals.reshape(templates[i].shape).astype(dtype))
+        return out
+
+    def canonical_from_storage_traced(self, storage):
+        """Traced twin of :meth:`canonical_from_storage` (used by the
+        plan-aware ``unflatten_params`` fallback paths)."""
+        import jax.numpy as jnp
+
+        st = storage.reshape(self.dp, self.piece_rows, self.lanes)
+        parts = [st[:, b.piece_start:b.piece_start + b.piece_rows]
+                 .reshape(b.rows, self.lanes) for b in self.buckets]
+        return jnp.concatenate(parts, axis=0)
+
+    def schedule(self):
+        """The engine-declared collective schedule skeleton: static
+        bucket geometry the overlap analyzer prices (the engine adds
+        the ``overlap`` flag and byte totals)."""
+        return {
+            "rs_buckets": int(self.n_buckets),
+            "ag_buckets": int(len(self.ag_groups)),
+            "reduce_bucket_size": int(self.reduce_bucket_size),
+            "allgather_bucket_size": int(self.allgather_bucket_size),
+            "rows": int(self.rows),
+        }

@@ -39,8 +39,35 @@ class DeepSpeedZeroConfig:
                                                C.ZERO_REDUCE_SCATTER_DEFAULT)
         self.overlap_comm = get_scalar_param(d, C.ZERO_OVERLAP_COMM,
                                              C.ZERO_OVERLAP_COMM_DEFAULT)
+        # identity checks like offload_overlap: 0/1 must not alias the
+        # booleans through int equality
+        if not (self.overlap_comm is True or self.overlap_comm is False
+                or self.overlap_comm == "auto"):
+            raise ValueError(
+                f"overlap_comm must be true, false, or \"auto\", got "
+                f"{self.overlap_comm!r}")
         self.allgather_bucket_size = get_scalar_param(d, C.ZERO_ALLGATHER_BUCKET_SIZE,
                                                       C.ZERO_ALLGATHER_BUCKET_SIZE_DEFAULT)
+        # ValueError (not assert: stripped under -O); bool is an int
+        # subclass and a bucket size of "true" meaning 1 element would
+        # silently explode the bucket count.  Integral FLOATS are
+        # coerced — JSON scientific notation (5e8, the documented
+        # default idiom) parses as float
+        def _bucket_size(key, val):
+            if (isinstance(val, float) and not isinstance(val, bool)
+                    and float(val).is_integer()):
+                val = int(val)
+            if (isinstance(val, bool) or not isinstance(val, int)
+                    or val < 1):
+                raise ValueError(
+                    f"{key} must be a positive integer element count, "
+                    f"got {val!r}")
+            return val
+
+        self.reduce_bucket_size = _bucket_size(
+            C.ZERO_REDUCE_BUCKET_SIZE, self.reduce_bucket_size)
+        self.allgather_bucket_size = _bucket_size(
+            C.ZERO_ALLGATHER_BUCKET_SIZE, self.allgather_bucket_size)
         self.cpu_offload = get_scalar_param(d, C.ZERO_CPU_OFFLOAD,
                                             C.ZERO_CPU_OFFLOAD_DEFAULT)
         self.offload_chunk_mb = get_scalar_param(d, C.ZERO_OFFLOAD_CHUNK_MB,

@@ -160,10 +160,21 @@ class FlatParamCoordinator:
                  cpu_offload=False, group_bytes=None,
                  uniform_chunk_rows=None,
                  uniform_min_chunks=UNIFORM_MIN_CHUNKS,
-                 host_families=3, master_dtype=None):
+                 host_families=3, master_dtype=None, bucket_plan=None):
         self.mesh = mesh
         self.stage = stage
         self.dp_size = dp_size
+        # Bucketed-exchange layout (overlap_comm, zero/buckets.py): when
+        # set, the flat buffers store rows in the plan's SHARD-MAJOR
+        # order (each rank owns its piece of every bucket — the
+        # reference's ZeRO-1 comm-interval sub-partitions) and every
+        # leaf<->flat / checkpoint conversion below routes through the
+        # plan.  Checkpoints stay canonical (unpadded 1-D), so bucketed
+        # and unbucketed engines restore each other bit-exactly.
+        self.bucket_plan = bucket_plan
+        assert bucket_plan is None or not cpu_offload, (
+            "overlap_comm bucketed layout does not compose with "
+            "cpu_offload (the streamed update owns the chunk layout)")
         # how many host-buffer FAMILIES share this row-group layout
         # (master + flat optimizer leaves + optional gradient buffer +
         # optional error-feedback residuals) — the auto group size caps
@@ -279,6 +290,19 @@ class FlatParamCoordinator:
                           memory_kind=self._host_memory_kind)
             if cpu_offload else None)
 
+    @property
+    def flat_shape(self):
+        """Shape of the flat master/grad/optimizer buffers: the bucket
+        plan's (shard-major, bucket-padded) shape under overlap_comm,
+        else the canonical segments shape."""
+        if self.bucket_plan is not None:
+            return self.bucket_plan.shape
+        return self.segments.shape
+
+    @property
+    def flat_rows(self):
+        return self.flat_shape[0]
+
     def home_host(self, buf, sharding=None):
         """``device_put`` a numpy staging buffer into a (pinned-)host
         sharding, RE-HOMED through a jitted copy on single-memory-space
@@ -373,6 +397,25 @@ class FlatParamCoordinator:
         # construction and init-only.
         from ...parallel.mesh import DATA_AXIS, mesh_axis_sizes
 
+        if self.bucket_plan is not None:
+            # Bucketed (shard-major) layout: the permutation is host
+            # arithmetic, so flatten leaf-wise on host into the plan's
+            # storage order and re-home through a jitted copy — the
+            # same laundering the multi-axis path uses (the step
+            # programs DONATE this buffer; a device_put of numpy can
+            # alias the numpy arena on CPU).
+            self.master_provenance = "jit_copy"
+            leaves = jax.tree_util.tree_leaves(params)
+            flat = (np.concatenate(
+                [np.asarray(jax.device_get(l), np.float32).reshape(-1)
+                 for l in leaves]) if leaves
+                else np.zeros((0,), np.float32))
+            storage = self.bucket_plan.scatter_unpadded(flat)
+            del flat
+            with self.mesh:
+                return jax.jit(
+                    _identity_copy,
+                    out_shardings=self.master_device_sharding)(storage)
         multi_axis = any(ax != DATA_AXIS
                          for ax in mesh_axis_sizes(self.mesh))
         if self.cpu_offload:
@@ -451,6 +494,10 @@ class FlatParamCoordinator:
             arr = np.asarray(jax.device_get(g))
             return arr if arr.dtype == np.float32 else arr.astype(np.float32)
 
+        if self.bucket_plan is not None:
+            # shard-major storage -> canonical unpadded 1-D: byte-
+            # identical to the unbucketed layout's checkpoint format
+            return self.bucket_plan.gather_unpadded(_up(master))
         if type(master) is tuple:  # row-group form (NamedTuples are pytree nodes)
             host = np.concatenate([_up(g) for g in master],
                                   axis=0).reshape(-1)
@@ -463,8 +510,12 @@ class FlatParamCoordinator:
         return np.concatenate(parts) if parts else np.zeros((0,), np.float32)
 
     def repad_unpadded(self, arr: np.ndarray) -> np.ndarray:
-        """1-D true-sized buffer → (rows, LANES) padded layout."""
+        """1-D true-sized buffer → (rows, LANES) padded layout (the
+        bucket plan's shard-major storage order when overlap_comm's
+        layout is active)."""
         arr = np.asarray(arr).reshape(-1)
+        if self.bucket_plan is not None:
+            return self.bucket_plan.scatter_unpadded(arr)
         out = np.zeros((self.segments.rows * LANES,), np.float32)
         off = 0
         for ro, n in zip(self.segments.row_offsets, self.segments.sizes):
@@ -519,6 +570,10 @@ class FlatParamCoordinator:
         return jnp.concatenate(blocks, axis=0)
 
     def flatten_grads(self, grads, dtype=jnp.float32):
+        assert self.bucket_plan is None, (
+            "bucketed overlap_comm layout active: gradients exchange "
+            "per bucket inside the engine's shard_map region, never "
+            "through the fused flatten")
         return self._flatten_traced(grads, dtype)
 
     def unflatten_params(self, master, template, dtype, constrain=True):
@@ -530,6 +585,22 @@ class FlatParamCoordinator:
         manual (shard_map) context."""
         flat = (jax.lax.with_sharding_constraint(master, self.replicated)
                 if constrain else master)
+        if self.bucket_plan is not None:
+            # shard-major storage: un-permute (reshape-only) to the
+            # canonical bucket-concat order, then carve by the plan's
+            # leaf row table
+            plan = self.bucket_plan
+            canon = plan.canonical_from_storage_traced(flat)
+            leaves, treedef = jax.tree_util.tree_flatten(template)
+            table = plan.leaf_rows()
+            assert len(leaves) == len(table), (
+                f"template has {len(leaves)} leaves but the bucket plan "
+                f"was built for {len(table)} (model changed after init?)")
+            out = []
+            for (ro, rc, sz), leaf in zip(table, leaves):
+                vals = canon[ro:ro + rc].reshape(-1)[:sz]
+                out.append(vals.reshape(leaf.shape).astype(dtype))
+            return jax.tree_util.tree_unflatten(treedef, out)
         leaves, treedef = jax.tree_util.tree_flatten(template)
         assert len(leaves) == self.segments.num_segments, (
             f"template has {len(leaves)} leaves but the coordinator was built "

@@ -116,6 +116,24 @@ FIELDS = {
     "dsp_downgraded": (numbers.Integral,
                        "DSP602 downgraded verdicts (alias bytes "
                        "unverifiable: warm-cache/absent/partial)"),
+    # ZeRO-2 bucketed-collective A/B row (round 14, bench.py
+    # _measure_zero2_overlap via the fresh-subprocess harness):
+    # overlap_comm on (the headline) vs off (the serialized control) on
+    # a dp mesh, with both schedules' static exposed-wire receipts —
+    # dryrun-marked on non-TPU backends (toy geometry on a virtual CPU
+    # mesh proves the plumbing; the bench attachment proves the ms)
+    "zero2_overlap_ms_per_step": (numbers.Real, "ms, overlap_comm on"),
+    "zero2_serial_ms_per_step": (numbers.Real,
+                                 "ms, serialized control (info)"),
+    "zero2_overlap_exposed_wire_seconds": (numbers.Real,
+                                           "declared-schedule exposure"),
+    "zero2_serial_exposed_wire_seconds": (numbers.Real,
+                                          "control exposure (info)"),
+    "zero2_overlap_fraction": (numbers.Real, "hidden/total, 0..1"),
+    "zero2_overlap_buckets": (numbers.Integral,
+                              "reduce buckets in the schedule"),
+    "zero2_overlap_dp": (numbers.Integral, "data-parallel degree"),
+    "zero2_overlap_note": (str, ""),
     # multichip-dryrun record envelope (dryrun_multichip's one line;
     # legacy blobs keep n_devices/rc/ok/skipped readable)
     "multichip_schema_version": (numbers.Integral, ""),
@@ -153,6 +171,16 @@ _LEG_FIELDS = {
     # attribution receipts (round 13)
     "predicted_step_seconds": numbers.Real,
     "step_unexplained_fraction": numbers.Real,
+    # onebit leg (round 14): the compressed step's wire bytes next to
+    # the fp32 flat buffer and the dense-allreduce ratio (~1/32 — the
+    # 1-bit claim as an asserted receipt, not prose)
+    "compressed_wire_bytes": numbers.Integral,
+    "flat_fp32_bytes": numbers.Integral,
+    "compressed_wire_ratio": numbers.Real,
+    # zero2_overlap leg (round 14): the serialized control's exposure
+    # next to the leg's own exposed_wire_seconds (strictly lower,
+    # asserted in the leg)
+    "serial_exposed_wire_seconds": numbers.Real,
     "error": str,
     "note": str,
 }
@@ -249,6 +277,12 @@ THRESHOLDS = {
     "n_devices": ("higher", 0.0),
     "legs_ok": ("higher", 0.0),
     "legs_failed": ("lower", 0.0),
+    # zero-2 bucketed-collective A/B (round 14): the overlapped row's
+    # step time and exposure are the gated headline; the serialized
+    # control rows are informational (they exist to be worse)
+    "zero2_overlap_ms_per_step": ("lower", 0.25),
+    "zero2_overlap_exposed_wire_seconds": ("lower", 0.25),
+    "zero2_overlap_fraction": ("higher", 0.10),
 }
 
 # thresholds for the pattern-based leg_<name>_<field> family
@@ -259,6 +293,10 @@ _LEG_FIELD_THRESHOLDS = {
     "overlap_fraction": ("higher", 0.10),
     "predicted_step_seconds": ("lower", 0.25),
     "step_unexplained_fraction": ("zero", 0.25),
+    # onebit compressed-path receipts (round 14): more wire (or a
+    # grown ratio) = the compression is leaking dense collectives
+    "compressed_wire_bytes": ("lower", 0.25),
+    "compressed_wire_ratio": ("lower", 0.25),
 }
 
 # thresholds for the pattern-based offload_<row>_<field> family

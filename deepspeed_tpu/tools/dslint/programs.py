@@ -355,6 +355,11 @@ class ProgramArtifact:
     # actually sequences the chunk transfers — what the overlap
     # analyzer prices exposure from (None = serialized by construction)
     host_stream_schedule: Optional[dict] = None
+    # the declared bucketed-collective schedule (overlap_comm bucket
+    # geometry, {overlap, rs_buckets, ag_buckets, ...}) of the ZeRO-2
+    # gradient exchange — producers set it only on exchange programs;
+    # None = no bucketed exchange declared (no claim either way)
+    collective_schedule: Optional[dict] = None
     # device_kind string the roofline/wire tables resolve against
     device_kind: Optional[str] = None
 
@@ -387,6 +392,7 @@ class ProgramArtifact:
             "master_provenance": self.master_provenance,
             "host_state_wire_bytes": self.host_state_wire_bytes,
             "host_stream_schedule": self.host_stream_schedule,
+            "collective_schedule": self.collective_schedule,
             "device_kind": self.device_kind,
         }
 
@@ -443,6 +449,10 @@ def load_run_artifacts(run_dir: str) -> List[ProgramArtifact]:
                 host_stream_schedule=(
                     dict(side["host_stream_schedule"])
                     if isinstance(side.get("host_stream_schedule"), dict)
+                    else None),
+                collective_schedule=(
+                    dict(side["collective_schedule"])
+                    if isinstance(side.get("collective_schedule"), dict)
                     else None),
                 device_kind=side.get("device_kind")))
         except (TypeError, ValueError) as e:
@@ -622,6 +632,7 @@ def program_overlap(artifact: ProgramArtifact):
                 declared_host_wire_bytes=(
                     artifact.host_state_wire_bytes or 0),
                 declared_host_stream=artifact.host_stream_schedule,
+                declared_collective_schedule=artifact.collective_schedule,
                 max_nodes=None)
         except Exception:
             summary = None
@@ -645,19 +656,46 @@ def exposure_metric_key(name: str) -> str:
     return f"<programs>|exposed_wire_seconds|{name}"
 
 
+def comm_exposure_metric_key(name: str) -> str:
+    """Baseline ``metrics`` key for one program's exposed COLLECTIVE
+    wire under a declared overlap_comm schedule.  A distinct metric
+    name, not a reuse of :func:`exposure_metric_key`: the checked-in
+    baseline records the offload fixture's host-stream exposure and the
+    zero-2 fixture's collective exposure for programs that share the
+    ``train_step`` name — one key would collide across the two
+    recorded run dirs."""
+    return f"<programs>|comm_exposed_wire_seconds|{name}"
+
+
+def _exposure_keys(artifact):
+    """The baseline metric keys this artifact ratchets under: the
+    host-stream key when it declares an offload stream, the
+    collective key when it declares an OVERLAPPED bucketed exchange
+    (a serialized control must not record/ratchet its own exposure —
+    it exists to be worse)."""
+    keys = []
+    if artifact.host_state_wire_bytes:
+        keys.append(exposure_metric_key(artifact.name))
+    if (artifact.collective_schedule or {}).get("overlap"):
+        keys.append(comm_exposure_metric_key(artifact.name))
+    return keys
+
+
 def exposure_metrics(artifacts) -> dict:
     """``{metric key: exposed_wire_seconds}`` for every artifact that
-    declares a host stream — what ``--update-baseline`` records so a
-    later run can ratchet against it (``check_exposure_ratchet``)."""
+    declares a host stream or an overlapped collective schedule — what
+    ``--update-baseline`` records so a later run can ratchet against
+    it (``check_exposure_ratchet``)."""
     out = {}
     for artifact in artifacts:
-        if not artifact.host_state_wire_bytes:
+        keys = _exposure_keys(artifact)
+        if not keys:
             continue
         summary = program_overlap(artifact)
         if summary is None:
             continue
-        out[exposure_metric_key(artifact.name)] = round(
-            float(summary["exposed_wire_seconds"]), 9)
+        for key in keys:
+            out[key] = round(float(summary["exposed_wire_seconds"]), 9)
     return out
 
 
@@ -670,7 +708,11 @@ def check_exposure_ratchet(artifacts, baseline_metrics) -> List[Diagnostic]:
     if not baseline_metrics:
         return out
     for artifact in artifacts:
-        recorded = baseline_metrics.get(exposure_metric_key(artifact.name))
+        recorded = None
+        for key in _exposure_keys(artifact):
+            if baseline_metrics.get(key) is not None:
+                recorded = baseline_metrics[key]
+                break
         if recorded is None:
             continue
         summary = program_overlap(artifact)
@@ -684,7 +726,7 @@ def check_exposure_ratchet(artifacts, baseline_metrics) -> List[Diagnostic]:
                 artifact, "DSO704",
                 f"exposed_wire_seconds grew {float(recorded):.6f} -> "
                 f"{current:.6f} (+{EXPOSED_WIRE_RATCHET_TOL:.0%} "
-                "tolerance exceeded): the offload stream is "
+                "tolerance exceeded): the stream/exchange is "
                 "re-serializing — restore the overlapped schedule or "
                 "re-record with --update-baseline"))
     return out
@@ -792,6 +834,13 @@ def check_attribution_ratchet(artifacts_by_dir,
         measured = None
         measured_resolved = False
         for artifact in artifacts:
+            if not artifact.host_state_wire_bytes:
+                # the attribution metrics are recorded ONLY for
+                # host-stream-declaring programs (attribution_metrics'
+                # gate); a same-NAMED program from another fixture dir
+                # (the zero-2 overlap fixture's train_step vs the
+                # offload fixture's) must not ratchet against it
+                continue
             rec_pred = baseline_metrics.get(
                 predicted_step_metric_key(artifact.name))
             rec_ceil = baseline_metrics.get(
@@ -878,22 +927,51 @@ def check_overlap(artifact: ProgramArtifact) -> List[Diagnostic]:
             f"{MAX_WINDOW_INSTRUCTIONS}-instruction window-analysis "
             "cap) — the DSO701/DSO702 window checks did NOT run for "
             "them; their exposure is UNVERIFIED, not clean"))
-    # DSO701: serialized collectives with a real window to hide them
+    # DSO701: serialized collectives with a real window to hide them.
+    # Two windows count: the DAG-independence window (floored at
+    # DSO701_MIN_WINDOW_SECONDS — micro-programs have nothing to
+    # overlap with), and the DECLARED potential window on nodes covered
+    # by an overlap_comm collective schedule with overlap off
+    # (source "hlo+declared"): there the ENGINE declared a bucketed
+    # schedule exists that would free the window, so any nonzero
+    # potential fires — the serialized control's receipt.
+    declared_off = (artifact.collective_schedule is not None
+                    and not artifact.collective_schedule.get("overlap"))
+    declared_on = (artifact.collective_schedule is not None
+                   and bool(artifact.collective_schedule.get("overlap")))
+
+    def _fires(n):
+        if n.get("source") == "hlo+declared":
+            # scheduled exchange nodes: under an OVERLAPPED schedule
+            # the residual exposure is the priced fill/drain — the
+            # DSO704 exposure ratchet owns it, not DSO701; under the
+            # serialized control ANY declared potential window fires
+            # (the engine itself declared bucketing would free it)
+            if declared_on:
+                return False
+            return (declared_off
+                    and (n.get("window_seconds") or 0.0) > 0)
+        return ((n.get("window_seconds") or 0.0)
+                >= DSO701_MIN_WINDOW_SECONDS)
+
     culprits = [n for n in nodes
                 if n["kind"] == KIND_COLLECTIVE
                 and n["classification"] == SERIALIZED
-                and n["seconds"] > 0
-                and (n.get("window_seconds") or 0.0)
-                >= DSO701_MIN_WINDOW_SECONDS]
+                and n["seconds"] > 0 and _fires(n)]
     if culprits:
         wire_ms = sum(n["seconds"] for n in culprits) * 1e3
         window_ms = max(n["window_seconds"] for n in culprits) * 1e3
+        declared = any(n.get("source") == "hlo+declared"
+                       for n in culprits)
+        hint = (" — overlap_comm would bucket and hide this exchange"
+                if declared else
+                " (no -start/-done overlap materialized)")
         out.append(_pdiag(
             artifact, "DSO701",
             f"{len(culprits)} fully serialized collective(s) paying "
             f"{wire_ms:.3f} ms of exposed wire with up to "
             f"{window_ms:.3f} ms of independent compute available to "
-            "hide them (no -start/-done overlap materialized)"))
+            f"hide them{hint}"))
     # DSO702: serialized host transfers next to independent compute
     host = [n for n in nodes
             if n["kind"] == KIND_HOST

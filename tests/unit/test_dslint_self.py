@@ -81,7 +81,8 @@ def test_checked_in_baseline_is_empty_of_violations():
 
     from deepspeed_tpu.tools.dslint.cli import main
     from deepspeed_tpu.tools.dslint.programs import (
-        exposure_metric_key, predicted_step_metric_key)
+        comm_exposure_metric_key, exposure_metric_key,
+        predicted_step_metric_key)
 
     baseline = os.path.join(os.path.dirname(PKG_DIR), "tools",
                             "dslint_baseline.json")
@@ -93,13 +94,19 @@ def test_checked_in_baseline_is_empty_of_violations():
         "violations: fix or pragma findings instead of baselining them")
     metrics = data.get("metrics") or {}
     # round 13 added the attribution budget pin (DSO705) next to the
-    # exposed-wire ratchet (DSO704) — both for the CI offload step,
-    # both re-derived deterministically from the dumped HLO
+    # exposed-wire ratchet (DSO704) — both for the CI offload step —
+    # and round 14 the bucketed zero-2 exchange's collective-exposure
+    # pins (its OWN metric name: the two fixtures share the
+    # "train_step" program name), all re-derived deterministically
+    # from the dumped HLO
     keys = {exposure_metric_key("train_step"),
-            predicted_step_metric_key("train_step")}
+            predicted_step_metric_key("train_step"),
+            comm_exposure_metric_key("train_step"),
+            comm_exposure_metric_key("cast_params")}
     assert set(metrics) == keys, (
         "the baseline records exactly the offload-step exposed-wire + "
-        f"attribution ratchet metrics ({sorted(keys)}); anything else "
+        "attribution ratchet metrics and the zero-2 overlap fixture's "
+        f"collective-exposure metrics ({sorted(keys)}); anything else "
         "needs review")
     for key in keys:
         assert metrics[key] > 0

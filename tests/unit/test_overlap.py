@@ -733,3 +733,134 @@ def test_dso704_ratchet_has_an_absolute_floor():
     assert 0 < cur < dsp.EXPOSED_WIRE_RATCHET_EPS
     key = dsp.exposure_metric_key("train_step")
     assert dsp.check_exposure_ratchet([art], {key: 0.0}) == []
+
+
+# ---------------------------------- declared collective schedule (r14)
+# a bucketed zero-2 exchange as CPU HLO shows it: sync reduce-scatters
+# (one per bucket) + a sync all-gather, next to an independent
+# flops-bound dot (the "rest of the backward")
+BUCKETED_EXCHANGE = _HEADER + (
+    "ENTRY %main.1 (p0: f32[1024,8192], p1: f32[8192,8192]) -> "
+    "(f32[256,8192], f32[256,8192], f32[1024,8192], f32[8192,8192]) {\n"
+    "  %p0 = f32[1024,8192]{1,0} parameter(0)\n"
+    "  %p1 = f32[8192,8192]{1,0} parameter(1)\n"
+    + _BIG_DOT +
+    "  %reduce-scatter.1 = f32[256,8192]{1,0} reduce-scatter("
+    "f32[1024,8192]{1,0} %p0), replica_groups={{0,1,2,3}}, "
+    "dimensions={0}\n"
+    "  %reduce-scatter.2 = f32[256,8192]{1,0} reduce-scatter("
+    "f32[1024,8192]{1,0} %p0), replica_groups={{0,1,2,3}}, "
+    "dimensions={0}\n"
+    "  %all-gather.1 = f32[1024,8192]{1,0} all-gather("
+    "f32[256,8192]{1,0} %reduce-scatter.1), "
+    "replica_groups={{0,1,2,3}}, dimensions={0}\n"
+    "  ROOT %tuple.1 = (f32[256,8192]{1,0}, f32[256,8192]{1,0}, "
+    "f32[1024,8192]{1,0}, f32[8192,8192]{1,0}) tuple("
+    "%reduce-scatter.1, %reduce-scatter.2, %all-gather.1, %dot.big)\n"
+    "}\n")
+
+_SCHED_ON = {"overlap": True, "rs_buckets": 2, "ag_buckets": 1}
+_SCHED_OFF = {"overlap": False, "rs_buckets": 2, "ag_buckets": 1}
+
+
+def test_declared_collective_schedule_pipelined_pricing():
+    """overlap on: steady-state buckets hide up to the shared compute
+    budget, fill/drain (one bucket's wire) stays exposed, nodes are
+    re-sourced ``hlo+declared``; all-reduces / no-schedule runs are
+    untouched."""
+    base = ov.analyze_hlo(BUCKETED_EXCHANGE, total_devices=4,
+                          device_kind="TPU v5e", max_nodes=None)
+    on = ov.analyze_hlo(BUCKETED_EXCHANGE, total_devices=4,
+                        device_kind="TPU v5e", max_nodes=None,
+                        declared_collective_schedule=_SCHED_ON)
+    assert on["exposed_wire_seconds"] < base["exposed_wire_seconds"]
+    matching = [n for n in on["nodes"]
+                if n["op"] in ("reduce-scatter", "all-gather")]
+    assert matching and all(n["source"] == "hlo+declared"
+                            for n in matching)
+    # fill/drain floor: at least one bucket's wire stays exposed
+    total = sum(n["seconds"] for n in matching)
+    exposed = sum(n["seconds"] - n["hidden_seconds"] for n in matching)
+    assert exposed >= total / len(matching) * (1 - 1e-9)
+    # the hiding never exceeds the program's compute
+    hidden = sum(n["hidden_seconds"] for n in matching)
+    assert hidden <= on["compute_seconds"] + 1e-12
+    # no node fully serialized any more -> DSO701 stays quiet
+    assert dsp.verify_program(_artifact(
+        BUCKETED_EXCHANGE, collective_schedule=_SCHED_ON)) == []
+
+
+def test_declared_collective_schedule_serialized_control():
+    """overlap off: exposure unchanged (everything stays serialized)
+    but the POTENTIAL window is recorded and DSO701 fires — the
+    engine declared a bucketed schedule could hide this exchange."""
+    base = ov.analyze_hlo(BUCKETED_EXCHANGE, total_devices=4,
+                          device_kind="TPU v5e", max_nodes=None)
+    off = ov.analyze_hlo(BUCKETED_EXCHANGE, total_devices=4,
+                         device_kind="TPU v5e", max_nodes=None,
+                         declared_collective_schedule=_SCHED_OFF)
+    assert off["exposed_wire_seconds"] == base["exposed_wire_seconds"]
+    matching = [n for n in off["nodes"]
+                if n["op"] in ("reduce-scatter", "all-gather")]
+    potential = off["compute_seconds"] * 2 / 3  # (B-1)/B over 3 buckets
+    for n in matching:
+        assert n["source"] == "hlo+declared"
+        assert n["classification"] == ov.SERIALIZED
+        assert n["window_seconds"] >= potential * (1 - 1e-9)
+    diags = dsp.verify_program(_artifact(
+        BUCKETED_EXCHANGE, collective_schedule=_SCHED_OFF))
+    assert rule_ids(diags) == ["DSO701"]
+    assert "overlap_comm would bucket" in diags[0].message
+
+
+def test_declared_collective_schedule_ignores_other_collectives():
+    """The schedule re-prices only reduce-scatter/all-gather: a sync
+    all-reduce (loss pmean) keeps its HLO classification, window rules
+    and all."""
+    on = ov.analyze_hlo(SERIAL_AR, total_devices=4,
+                        device_kind="TPU v5e", max_nodes=None,
+                        declared_collective_schedule=_SCHED_ON)
+    ar = [n for n in on["nodes"] if n["op"] == "all-reduce"]
+    assert ar and ar[0]["source"] == "hlo" and (
+        ar[0]["classification"] == ov.SERIALIZED)
+
+
+def test_collective_schedule_sidecar_roundtrip(tmp_path):
+    art = _artifact(BUCKETED_EXCHANGE, name="train_step",
+                    collective_schedule=_SCHED_ON)
+    progdir = tmp_path / "programs"
+    progdir.mkdir()
+    (progdir / "train_step.hlo").write_text(BUCKETED_EXCHANGE)
+    (progdir / "train_step.json").write_text(
+        json.dumps(art.sidecar()))
+    loaded = dsp.load_run_artifacts(str(tmp_path))
+    assert loaded[0].collective_schedule == _SCHED_ON
+    # and the offline re-analysis agrees with the live one (DSO703's
+    # like-with-like contract)
+    assert dsp.program_overlap(loaded[0])["exposed_wire_seconds"] == (
+        ov.analyze_hlo(BUCKETED_EXCHANGE, total_devices=4,
+                       device_kind="TPU v5e", max_nodes=None,
+                       declared_collective_schedule=_SCHED_ON)[
+            "exposed_wire_seconds"])
+
+
+def test_comm_exposure_metric_keys_and_ratchet():
+    """The baseline records the collective exposure under its OWN key
+    (comm_exposed_wire_seconds — the offload host-stream metric for a
+    same-named program must not collide), only for OVERLAPPED
+    schedules; the DSO704 ratchet reads it back."""
+    on = _artifact(BUCKETED_EXCHANGE, name="train_step",
+                   collective_schedule=_SCHED_ON)
+    off = _artifact(BUCKETED_EXCHANGE, name="train_step",
+                    collective_schedule=_SCHED_OFF)
+    metrics = dsp.exposure_metrics([on])
+    key = dsp.comm_exposure_metric_key("train_step")
+    assert set(metrics) == {key}
+    assert key != dsp.exposure_metric_key("train_step")
+    # the serialized control records nothing (it exists to be worse)
+    assert dsp.exposure_metrics([off]) == {}
+    # ratchet: growth past tolerance trips DSO704 through the new key
+    tight = {key: metrics[key] / 2.0}
+    diags = dsp.check_exposure_ratchet([on], tight)
+    assert rule_ids(diags) == ["DSO704"]
+    assert not dsp.check_exposure_ratchet([on], metrics)

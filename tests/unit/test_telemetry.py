@@ -413,6 +413,61 @@ def test_engine_zero_added_host_syncs(cpu_devices, tmp_path, monkeypatch):
     assert "attribution/unexplained_fraction" in snap
 
 
+def test_engine_zero_added_host_syncs_overlap_comm(cpu_devices, tmp_path,
+                                                   monkeypatch):
+    """Round 14: the bucketed overlap_comm exchange adds ZERO per-step
+    host syncs — the shard_map region, the declared collective
+    schedule, and the overlap/verify receipts are all compile-time or
+    host-float work.  Same counting harness as the main test, on a
+    ZeRO-2 dp=4 run with the buckets engaged."""
+    import jax
+
+    zero = {"stage": 2, "overlap_comm": True,
+            "reduce_bucket_size": 400, "allgather_bucket_size": 800}
+    batches = random_batches(4, 16, HIDDEN, seed=0)
+
+    def count_gets(config, after=None):
+        engine = make_engine(config, cpu_devices)
+        assert engine.comm_overlap_enabled()
+        assert engine.collective_schedule()["rs_buckets"] > 1
+        counts = {"n": 0}
+        real_get = jax.device_get
+
+        def counting_get(x):
+            counts["n"] += 1
+            return real_get(x)
+
+        monkeypatch.setattr(jax, "device_get", counting_get)
+        try:
+            run_steps(engine, batches)
+            if after is not None:
+                after(engine)
+        finally:
+            monkeypatch.setattr(jax, "device_get", real_get)
+        engine.close()
+        return counts["n"]
+
+    base = count_gets(base_config(steps_per_print=1,
+                                  zero_optimization=zero))
+
+    def verify(engine):
+        report = engine.verify_programs()
+        assert report is not None and report["violations"] == 0, (
+            [d.format() for d in report["diagnostics"]])
+        receipt = engine.overlap_receipt()
+        assert receipt is not None
+        assert receipt["exposed_wire_seconds"] < receipt["wire_seconds"]
+
+    full = count_gets(tel_config(
+        tmp_path / "oc", trace=True, zero_optimization=zero,
+        profiling={"memory_ledger": True, "comm_ledger": True,
+                   "program_dump": True}), after=verify)
+    assert full == base, (f"overlap_comm observability added host "
+                          f"syncs: {full} device_get calls vs {base} "
+                          f"baseline")
+    assert base > 0
+
+
 def test_engine_step_metrics_and_monitor_preserved(cpu_devices, tmp_path):
     """Scalars flow through the event stream AND the TrainingMonitor's
     JSONL/TB output (thin-consumer contract: TB behavior unchanged)."""
