@@ -55,6 +55,55 @@ _PREEMPT_CALLBACKS = []   # [ref()] -> final_save_fn or None when dead
 _PREEMPT_PREVIOUS = {}    # signum -> disposition we replaced
 
 
+def _arm_drain_watchdog(grace):
+    """Hard deadline on the WHOLE preemption drain + final save.
+
+    The lock acquires below are individually bounded, but the final
+    save's actual payload write is not — stuck storage (a wedged NFS
+    mount, a dead remote filesystem) can pin ``fn()`` mid-``write()``
+    far past every lock timeout.  Without this, the process sits in the
+    hung syscall until the launcher's SIGKILL at the END of the full
+    kill grace, and the exit reads as an unhandled signal death.  The
+    watchdog turns that into a deliberate, RESPAWNABLE hang exit
+    (:data:`~deepspeed_tpu.resilience.constants.EXIT_STEP_HANG`): the
+    supervisor reads lost capacity and respawns/resizes immediately
+    instead of waiting out the grace.
+
+    Deadline: ``DS_TERM_DRAIN_DEADLINE_SECS`` (<= 0 disables), default
+    90% of the kill grace — inside the window the launcher would have
+    SIGKILLed us anyway, so arming it never loses a save that would
+    have landed.  Returns the armed timer (cancel on normal handler
+    completion), or None when disabled."""
+    raw = os.environ.get("DS_TERM_DRAIN_DEADLINE_SECS", "")
+    try:
+        secs = float(raw) if raw else grace * 0.9
+    except ValueError:
+        # this runs INSIDE the SIGTERM handler: a malformed env value
+        # must degrade to the default, never abort the drain + final
+        # save it exists to protect
+        logger.warning(
+            f"DS_TERM_DRAIN_DEADLINE_SECS={raw!r} is not a number; "
+            f"using the default (90% of the kill grace)")
+        secs = grace * 0.9
+    if secs <= 0:
+        return None
+
+    def fire():
+        from ..resilience.constants import EXIT_STEP_HANG
+
+        logger.error(
+            f"preemption drain still running at the hard deadline "
+            f"({secs:.1f}s): the checkpoint writer itself is hung; "
+            f"exiting {EXIT_STEP_HANG} (respawnable) instead of pinning "
+            "the process until the launcher's SIGKILL")
+        os._exit(EXIT_STEP_HANG)
+
+    timer = threading.Timer(secs, fire)
+    timer.daemon = True
+    timer.start()
+    return timer
+
+
 def _preemption_handler(signum, frame):
     global _PREEMPT_DEADLINE
     logger.warning(f"signal {signum}: draining checkpoint writes and "
@@ -65,7 +114,18 @@ def _preemption_handler(signum, frame):
     # thread owns can never finish while we join it — time-box to a slice
     # of the launcher's kill grace and let the final save (which CAN
     # re-enter that RLock) use the rest
-    grace = float(os.environ.get("DS_TERM_GRACE_SECS", "30"))
+    try:
+        grace = float(os.environ.get("DS_TERM_GRACE_SECS", "30"))
+    except ValueError:
+        # inside the SIGTERM handler: a malformed env value must never
+        # abort the drain + final save (same contract as the drain
+        # watchdog's own env parse below)
+        logger.warning(
+            f"DS_TERM_GRACE_SECS="
+            f"{os.environ.get('DS_TERM_GRACE_SECS')!r} is not a "
+            f"number; using 30")
+        grace = 30.0
+    drain_watchdog = _arm_drain_watchdog(grace)
     try:
         if not drain_inflight(timeout=grace / 3):
             logger.warning("preemption drain timed out; proceeding to the "
@@ -87,6 +147,8 @@ def _preemption_handler(signum, frame):
                 logger.error(f"preemption checkpoint failed: {e}")
     finally:
         _PREEMPT_DEADLINE = None
+        if drain_watchdog is not None:
+            drain_watchdog.cancel()
     prev = _PREEMPT_PREVIOUS.get(signum)
     if callable(prev):
         prev(signum, frame)

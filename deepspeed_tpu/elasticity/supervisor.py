@@ -19,6 +19,16 @@ Env contract (consumed by training scripts and ``DeepSpeedConfig``):
   trains on the same schedule (a drifted config fails loudly instead of
   silently changing convergence).
 
+Integrity-directed eviction (``resilience/integrity.py``): when the
+fleet integrity plane names a bad rank — a state-fingerprint outlier or
+a hang-quorum suspect — the resize is *aimed* instead of blind.  The
+:class:`EvictionLedger` records which hostfile slots the verdicts have
+indicted: their devices are charged against the elastic budget, the
+slots join a blocklist every subsequent spawn respects (the suspect
+host never rejoins the fleet), and evictions beyond the run's budget
+escalate to the poison teardown — a fleet that keeps producing
+integrity verdicts has a systemic problem no resize can fix.
+
 Jax-free on purpose: the launcher imports this next to its other
 stdlib-only collaborators.
 """
@@ -107,3 +117,79 @@ def export_plan_env(env: dict, elastic_config_dict: dict,
     env[EC.DEEPSPEED_ELASTICITY_CONFIG] = json.dumps(
         normalized_elastic_config(elastic_config_dict), sort_keys=True)
     return env
+
+
+#: evictions one supervised run tolerates before poisoning (env
+#: ``DS_INTEGRITY_MAX_EVICTIONS`` overrides): ONE bad host is the
+#: cosmic-ray story the plane exists for; a fleet that keeps indicting
+#: ranks after an eviction already resized around the suspect has a
+#: systemic problem (bad batch of hosts, corrupted shared storage, a
+#: software bug voting against itself) that shrinking cannot fix.
+DEFAULT_MAX_EVICTIONS = 1
+
+
+class EvictionLedger:
+    """Integrity-verdict bookkeeping for one supervised run.
+
+    The launcher records every consumed integrity verdict here:
+    ``record()`` returns True while the eviction budget holds (resize
+    around the suspect, blocklisting its slot) and False once the run
+    must poison instead (*repeated eviction*).  ``blocked_slots`` is
+    the planner-facing blocklist: every respawn spawns only from the
+    slots NOT indicted by a previous verdict, so an evicted host's
+    devices never rejoin the fleet no matter how many resizes follow.
+    """
+
+    def __init__(self, max_evictions=None):
+        if max_evictions is None:
+            raw = os.environ.get("DS_INTEGRITY_MAX_EVICTIONS",
+                                 str(DEFAULT_MAX_EVICTIONS))
+            try:
+                max_evictions = int(raw)
+            except ValueError:
+                # same contract as the other env parses: a malformed
+                # value degrades to the default, never kills the
+                # launcher at startup
+                logger.warning(
+                    f"DS_INTEGRITY_MAX_EVICTIONS={raw!r} is not an "
+                    f"integer; using {DEFAULT_MAX_EVICTIONS}")
+                max_evictions = DEFAULT_MAX_EVICTIONS
+        self.max_evictions = int(max_evictions)
+        self.evictions = []     # [{"slot", "suspect", "kind", "detail"}]
+
+    @property
+    def blocked_slots(self):
+        """Hostfile slots an integrity verdict has indicted — excluded
+        from every subsequent spawn."""
+        return frozenset(e["slot"] for e in self.evictions
+                         if e["slot"] is not None)
+
+    def filter_slots(self, slots):
+        """``slots`` minus the blocklist, order preserved."""
+        blocked = self.blocked_slots
+        return [s for s in slots if s not in blocked]
+
+    def record(self, suspect, slot, kind, detail=""):
+        """Note one consumed verdict.  Returns True when the eviction
+        fits the budget (resize around the suspect); False when this is
+        a *repeated eviction* and the run must poison — there is no
+        longer a basis to trust that evicting one more host fixes the
+        fleet."""
+        self.evictions.append({"slot": slot, "suspect": int(suspect),
+                               "kind": str(kind), "detail": str(detail)})
+        within = len(self.evictions) <= self.max_evictions
+        if within:
+            logger.warning(
+                "integrity eviction %d/%d: rank %s (slot %s) indicted "
+                "by %s verdict; its devices leave the elastic budget",
+                len(self.evictions), self.max_evictions, suspect, slot,
+                kind)
+        else:
+            logger.error(
+                "repeated integrity eviction (%d > budget %d): rank %s "
+                "(slot %s, %s) indicted after a previous eviction "
+                "already resized around a suspect — poisoning the run "
+                "instead of shrinking further",
+                len(self.evictions), self.max_evictions, suspect, slot,
+                kind)
+        return within

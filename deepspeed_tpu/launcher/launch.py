@@ -35,6 +35,21 @@ warm, exporting ``DS_ELASTIC_TARGET_WORLD_SIZE`` so scripts size their
 mesh, and ``DEEPSPEED_ELASTICITY_CONFIG`` so the runtime's immutability
 check proves every life trains the same schedule.  Poison codes still
 tear the node down: a divergence is never "resized around".
+
+Integrity-directed eviction (``resilience/integrity.py``): a child death
+that carries an integrity verdict — exit 87 from a fingerprint-consensus
+outlier or a hang-quorum fire, with the detecting rank's verdict file in
+the shared run dir — turns the blind resize into an *aimed* one.  The
+supervisor reads the verdict, charges the suspect's devices against the
+elastic budget, blocklists the suspect's slot (``EvictionLedger``) so
+the bad host never rejoins the fleet, clears the run dir's fleet state
+(a new life must not vote against the previous life's stale
+fingerprints), and respawns the fleet around the eviction; every rank
+rolls back to the latest committed checkpoint via ``auto_resume``.
+Verdicts past the eviction budget (``DS_INTEGRITY_MAX_EVICTIONS``,
+default 1) poison the run instead: a fleet that keeps indicting ranks
+after an eviction already removed the suspect has a problem no resize
+fixes.
 """
 
 import argparse
@@ -50,8 +65,12 @@ import time
 from ..elasticity.config import (ElasticityError,
                                  ElasticityIncompatibleWorldSize)
 from ..elasticity.constants import ELASTICITY
-from ..elasticity.supervisor import export_plan_env, plan_world_size
-from ..resilience.constants import POISON_EXIT_CODES
+from ..elasticity.supervisor import (EvictionLedger, export_plan_env,
+                                     plan_world_size)
+from ..resilience import integrity as fleet_integrity
+from ..resilience.constants import (EXIT_DIVERGENCE_ABORT,
+                                    EXIT_INTEGRITY_EVICT,
+                                    POISON_EXIT_CODES)
 # stdlib-only import chain on purpose: the launcher must not need jax
 # (the elasticity planner/supervisor above are plain-python too)
 from ..telemetry.events import (EVENT_ELASTIC, EVENT_PROC_EXIT,
@@ -213,7 +232,8 @@ def main(argv=None):
             str(max(1, budget // max(1, len(local_slots))))))
         plan = plan_world_size(elastic_dict, budget)
         elastic = {"dict": elastic_dict, "budget": budget,
-                   "per_failure": per_failure, "plan": plan, "resizes": 0}
+                   "per_failure": per_failure, "plan": plan, "resizes": 0,
+                   "ledger": EvictionLedger()}
         # the FIRST life is also sized by the planner: processes scale
         # with the planned world size exactly as resizes do (a schedule
         # whose largest valid world is below the slot count must not
@@ -268,13 +288,27 @@ def main(argv=None):
                 f"launching process {first_id + local_rank}/{n_procs}: "
                 f"{' '.join(cmd)}")
             fleet.append({"proc": subprocess.Popen(cmd, env=env),
-                          "cmd": cmd, "env": env,
+                          "cmd": cmd, "env": env, "slot": slot,
                           "rank": first_id + local_rank, "restarts": 0,
                           "respawn_at": None})
             tel_emit(EVENT_PROC_SPAWN, proc_rank=first_id + local_rank,
                      pid=fleet[-1]["proc"].pid,
                      **({} if restart is None else {"restart": restart}))
         return fleet
+
+    if args.telemetry_dir:
+        # a reused run dir may hold a PREVIOUS run's verdict (teardown
+        # paths don't clear — the launcher is already exiting) plus its
+        # fingerprints/heartbeats: consumed at this run's first
+        # respawnable death they would blocklist an innocent slot and
+        # burn the eviction budget.  This run starts from a clean
+        # integrity plane.  (Multi-node: a late-starting node's clear
+        # briefly thins the live fleet's files; they republish within
+        # one beat/print cadence.)
+        n_stale = fleet_integrity.clear_fleet_state(args.telemetry_dir)
+        if n_stale:
+            logger.info(f"cleared {n_stale} stale integrity-plane "
+                        "file(s) left in the run dir by a previous run")
 
     children = spawn_fleet(local_slots, total)   # [{proc, cmd, env, ...}]
 
@@ -329,11 +363,80 @@ def main(argv=None):
     signal.signal(signal.SIGINT, forward_signal)
     signal.signal(signal.SIGTERM, forward_signal)
 
-    def elastic_resize(child, code, signame):
+    consumed_verdicts = set()
+
+    def consume_integrity_verdict(code):
+        """The integrity verdict behind a child death, if any.  An exit
+        87 should always have one (the detecting rank commits the
+        verdict file before exiting); every OTHER respawnable death also
+        checks, because the first death the monitor observes need not be
+        the detecting rank (a hang victim dies by signal in the drain
+        while its accusers exit 87).  Falls back to the CONSUMED marker
+        a sibling node's launcher renamed the verdict to (multi-node
+        shared run dir: deleting on first consumption would race the
+        siblings' monitor polls and the node that owns the suspect's
+        slot would resize blind); each verdict — identified by its
+        commit (ts, suspect, kind) — is acted on at most once per
+        launcher."""
+        if not args.telemetry_dir:
+            return None
+        verdict = fleet_integrity.read_verdict(args.telemetry_dir,
+                                               include_consumed=True)
+        if verdict is not None:
+            key = (verdict.get("ts"), verdict.get("suspect"),
+                   verdict.get("kind"))
+            if key in consumed_verdicts:
+                verdict = None          # already acted on this one
+            else:
+                consumed_verdicts.add(key)
+                # free VERDICT_FILE for the next life's first-writer-
+                # wins commit while leaving the marker for siblings
+                fleet_integrity.mark_verdict_consumed(args.telemetry_dir)
+        if verdict is None and code == EXIT_INTEGRITY_EVICT:
+            logger.warning(
+                f"exit {code} (integrity eviction) without a readable "
+                "verdict file in the run dir; resizing blind")
+        return verdict
+
+    def clear_integrity_state(reason, rank=None, keep_consumed=False):
+        """Fleet state (fingerprints, heartbeats, the consumed verdict)
+        must not leak into the next life: a rolled-back fleet recomputes
+        the abandoned timeline and must not be voted against by its
+        previous self.  ``rank`` narrows the clear to one rank's files
+        (ordinary single-rank respawn: peers' state stays valid);
+        ``keep_consumed`` preserves the consumed-verdict marker for
+        sibling nodes' launchers (the resize path)."""
+        if args.telemetry_dir:
+            n = fleet_integrity.clear_fleet_state(
+                args.telemetry_dir, rank=rank,
+                keep_consumed=keep_consumed)
+            if n:
+                logger.info(f"cleared {n} integrity-plane file(s) from "
+                            f"the run dir ({reason})")
+
+    def elastic_resize(child, code, signame, verdict=None):
         """One resize cycle: charge the failed capacity, re-plan, drain
         the survivors (SIGTERM grace — their preemption saves land),
-        respawn the whole fleet at the planned size.  Returns the new
-        children list, or None when no valid world size is left."""
+        respawn the whole fleet at the planned size.  With an integrity
+        ``verdict``, the resize is aimed: the suspect's slot joins the
+        eviction blocklist and never rejoins the fleet.  Returns the new
+        children list, None when no valid world size is left, or
+        ``"poison"`` when a repeated eviction must tear the run down
+        un-respawned."""
+        suspect_slot = None
+        if verdict is not None:
+            suspect = verdict.get("suspect")
+            suspect_slot = next((c["slot"] for c in children
+                                 if c["rank"] == suspect), None)
+            tel_emit(EVENT_ELASTIC, phase="evict", suspect=suspect,
+                     slot=suspect_slot, kind=verdict.get("kind"),
+                     detail=verdict.get("detail"),
+                     eviction=len(elastic["ledger"].evictions) + 1,
+                     exit_code=code)
+            if not elastic["ledger"].record(suspect, suspect_slot,
+                                            verdict.get("kind", "?"),
+                                            verdict.get("detail", "")):
+                return "poison"
         elastic["resizes"] += 1
         elastic["budget"] -= elastic["per_failure"]
         prev = elastic["plan"]
@@ -345,7 +448,9 @@ def main(argv=None):
         # a SIGTERM death is read as a preemption notice: the child's
         # grace-window save (checkpoint.save_on_preemption) already
         # landed, so the resized fleet resumes from it warm
-        trigger = (f"preemption notice ({signame})"
+        trigger = (f"integrity eviction (rank {verdict.get('suspect')}, "
+                   f"{verdict.get('kind')})" if verdict is not None else
+                   f"preemption notice ({signame})"
                    if signame == "SIGTERM" else
                    f"signal death ({signame})" if signame else
                    f"exit code {code}")
@@ -375,15 +480,31 @@ def main(argv=None):
         # must commit before their writers die
         terminate_all()
         time.sleep(delay)
+        # the new life rolls back to the latest committed checkpoint
+        # (auto_resume) and recomputes the abandoned timeline — stale
+        # fingerprints/heartbeats must go first; the consumed-verdict
+        # marker stays (siblings sharing the run dir dedup by ts)
+        clear_integrity_state(f"resize {elastic['resizes']}",
+                              keep_consumed=True)
         n_prev = max(1, len(children))
         n_procs = max(1, round(n_prev * plan.world_size
                                / max(1, prev.world_size)))
-        n_procs = min(n_procs, len(local_slots))
+        # spawn only from slots no integrity verdict has indicted: the
+        # evicted host's devices never rejoin the fleet
+        slots = elastic["ledger"].filter_slots(local_slots)
+        if not slots:
+            logger.error("elastic resize: every slot is on the eviction "
+                         "blocklist; tearing the node down")
+            return None
+        n_procs = min(n_procs, len(slots))
         elastic["plan"] = plan
-        fleet = spawn_fleet(local_slots[:n_procs], n_procs,
+        fleet = spawn_fleet(slots[:n_procs], n_procs,
                             restart=elastic["resizes"])
         tel_emit(EVENT_ELASTIC, phase="resize", procs=n_procs,
-                 world_size=plan.world_size, restart=elastic["resizes"])
+                 world_size=plan.world_size, restart=elastic["resizes"],
+                 **({"evicted_slots": sorted(
+                     elastic["ledger"].blocked_slots)}
+                    if elastic["ledger"].evictions else {}))
         return fleet
 
     # monitor: a failed child is respawned (up to --max-restarts, with
@@ -435,8 +556,15 @@ def main(argv=None):
                     "the node")
             elif (elastic is not None and not tearing_down
                     and elastic["resizes"] < args.max_restarts):
-                fleet = elastic_resize(child, code, signame)
-                if fleet is not None:
+                fleet = elastic_resize(child, code, signame,
+                                       verdict=consume_integrity_verdict(
+                                           code))
+                if fleet == "poison":
+                    # repeated eviction: escalate to the poison code —
+                    # the teardown below must never respawn, and the
+                    # launcher's own exit says why
+                    code = EXIT_DIVERGENCE_ABORT
+                elif fleet is not None:
                     children = fleet
                     alive = list(children)
                     break   # the fleet was replaced wholesale
@@ -452,6 +580,22 @@ def main(argv=None):
                 tel_emit(EVENT_PROC_RESPAWN, proc_rank=child["rank"],
                          restart=child["restarts"], backoff_secs=delay,
                          exit_code=code)
+                if code == EXIT_INTEGRITY_EVICT:
+                    # no supervisor to aim the respawn, but the new life
+                    # still must not vote against its previous self's
+                    # stale fingerprints/heartbeats
+                    clear_integrity_state(
+                        f"respawn of rank {child['rank']}")
+                else:
+                    # ordinary crash: the dead life's stale heartbeat
+                    # would read as a hang (step lags the head, beat
+                    # stale) through the backoff + re-init window and
+                    # the quorum would falsely evict the new life —
+                    # clear only THIS rank's files, peers' state is
+                    # still valid
+                    clear_integrity_state(
+                        f"respawn of rank {child['rank']}",
+                        rank=child["rank"])
                 child["proc"] = None
                 child["respawn_at"] = time.time() + delay
                 continue

@@ -46,6 +46,7 @@ import re
 import threading
 import time
 
+from ..resilience.integrity import atomic_publish_json, read_fleet_json_files
 from ..utils.logging import logger
 
 # the collective mnemonics walked out of optimized HLO (async forms
@@ -466,22 +467,18 @@ def publish_rank_latency(run_dir, rank, snapshot, step=None):
     """Atomically publish one rank's latency-ring snapshot to
     ``<run_dir>/latency-rank<k>.json`` (tmp + ``os.replace``: readers
     never see a torn file).  Returns the path, or None on failure
-    (fail-soft — a full disk must not take the step loop down)."""
-    path = os.path.join(str(run_dir), latency_filename(rank))
+    (fail-soft — a full disk must not take the step loop down).
+    Delegates to the shared run-dir publish primitive in
+    :mod:`~deepspeed_tpu.resilience.integrity` (same protocol as the
+    fingerprint/heartbeat exchanges)."""
     payload = dict(snapshot)
     payload["rank"] = rank
     payload["ts"] = time.time()
     if step is not None:
         payload["step"] = int(step)
-    tmp = path + ".tmp"
-    try:
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump(payload, f)
-        os.replace(tmp, path)
-    except OSError as e:
-        logger.debug("comm skew: latency publish to %s failed: %s", path, e)
-        return None
-    return path
+    return atomic_publish_json(
+        os.path.join(str(run_dir), latency_filename(rank)), payload,
+        log_context="comm skew")
 
 
 def read_fleet_latencies(run_dir, max_age_secs=None, world_size=None):
@@ -495,35 +492,16 @@ def read_fleet_latencies(run_dir, max_age_secs=None, world_size=None):
     - ``max_age_secs``: drop snapshots whose publish ``ts`` is older
       (snapshots without a ts pass — pre-round-8 writers);
     - ``world_size``: drop integer ranks outside ``[0, world_size)`` —
-      definitionally not part of the current run."""
-    out = {}
-    try:
-        names = sorted(os.listdir(str(run_dir)))
-    except OSError:
-        return out
-    now = time.time()
-    for name in names:
-        if not (name.startswith(LATENCY_FILE_PREFIX)
-                and name.endswith(LATENCY_FILE_SUFFIX)):
-            continue
-        try:
-            with open(os.path.join(str(run_dir), name),
-                      encoding="utf-8") as f:
-                snap = json.load(f)
-        except (OSError, ValueError):
-            continue
-        if not (isinstance(snap, dict) and "p50" in snap):
-            continue
-        if (max_age_secs is not None and snap.get("ts") is not None
-                and now - float(snap["ts"]) > max_age_secs):
-            continue
-        rank = snap.get("rank", name[len(LATENCY_FILE_PREFIX):
-                                     -len(LATENCY_FILE_SUFFIX)])
-        if (world_size is not None and isinstance(rank, int)
-                and not 0 <= rank < world_size):
-            continue
-        out[rank] = snap
-    return out
+      definitionally not part of the current run.
+
+    ``rank_from_name`` keeps a pre-round-8 writer's snapshot readable:
+    a payload without a ``rank`` key is keyed by the filename digits
+    (as a string, exempt from the ``world_size`` filter)."""
+    return read_fleet_json_files(run_dir, LATENCY_FILE_PREFIX,
+                                 LATENCY_FILE_SUFFIX,
+                                 world_size=world_size,
+                                 max_age_secs=max_age_secs,
+                                 require_key="p50", rank_from_name=True)
 
 
 def fleet_skew(fleet):

@@ -15,7 +15,12 @@ Four pieces (see ``docs/resilience.md``):
   and exits with a distinct respawnable code;
 - :mod:`~deepspeed_tpu.resilience.chaos` — seeded fault injector
   (NaN batches, torn/corrupt/delayed checkpoints, synthetic SIGTERM,
-  step hangs) driving the chaos tests.
+  step hangs, state bitflips) driving the chaos tests;
+- :mod:`~deepspeed_tpu.resilience.integrity` — the fleet integrity
+  plane: cross-rank state-fingerprint consensus (silent-data-corruption
+  / desync detection by majority vote over run-dir artifacts), fleet
+  heartbeats with a hang quorum, and the eviction verdict the
+  launcher's elastic supervisor resizes on.
 
 Exit-code contract and :class:`TrainingDivergedError` live in
 :mod:`~deepspeed_tpu.resilience.constants` (stdlib-only: the launcher
@@ -24,9 +29,9 @@ modules load lazily so ``from deepspeed_tpu.resilience.constants import
 POISON_EXIT_CODES`` stays cheap.
 """
 
-from .constants import (EXIT_DIVERGENCE_ABORT, EXIT_STEP_HANG,  # noqa: F401
-                        GUARD_POLICIES, POISON_EXIT_CODES,
-                        TrainingDivergedError)
+from .constants import (EXIT_DIVERGENCE_ABORT, EXIT_INTEGRITY_EVICT,  # noqa: F401,E501
+                        EXIT_STEP_HANG, GUARD_POLICIES, POISON_EXIT_CODES,
+                        FleetIntegrityError, TrainingDivergedError)
 
 _LAZY = {
     "AnomalyGuard": ("guard", "AnomalyGuard"),
@@ -34,10 +39,13 @@ _LAZY = {
     "StepWatchdog": ("watchdog", "StepWatchdog"),
     "ChaosMonkey": ("chaos", "ChaosMonkey"),
     "DeepSpeedResilienceConfig": ("config", "DeepSpeedResilienceConfig"),
+    "IntegrityPlane": ("integrity", "IntegrityPlane"),
+    "FleetHeartbeat": ("integrity", "FleetHeartbeat"),
 }
 
-__all__ = ["EXIT_DIVERGENCE_ABORT", "EXIT_STEP_HANG", "GUARD_POLICIES",
-           "POISON_EXIT_CODES", "TrainingDivergedError", *_LAZY]
+__all__ = ["EXIT_DIVERGENCE_ABORT", "EXIT_INTEGRITY_EVICT",
+           "EXIT_STEP_HANG", "GUARD_POLICIES", "POISON_EXIT_CODES",
+           "FleetIntegrityError", "TrainingDivergedError", *_LAZY]
 
 
 def __getattr__(name):

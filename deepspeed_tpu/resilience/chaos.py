@@ -14,13 +14,23 @@ Faults mirror the real-world menagerie:
   the launcher's elastic supervisor reads the signal death as lost
   capacity and resizes the fleet;
 - ``hang_steps`` — the step wedges (stuck collective / dead remote
-  attachment): blocks on an event (test-controlled) or sleeps;
+  attachment): blocks on an event (test-controlled) or sleeps.  With
+  ``target_rank`` set, ONE rank of a fleet wedges before entering the
+  step while its peers proceed into the collective region and block
+  behind it — the exact failure the integrity plane's hang quorum
+  exists to turn into one eviction instead of N watchdog timeouts;
+- ``bitflip_steps`` — silent data corruption: ONE seeded element of
+  the targeted rank's master (or optimizer) state gets a bit flipped
+  right before the step pulls its batch, desyncing that replica from
+  the dp fleet with no crash, no NaN, no log line — detectable only by
+  the integrity plane's cross-rank fingerprint consensus;
 
-Process-killing faults (``kill_steps``/``sigterm_steps``) can target a
-SPECIFIC rank: pass ``rank=<this process's rank>`` and
-``target_rank=<victim>`` and only the victim injects — the chaos
-schedule stays identical across the fleet (same seed everywhere), so
-"kill rank 3 at step k" reproduces exactly.
+Rank-targetable faults (``kill_steps``/``sigterm_steps``/
+``hang_steps``/``bitflip_steps``) hit a SPECIFIC rank: pass
+``rank=<this process's rank>`` and ``target_rank=<victim>`` and only
+the victim injects — the chaos schedule stays identical across the
+fleet (same seed everywhere), so "corrupt rank 3 at step k"
+reproduces exactly.
 - :meth:`corrupt_checkpoint` — flip bytes in a committed payload file
   (bit rot / torn storage);
 - :meth:`torn_tmp_dir` — fabricate a half-written ``<tag>.tmp`` dir (a
@@ -80,7 +90,8 @@ class ChaosMonkey:
 
     def wrap_iter(self, data_iter, nan_steps=(), sigterm_steps=(),
                   hang_steps=(), hang_event=None, hang_secs=None,
-                  kill_steps=(), kill_signal=None, rank=0,
+                  kill_steps=(), kill_signal=None, bitflip_steps=(),
+                  bitflip_engine=None, bitflip_field="master", rank=0,
                   target_rank=None):
         """Wrap a batch iterator, injecting faults at the given PULL
         indices (0-based; with gradient accumulation one optimizer step
@@ -89,14 +100,23 @@ class ChaosMonkey:
 
         ``kill_steps`` kills THIS process with ``kill_signal`` (default
         SIGKILL: unhandleable, the preempted-host failure mode — the
-        elastic supervisor's respawn trigger).  The process-killing
-        faults (kill + sigterm) honor ``target_rank``: when set, only
-        the process whose ``rank`` matches injects them, so a fleet
-        sharing one seeded schedule kills exactly one rank mid-step."""
+        elastic supervisor's respawn trigger).  ``bitflip_steps`` calls
+        :meth:`bitflip_state` on ``bitflip_engine`` — the silent-data-
+        corruption fault the fingerprint consensus must catch.  Every
+        rank-targetable fault (kill, sigterm, hang, bitflip) honors
+        ``target_rank``: when set, only the process whose ``rank``
+        matches injects it, so a fleet sharing one seeded schedule
+        hits exactly one rank mid-step.  The targeted hang models a
+        rank wedging BEFORE it enters the step: its peers proceed into
+        the collective region and block behind it, which is where the
+        hang-quorum heartbeat (not N local watchdogs) must recover."""
         nan_steps = frozenset(nan_steps)
         sigterm_steps = frozenset(sigterm_steps)
         hang_steps = frozenset(hang_steps)
         kill_steps = frozenset(kill_steps)
+        bitflip_steps = frozenset(bitflip_steps)
+        assert not bitflip_steps or bitflip_engine is not None, (
+            "bitflip_steps needs bitflip_engine (whose state to corrupt)")
         if kill_signal is None:
             kill_signal = signal.SIGKILL
         targeted = target_rank is None or int(rank) == int(target_rank)
@@ -109,18 +129,45 @@ class ChaosMonkey:
                 if i in sigterm_steps and targeted:
                     self.log.append((i, "sigterm"))
                     signal.raise_signal(signal.SIGTERM)
-                if i in hang_steps:
+                if i in hang_steps and targeted:
                     self.log.append((i, "hang"))
                     if hang_event is not None:
                         hang_event.wait()
                     elif hang_secs is not None:
                         time.sleep(hang_secs)
+                if i in bitflip_steps and targeted:
+                    self.bitflip_state(bitflip_engine, field=bitflip_field)
                 if i in nan_steps:
                     self.log.append((i, "nan"))
                     batch = self.nan_batch(batch)
                 yield batch
 
         return gen()
+
+    # ------------------------------------------------- state-level faults
+    def bitflip_state(self, engine, field="master"):
+        """Flip ONE seeded bit of one element of ``engine.state[field]``
+        (master parameters by default; any flat optimizer-state buffer
+        works) — a cosmic-ray/SDC event: no crash, no NaN, nothing in
+        the logs, just a replica whose state silently disagrees with
+        its dp siblings from this step on.  The integrity plane's
+        cross-rank fingerprint consensus is the only guard that can see
+        it.  Returns ``(flat_index, bit)`` for the post-mortem."""
+        import jax  # lazy: chaos planning stays importable without jax
+
+        val = engine.state[field]
+        grouped = type(val) is tuple    # offload row-group layout
+        buf = val[0] if grouped else val
+        host = np.array(jax.device_get(buf))   # owned, writable copy
+        flat = host.reshape(-1).view(
+            np.dtype(f"u{host.dtype.itemsize}"))
+        idx = int(self._rng.integers(0, flat.size))
+        bit = int(self._rng.integers(0, flat.dtype.itemsize * 8))
+        flat[idx] ^= flat.dtype.type(1 << bit)
+        new = jax.device_put(host, buf.sharding)
+        engine.state[field] = ((new,) + val[1:]) if grouped else new
+        self.log.append((f"{field}[{idx}]", "bitflip"))
+        return idx, bit
 
     # --------------------------------------------- checkpoint-level faults
     def corrupt_checkpoint(self, ckpt_dir,

@@ -65,6 +65,28 @@ class DeepSpeedResilienceConfig:
             "it multiplies the fleet-median p50, and slowest/median is "
             ">= 1 by construction — a factor in (0,1) would flag every "
             "healthy fleet at every print cadence")
+        # fleet integrity plane (resilience/integrity.py)
+        self.integrity = bool(get_scalar_param(
+            res, C.RESILIENCE_INTEGRITY, C.RESILIENCE_INTEGRITY_DEFAULT))
+        self.integrity_window = int(get_scalar_param(
+            res, C.RESILIENCE_INTEGRITY_WINDOW,
+            C.RESILIENCE_INTEGRITY_WINDOW_DEFAULT))
+        assert self.integrity_window >= 1, (
+            "resilience.integrity_window must be >= 1")
+        self.integrity_action = str(get_scalar_param(
+            res, C.RESILIENCE_INTEGRITY_ACTION,
+            C.RESILIENCE_INTEGRITY_ACTION_DEFAULT)).lower()
+        from .integrity import INTEGRITY_ACTIONS
+
+        assert self.integrity_action in INTEGRITY_ACTIONS, (
+            f"resilience.integrity_action {self.integrity_action!r} not "
+            f"one of {INTEGRITY_ACTIONS}")
+        self.integrity_peer_timeout_secs = float(get_scalar_param(
+            res, C.RESILIENCE_INTEGRITY_PEER_TIMEOUT_SECS,
+            C.RESILIENCE_INTEGRITY_PEER_TIMEOUT_SECS_DEFAULT))
+        assert self.integrity_peer_timeout_secs >= 0, (
+            "resilience.integrity_peer_timeout_secs must be >= 0 "
+            "(0 disables the fleet heartbeat)")
 
     def __repr__(self):
         return (f"DeepSpeedResilienceConfig(enabled={self.enabled}, "

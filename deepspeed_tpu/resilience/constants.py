@@ -19,10 +19,24 @@ signal deaths.
   the same data into the same diverging state, so the launcher must
   never respawn on it — a human (or sweep controller) has to change
   something first.
+
+- ``EXIT_INTEGRITY_EVICT`` — the fleet integrity plane reached a
+  verdict naming one bad rank: a fingerprint-consensus outlier (an
+  SDC/desync suspect whose state checksum disagrees with the replica
+  majority) or a hang-quorum suspect (a peer whose heartbeat went
+  stale while a majority kept making step progress).  A
+  *resize-with-eviction* failure: the launcher's elastic supervisor
+  reads the verdict file, charges the suspect's devices against the
+  elastic budget (an eviction blocklist the planner respects), rolls
+  the fleet back to the latest committed checkpoint, and respawns
+  WITHOUT the suspect.  A no-majority split or a repeated eviction
+  escalates to the poison code instead — there is no healthy majority
+  left to trust.
 """
 
 EXIT_STEP_HANG = 85
 EXIT_DIVERGENCE_ABORT = 86
+EXIT_INTEGRITY_EVICT = 87
 
 # codes the launcher must never respawn, regardless of --max-restarts
 POISON_EXIT_CODES = frozenset({EXIT_DIVERGENCE_ABORT})
@@ -44,3 +58,18 @@ class TrainingDivergedError(RuntimeError):
     def __init__(self, message, exit_code=EXIT_DIVERGENCE_ABORT):
         super().__init__(message)
         self.exit_code = exit_code
+
+
+class FleetIntegrityError(RuntimeError):
+    """Raised when the integrity plane's fingerprint consensus names a
+    bad rank (this one or a peer).  Training scripts should
+    ``sys.exit(err.exit_code)`` so the launcher's elastic supervisor
+    evicts the suspect and resizes around it; the verdict file in the
+    run dir carries who and why."""
+
+    def __init__(self, message, exit_code=EXIT_INTEGRITY_EVICT,
+                 suspect=None, kind=None):
+        super().__init__(message)
+        self.exit_code = exit_code
+        self.suspect = suspect      # fleet rank the consensus named
+        self.kind = kind            # "sdc_outlier" | "hang_quorum"

@@ -378,6 +378,30 @@ RESILIENCE_CHECKPOINT_DIR_DEFAULT = None
 # 0 disables; needs telemetry (the run dir is the exchange medium)
 RESILIENCE_STRAGGLER_FACTOR = "straggler_factor"
 RESILIENCE_STRAGGLER_FACTOR_DEFAULT = 0.0
+# fleet integrity plane (resilience/integrity.py): per-rank state
+# fingerprints (a cheap in-jit checksum over the flat master +
+# optimizer state, riding the existing batched steps_per_print fetch)
+# cross-checked by majority vote over run-dir artifacts — an SDC/desync
+# suspect is named, reported to the supervisor, and evicted on resize.
+# Needs telemetry (the run dir is the exchange medium)
+RESILIENCE_INTEGRITY = "integrity"
+RESILIENCE_INTEGRITY_DEFAULT = False
+# fingerprint history steps each rank publishes (voting scans the
+# window, so ranks whose publishes lag the fleet head are still judged)
+RESILIENCE_INTEGRITY_WINDOW = "integrity_window"
+RESILIENCE_INTEGRITY_WINDOW_DEFAULT = 8
+# evict: verdict file + FleetIntegrityError (exit 87, the supervisor
+# resizes around the suspect); warn: telemetry events only (use on
+# meshes that shard state across processes, where per-process
+# fingerprints legitimately differ)
+RESILIENCE_INTEGRITY_ACTION = "integrity_action"
+RESILIENCE_INTEGRITY_ACTION_DEFAULT = "evict"
+# fleet heartbeat + hang quorum: a peer whose step-entry beat lags the
+# fleet head and goes stale by this many seconds is the hang suspect
+# (healthy ranks exit with ONE respawnable eviction instead of N local
+# watchdog timeouts).  0 disables the heartbeat thread
+RESILIENCE_INTEGRITY_PEER_TIMEOUT_SECS = "integrity_peer_timeout_secs"
+RESILIENCE_INTEGRITY_PEER_TIMEOUT_SECS_DEFAULT = 0.0
 
 #############################################
 # Telemetry subsystem (deepspeed_tpu/telemetry; new — the reference's

@@ -798,6 +798,135 @@ class DeepSpeedEngine:
                         f"micro_steps={self.micro_steps}"),
                     on_fire=self._telemetry_watchdog_fire).start()
             log_dist(f"resilience enabled: {rcfg}", ranks=[0])
+
+        # -- fleet integrity plane (deepspeed_tpu/resilience/integrity):
+        # per-rank state fingerprints + majority vote, fleet heartbeats
+        # + hang quorum.  The exchange medium is the telemetry run dir
+        # (the PR-8 latency-rank*.json atomic-file pattern), so like the
+        # skew export it needs telemetry on; the fingerprint scalar
+        # rides the EXISTING batched steps_per_print fetch — zero new
+        # per-step host syncs (device_get-counting test covers it)
+        self._integrity = None
+        self._fleet_heartbeat = None
+        self._fingerprint_jit = None
+        if rcfg.enabled and rcfg.integrity:
+            if not (self.telemetry.enabled and self.telemetry.run_dir):
+                logger.warning(
+                    "resilience.integrity needs telemetry enabled with a "
+                    "run_dir (the fingerprint/heartbeat exchange medium); "
+                    "integrity plane disabled")
+            else:
+                from ..launcher.constants import (ENV_NUM_PROCESSES,
+                                                  ENV_PROCESS_ID)
+                from ..resilience.integrity import (FleetHeartbeat,
+                                                    IntegrityPlane)
+
+                # fleet identity: the launcher's env contract when
+                # spawned under it (each process one fleet rank), else
+                # the jax multi-controller identity
+                fleet_rank = int(os.environ.get(ENV_PROCESS_ID, "")
+                                 or jax.process_index())
+                fleet_size = int(os.environ.get(ENV_NUM_PROCESSES, "")
+                                 or jax.process_count())
+                if fleet_size < 2:
+                    # min_quorum is always >= 2: a single process can
+                    # never reach a verdict, so don't pay a full-state
+                    # jitted checksum + run-dir I/O every print cadence
+                    # for an eternally-pending vote
+                    logger.warning(
+                        "resilience.integrity: fingerprint consensus "
+                        "needs a fleet of >= 2 ranks (single process "
+                        "can never reach a voting quorum); integrity "
+                        "plane not armed")
+                elif jax.process_count() > 1:
+                    # the consensus model needs each process's checksum
+                    # computed over process-LOCAL replica state (the
+                    # launcher's full-replica fleet contract, one jax
+                    # world per process).  Under a multi-controller
+                    # rendezvous the state arrays are jointly sharded
+                    # and the in-jit checksum compiles to a GLOBAL
+                    # cross-process reduction: every process publishes
+                    # the identical value, the vote can never name a
+                    # suspect, and a corrupted shard reads as a
+                    # unanimous "ok" — worse than no detection at all
+                    logger.warning(
+                        "resilience.integrity: fingerprint consensus "
+                        "disabled under a jax multi-controller "
+                        "rendezvous (the in-jit checksum over jointly "
+                        "sharded state is a global reduction — every "
+                        "process publishes the same value and the vote "
+                        "is blind); fleet heartbeat still armed")
+                elif self._config.zero_config.cpu_offload:
+                    # the offloaded (master, opt) state is host-resident
+                    # BECAUSE it does not fit on device: checksumming it
+                    # in-jit would re-upload the whole state at every
+                    # print cadence (or OOM and silently disable).  A
+                    # chunked host-side checksum is future work; the
+                    # heartbeat/hang-quorum half stays armed
+                    logger.warning(
+                        "resilience.integrity: fingerprint consensus "
+                        "disabled under ZeRO-Offload (in-jit checksum "
+                        "would re-transfer the host-resident state each "
+                        "print cadence); fleet heartbeat still armed")
+                else:
+                    self._integrity = IntegrityPlane(
+                        self.telemetry.run_dir, rank=fleet_rank,
+                        fleet_size=fleet_size,
+                        window=rcfg.integrity_window,
+                        action=rcfg.integrity_action)
+                if rcfg.integrity_peer_timeout_secs > 0:
+                    if fleet_size >= 3:
+                        self._fleet_heartbeat = FleetHeartbeat(
+                            self.telemetry.run_dir, rank=fleet_rank,
+                            fleet_size=fleet_size,
+                            peer_timeout_secs=(
+                                rcfg.integrity_peer_timeout_secs),
+                            action=rcfg.integrity_action,
+                            on_fire=self._telemetry_integrity_hang,
+                        ).start()
+                    elif fleet_size == 2:
+                        # with 2 ranks a strict majority at the head
+                        # means BOTH are at the head (no lagging
+                        # suspect), and a lone leader is no majority:
+                        # the quorum can mathematically never convict —
+                        # don't pay a monitor thread + per-step beats
+                        # for an inert mechanism
+                        logger.warning(
+                            "resilience.integrity: hang quorum needs a "
+                            "fleet of >= 3 ranks (2 ranks can never "
+                            "reach a convicting majority); fleet "
+                            "heartbeat not armed — each rank's local "
+                            "watchdog remains the hang authority")
+                launcher_dir = os.environ.get("DS_TELEMETRY_DIR")
+                if launcher_dir and (os.path.abspath(launcher_dir)
+                                     != os.path.abspath(
+                                         self.telemetry.run_dir)):
+                    # the launcher consumes verdicts / clears fleet
+                    # state from ITS --telemetry-dir; an exchange
+                    # happening elsewhere makes every eviction blind
+                    # (suspect never blocklisted) and leaves stale
+                    # fleet state to convict the rolled-back fleet
+                    logger.warning(
+                        "resilience.integrity: telemetry.run_dir "
+                        f"({self.telemetry.run_dir}) differs from the "
+                        f"launcher's --telemetry-dir ({launcher_dir}); "
+                        "the launcher consumes integrity verdicts and "
+                        "clears fleet state from its own dir, so "
+                        "eviction recovery will NOT see this run's "
+                        "verdicts — drop telemetry.run_dir from the "
+                        "config or point both at the same directory")
+                armed = [h for h, on in (
+                    ("fingerprint consensus", self._integrity is not None),
+                    ("hang quorum", self._fleet_heartbeat is not None),
+                ) if on]
+                if armed:
+                    log_dist(
+                        f"fleet integrity plane armed "
+                        f"({', '.join(armed)}): rank {fleet_rank}/"
+                        f"{fleet_size}, window {rcfg.integrity_window}, "
+                        f"action {rcfg.integrity_action}, peer timeout "
+                        f"{rcfg.integrity_peer_timeout_secs:g}s",
+                        ranks=[0])
         from ..profiling.step_profiler import StepLatencyRing
 
         if self._step_latencies is None:
@@ -962,6 +1091,159 @@ class DeepSpeedEngine:
             self._watchdog.pause()
         if self._step_latencies is not None:
             self._step_latencies.pause()
+        if self._fleet_heartbeat is not None:
+            self._fleet_heartbeat.pause()
+
+    # ------------------------------------------------------------------
+    # fleet integrity plane (deepspeed_tpu/resilience/integrity)
+    # ------------------------------------------------------------------
+    def _integrity_step_enter(self):
+        """Entering one optimizer step: publish the fleet heartbeat
+        (throttled atomic file write — O(1) host work, no device
+        access).  Placed AFTER the batch fetch so a wedged input
+        pipeline never publishes the step it failed to enter: the lag
+        is exactly what the hang quorum discriminates on."""
+        if self._fleet_heartbeat is not None:
+            self._fleet_heartbeat.beat(self.global_steps + 1)
+
+    def _telemetry_integrity_hang(self, verdict):
+        """FleetHeartbeat fire hook: the process exits via ``os._exit``
+        next (the main thread may be wedged inside a collective), so
+        the verdict event must be emitted AND flushed here."""
+        self.telemetry.emit(
+            TEL.EVENT_INTEGRITY, step=self.global_steps,
+            verdict="outlier", kind="hang_quorum",
+            suspects=[verdict["suspect"]],
+            stalled_secs=float(verdict["stalled_secs"]),
+            suspect_step=verdict["suspect_step"],
+            head_step=verdict["head_step"], voters=verdict["leaders"])
+        self.telemetry.counter("integrity/violations").inc()
+        self.telemetry.flush(reason="integrity_hang_quorum")
+
+    def _integrity_fingerprint_device(self):
+        """Dispatch the in-jit state checksum; returns the uint32
+        device scalar (or None with the plane off / a backend that
+        cannot run it).  The value is NOT fetched here — it joins the
+        one existing batched ``steps_per_print`` ``device_get`` so the
+        fingerprint adds zero host syncs.
+
+        The checksum is a position-weighted sum of the raw bits of
+        every (master, optimizer-state) leaf in uint32 wraparound
+        arithmetic: integer math, so replicas that are bit-identical
+        produce identical fingerprints on any backend, and a single
+        flipped bit anywhere changes the sum."""
+        if self._integrity is None:
+            return None
+        if self._fingerprint_jit is False:     # prior failure: disabled
+            return None
+        if self._fingerprint_jit is None:
+            from jax import lax
+
+            _BIT_UINTS = {1: jnp.uint8, 2: jnp.uint16}
+
+            def _leaf_bits(leaf):
+                x = jnp.asarray(leaf)
+                if x.dtype == jnp.bool_:
+                    x = x.astype(jnp.uint8)
+                if x.dtype.itemsize >= 4:
+                    if x.dtype != jnp.uint32:
+                        # 8-byte dtypes (x64 mode) bitcast to a trailing
+                        # pair of uint32 words — never truncated
+                        x = lax.bitcast_convert_type(x, jnp.uint32)
+                    return x.reshape(-1)
+                if not jnp.issubdtype(x.dtype, jnp.unsignedinteger):
+                    x = lax.bitcast_convert_type(
+                        x, _BIT_UINTS[x.dtype.itemsize])
+                return x.reshape(-1).astype(jnp.uint32)
+
+            def _fingerprint(master, opt):
+                acc = jnp.zeros((), jnp.uint32)
+                for leaf in jax.tree_util.tree_leaves((master, opt)):
+                    bits = _leaf_bits(leaf)
+                    # position weights forced ODD (|1): an odd weight is
+                    # a unit mod 2^32, so flipping ANY single bit b
+                    # moves the sum by 2^b * w != 0 — an even weight
+                    # would make MSB flips at that position invisible.
+                    # Distinct-per-position via the Knuth multiplier:
+                    # catches element swaps a plain sum would miss
+                    w = (jnp.arange(bits.size, dtype=jnp.uint32)
+                         * jnp.uint32(2654435761)) | jnp.uint32(1)
+                    acc = acc + jnp.sum(bits * w, dtype=jnp.uint32)
+                return acc
+
+            self._fingerprint_jit = jax.jit(_fingerprint)
+        try:
+            with self.mesh:
+                return self._fingerprint_jit(self.state["master"],
+                                             self.state["opt"])
+        except Exception as e:  # noqa: BLE001 — observability only
+            logger.error(
+                "integrity fingerprint program failed (%s); disabling "
+                "the fingerprint exchange on this rank", e)
+            self._fingerprint_jit = False
+            return None
+
+    def _sample_integrity(self, fingerprint):
+        """Publish this rank's fingerprint, read the fleet, vote, and
+        escalate per ``resilience.integrity_action``.  Called only from
+        the steps_per_print cadence block with the scalar the batched
+        fetch already transferred — host arithmetic + run-dir file I/O
+        only, ZERO added per-step syncs (dslint DSH205 pins the
+        publish/read APIs to this cadence statically)."""
+        if self._integrity is None or fingerprint is None:
+            return
+        from ..resilience import integrity as integ
+
+        verdict = self._integrity.note_fingerprint(self.global_steps,
+                                                   int(fingerprint))
+        self.telemetry.gauge("integrity/fleet_voters").set(
+            float(verdict["voters"]))
+        self.telemetry.emit(
+            TEL.EVENT_INTEGRITY, step=self.global_steps,
+            verdict=verdict["verdict"], kind="fingerprint",
+            suspects=verdict["suspects"],
+            fingerprint=self._integrity.history.get(self.global_steps),
+            majority_fingerprint=verdict["fingerprint"],
+            voted_step=verdict["step"], voters=verdict["voters"])
+        if verdict["verdict"] in (integ.VERDICT_OK, integ.VERDICT_PENDING):
+            return
+        self.telemetry.counter("integrity/violations").inc()
+        if self._integrity.action != "evict":
+            logger.error(
+                "integrity verdict %s at step %s (suspects %s) — "
+                "integrity_action=warn, continuing", verdict["verdict"],
+                verdict["step"], verdict["suspects"])
+            return
+        from ..resilience.constants import (FleetIntegrityError,
+                                            TrainingDivergedError)
+
+        if self._watchdog is not None:
+            # the eviction/poison teardown (flush, verdict write, the
+            # script's exit) must never be preempted by the watchdog's
+            # respawnable os._exit
+            self._watchdog.stop()
+        if self._fleet_heartbeat is not None:
+            self._fleet_heartbeat.stop()
+        if verdict["verdict"] == integ.VERDICT_NO_MAJORITY:
+            msg = (f"fleet integrity: NO MAJORITY among "
+                   f"{verdict['voters']} rank(s) at step "
+                   f"{verdict['step']} — nobody can say which replica "
+                   f"is right; poisoning the run")
+            self.telemetry.emit(TEL.EVENT_ABORT, step=self.global_steps,
+                                reason=msg)
+            self.telemetry.flush(reason="integrity_no_majority")
+            raise TrainingDivergedError(msg)
+        suspect = verdict["suspects"][0]
+        detail = (f"state fingerprint of rank(s) {verdict['suspects']} "
+                  f"disagrees with the majority of {verdict['voters']} "
+                  f"voter(s) at step {verdict['step']} "
+                  f"(majority {verdict['fingerprint']})")
+        self._integrity.record_eviction_verdict(
+            integ.KIND_SDC, suspect, detail, step=verdict["step"])
+        self.telemetry.flush(reason="integrity_evict")
+        raise FleetIntegrityError(
+            f"fleet integrity: {detail}; exiting for eviction resize",
+            suspect=suspect, kind=integ.KIND_SDC)
 
     # ------------------------------------------------------------------
     # communication observability (deepspeed_tpu/profiling/comm)
@@ -1319,10 +1601,13 @@ class DeepSpeedEngine:
 
     def close(self):
         """Flush + close every telemetry sink (events, trace, metrics
-        snapshot, monitor).  Idempotent; also registered via atexit, so a
-        normally-exiting run keeps its tail events without calling this."""
+        snapshot, monitor) and stop the fleet-heartbeat monitor.
+        Idempotent; also registered via atexit, so a normally-exiting
+        run keeps its tail events without calling this."""
         from .compilation import uninstall_compile_telemetry
 
+        if self._fleet_heartbeat is not None:
+            self._fleet_heartbeat.stop()
         uninstall_compile_telemetry(self.telemetry)
         self.telemetry.close()
 
@@ -3142,6 +3427,7 @@ class DeepSpeedEngine:
         ``engine.py:993-1076``)."""
         if not self.is_gradient_accumulation_boundary():
             return
+        self._integrity_step_enter()
         if self.wall_clock_breakdown():
             self.timers("step").start(sync=False)
         hp = self._device_hyperparams()
@@ -3199,11 +3485,17 @@ class DeepSpeedEngine:
         if self.global_steps % self.steps_per_print() == 0:
             # ONE batched transfer for every print-cadence scalar: the
             # per-loss/per-property form cost 2 + grad_acc separate
-            # blocking round-trips here (dslint DSH202/DSH203)
+            # blocking round-trips here (dslint DSH202/DSH203).  The
+            # integrity fingerprint (a dispatched device scalar) rides
+            # the same transfer: zero added host syncs
+            fetch = {"losses": list(self._losses),
+                     "scale": self.state["scale"].cur_scale,
+                     "skipped": self.state["skipped"]}
+            fp_dev = self._integrity_fingerprint_device()
+            if fp_dev is not None:
+                fetch["fingerprint"] = fp_dev
             # dslint: disable=DSH203 -- print cadence; cannot batch with the per-step fp16 overflow fetch above
-            stats = jax.device_get({"losses": list(self._losses),
-                                    "scale": self.state["scale"].cur_scale,
-                                    "skipped": self.state["skipped"]})
+            stats = jax.device_get(fetch)
             mean_loss = (float(np.mean(stats["losses"]))
                          if stats["losses"] else 0.0)
             lr = self.get_lr()[0] if self.optimizer.param_groups else 0.0
@@ -3224,6 +3516,7 @@ class DeepSpeedEngine:
             self._sample_memory_watermarks()
             self._sample_comm_skew()
             self._sample_attribution()
+            self._sample_integrity(stats.get("fingerprint"))
         self._losses = []
         if self._config.memory_breakdown:
             from .utils import see_memory_usage
@@ -3269,6 +3562,12 @@ class DeepSpeedEngine:
                                 reason=reason)
             self.telemetry.counter("resilience/rollbacks").inc()
             self._guard.notify_rollback()
+            if self._integrity is not None:
+                # the abandoned timeline's published fingerprints must
+                # not stay up for peers to vote against while replay
+                # heals this replica (a mixed stale/replayed window
+                # could convict a rank the rollback already fixed)
+                self._integrity.reset_history()
             return True
         if action == ACTION_ABORT:
             if self._watchdog is not None:
@@ -3312,6 +3611,7 @@ class DeepSpeedEngine:
         acc = self.gradient_accumulation_steps()
         with self.telemetry.span("batch_fetch", step=self.global_steps + 1):
             micro_batches = [next(data_iter) for _ in range(acc)]
+        self._integrity_step_enter()
         try:
             packed_host, spec = _pack_batches(micro_batches)
         except (ValueError, AssertionError):
@@ -3437,10 +3737,16 @@ class DeepSpeedEngine:
             # round-trip; dslint DSH203)
             self._check_sparse_overflow()
             lr = self.get_lr()[0] if self.optimizer.param_groups else 0.0
+            # the integrity fingerprint (a dispatched device scalar)
+            # rides the same batched transfer: zero added host syncs
+            fetch = {"loss": loss,
+                     "scale": self.state["scale"].cur_scale,
+                     "skipped": self.state["skipped"]}
+            fp_dev = self._integrity_fingerprint_device()
+            if fp_dev is not None:
+                fetch["fingerprint"] = fp_dev
             # dslint: disable=DSH203 -- print cadence; cannot batch with the per-step fp16 overflow fetch above
-            stats = jax.device_get({"loss": loss,
-                                    "scale": self.state["scale"].cur_scale,
-                                    "skipped": self.state["skipped"]})
+            stats = jax.device_get(fetch)
             loss_val = float(stats["loss"])
             scale = (float(stats["scale"]) if self._config.fp16_enabled
                      else 1.0)
@@ -3461,6 +3767,7 @@ class DeepSpeedEngine:
             self._sample_memory_watermarks()
             self._sample_comm_skew()
             self._sample_attribution()
+            self._sample_integrity(stats.get("fingerprint"))
         if self.wall_clock_breakdown():
             # the fused program has no forward/step boundary to time
             # separately; report the whole fused step

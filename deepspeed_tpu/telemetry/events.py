@@ -76,9 +76,17 @@ EVENT_ATTRIBUTION = "attribution"
 # "plan" (the HCN planner's re-plan after a failure: surviving device
 # budget, planned world size + micro x accum factorization), "resize"
 # (the fleet respawn at the planned size), "restore" (a checkpoint
-# restored onto a DIFFERENT dp degree than wrote it).  Together they
-# are the resize timeline ``telemetry report`` prints.
+# restored onto a DIFFERENT dp degree than wrote it), "evict" (the
+# supervisor consuming an integrity verdict: suspect rank/slot charged
+# against the elastic budget before the resize).  Together they are
+# the resize timeline ``telemetry report`` prints.
 EVENT_ELASTIC = "elastic"
+# fleet integrity plane (resilience/integrity.py): one record per
+# consensus vote at the steps_per_print cadence and per hang-quorum
+# fire.  ``verdict`` is ok | outlier | no_majority | pending; ``kind``
+# says what voted ("fingerprint" majority vote vs "hang_quorum"
+# staleness); ``suspects`` names the ranks a non-ok verdict indicts
+EVENT_INTEGRITY = "integrity"
 
 # type -> required data keys.  The report CLI and the golden-schema test
 # validate against this table; emitting an unknown type or dropping a
@@ -108,6 +116,7 @@ EVENT_TYPES = {
                         "measured_step_seconds",
                         "step_unexplained_fraction"),
     EVENT_ELASTIC: ("phase",),
+    EVENT_INTEGRITY: ("verdict", "kind", "suspects"),
 }
 
 

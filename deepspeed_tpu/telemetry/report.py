@@ -225,11 +225,57 @@ def elastic_timeline(records):
         elif phase == "restore":
             detail = (f"checkpoint dp={d.get('from_dp')} restored onto "
                       f"dp={d.get('to_dp')} ({d.get('checkpoint')})")
+        elif phase == "evict":
+            detail = (f"integrity verdict ({d.get('kind')}): rank "
+                      f"{d.get('suspect')} / slot {d.get('slot')} "
+                      f"charged against the elastic budget "
+                      f"(eviction {d.get('eviction')})")
         else:
             detail = _fmt_data(d)
         rel = rec.get("_rel", rec.get("ts", 0.0))
         lines.append(f"  t=+{rel:9.3f}s rank={rec.get('rank')} "
                      f"{phase:<8} {detail}")
+    return lines
+
+
+def integrity_summary(records):
+    """The fleet-integrity story in one block: consensus participation,
+    every non-ok verdict with its suspects, and hang-quorum fires.
+    Returned empty when the run never emitted an ``integrity`` event —
+    the section only prints for integrity-enabled runs."""
+    integ = [r for r in align_records(records)
+             if r.get("type") == ev.EVENT_INTEGRITY]
+    if not integ:
+        return []
+    votes = [r for r in integ
+             if r.get("data", {}).get("kind") == "fingerprint"]
+    ok = sum(1 for r in votes
+             if r.get("data", {}).get("verdict") in ("ok", "pending"))
+    lines = [f"  fingerprint votes: {len(votes)} "
+             f"({ok} ok/pending, {len(votes) - ok} flagged)"]
+    for rec in integ:
+        d = rec.get("data", {})
+        verdict = d.get("verdict")
+        if d.get("kind") == "hang_quorum":
+            detail = (f"hang quorum: rank(s) {d.get('suspects')} stalled "
+                      f"{d.get('stalled_secs', 0.0):.1f}s at step "
+                      f"{d.get('suspect_step')} while {d.get('voters')} "
+                      f"peer(s) reached step {d.get('head_step')}")
+        elif verdict in ("ok", "pending"):
+            continue
+        elif verdict == "outlier":
+            detail = (f"fingerprint outlier: rank(s) {d.get('suspects')} "
+                      f"disagree with the {d.get('voters')}-voter "
+                      f"majority {d.get('majority_fingerprint')} at "
+                      f"step {d.get('voted_step')}")
+        else:
+            detail = (f"{verdict}: {d.get('voters')} voter(s) at step "
+                      f"{d.get('voted_step')} — no replica majority "
+                      f"to trust")
+        rel = rec.get("_rel", rec.get("ts", 0.0))
+        lines.append(f"  t=+{rel:9.3f}s rank={rec.get('rank')} {detail}")
+    if len(lines) == 1:
+        lines.append("  no non-ok verdict: every vote agreed bit-exactly")
     return lines
 
 
@@ -438,6 +484,11 @@ def generate_report(run_dir, strict=False, comm=False, doctor=False,
         out.append("")
         out.append("elastic resize timeline:")
         out.extend(elastic_lines)
+    integrity_lines = integrity_summary(records)
+    if integrity_lines:
+        out.append("")
+        out.append("fleet integrity (fingerprint consensus + hang quorum):")
+        out.extend(integrity_lines)
     out.append("")
     out.append("step metrics:")
     out.extend(summarize_step_metrics(records))
@@ -512,6 +563,13 @@ def report_json(run_dir, strict=False, doctor=False,
              **rec.get("data", {})}
             for rec in align_records(records)
             if rec.get("type") == ev.EVENT_ELASTIC],
+        "integrity": [
+            {"rank": rec.get("rank"), "step": rec.get("step"),
+             **rec.get("data", {})}
+            for rec in align_records(records)
+            if rec.get("type") == ev.EVENT_INTEGRITY
+            and rec.get("data", {}).get("verdict") not in (None, "ok",
+                                                           "pending")],
         "events": records,
     }
     if doctor:

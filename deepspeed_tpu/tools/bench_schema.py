@@ -145,6 +145,11 @@ FIELDS = {
     "rc": (numbers.Integral, "legacy driver wrapper exit code"),
     "ok": (bool, "legacy driver wrapper flag"),
     "skipped": (bool, "legacy driver wrapper flag"),
+    # fleet integrity receipt (round 15): seeded SDC faults the
+    # integrity leg injected MINUS the ones the fingerprint consensus
+    # caught — 0 is the receipt that nothing silent went undetected
+    "integrity_violations": (numbers.Integral,
+                             "seeded integrity faults left undetected"),
 }
 
 # multichip leg fields: leg_<name>_<field>
@@ -181,6 +186,12 @@ _LEG_FIELDS = {
     # next to the leg's own exposed_wire_seconds (strictly lower,
     # asserted in the leg)
     "serial_exposed_wire_seconds": numbers.Real,
+    # integrity leg (round 15): the aimed-recovery transition the leg
+    # proved — which rank the fingerprint consensus indicted, the
+    # consensus verdict that did it, and the fleet size the eviction
+    # resize landed on
+    "evicted_rank": numbers.Integral,
+    "verdict": str,
     "error": str,
     "note": str,
 }
@@ -277,6 +288,9 @@ THRESHOLDS = {
     "n_devices": ("higher", 0.0),
     "legs_ok": ("higher", 0.0),
     "legs_failed": ("lower", 0.0),
+    # any seeded integrity fault the consensus missed is a gated
+    # regression (zero tolerance: the receipt exists to pin this at 0)
+    "integrity_violations": ("lower", 0.0),
     # zero-2 bucketed-collective A/B (round 14): the overlapped row's
     # step time and exposure are the gated headline; the serialized
     # control rows are informational (they exist to be worse)

@@ -112,18 +112,22 @@ register_rule(Rule(
 
 register_rule(Rule(
     id="DSH205", name="driver-skew-export", severity="warning",
-    summary="latency/skew telemetry export outside the steps_per_print "
-            "cadence in driver code",
-    rationale="Per-rank skew export (latency-ring snapshots, the "
-              "latency-rank*.json publish/read exchange) does host "
-              "arithmetic plus run-dir file I/O: cheap at print cadence, "
-              "a per-step cost multiplier on the hot path.  The comm-"
-              "telemetry contract is that it rides the existing batched "
-              "steps_per_print fetch, adding zero per-step work.",
+    summary="latency/skew/fingerprint telemetry export outside the "
+            "steps_per_print cadence in driver code",
+    rationale="Per-rank run-dir exchange (latency-ring snapshots, the "
+              "latency-rank*.json publish/read pair, and the integrity "
+              "plane's integrity-rank*.json fingerprint publish/read/"
+              "vote) does host arithmetic plus run-dir file I/O: cheap "
+              "at print cadence, a per-step cost multiplier on the hot "
+              "path.  The contract for both families is that they ride "
+              "the existing batched steps_per_print fetch, adding zero "
+              "per-step work.",
     autofix_hint="Call latency_snapshot/publish_rank_latency/"
-                 "read_fleet_latencies only from code reached through an "
-                 "`if ... steps_per_print ...:` guard (e.g. the engine's "
-                 "_sample_comm_skew)."))
+                 "read_fleet_latencies (and publish_rank_fingerprint/"
+                 "read_fleet_fingerprints/note_fingerprint) only from "
+                 "code reached through an `if ... steps_per_print ...:` "
+                 "guard (e.g. the engine's _sample_comm_skew / "
+                 "_sample_integrity)."))
 
 register_rule(Rule(
     id="DSH203", name="driver-unbatched-sync", severity="warning",
@@ -366,9 +370,13 @@ def _sync_properties(index: ModuleIndex, cls_name: str):
 
 
 # latency/skew export surface (profiling/step_profiler.StepLatencyRing
-# + profiling/comm's per-rank exchange): print-cadence-only by contract
+# + profiling/comm's per-rank exchange) plus the integrity plane's
+# fingerprint exchange (resilience/integrity.py: the publish/read/vote
+# APIs — NOT the fleet heartbeat's beat(), which is per-step by design
+# at O(1) throttled host work): print-cadence-only by contract
 _SKEW_EXPORT_CALLS = {"latency_snapshot", "publish_rank_latency",
-                      "read_fleet_latencies"}
+                      "read_fleet_latencies", "publish_rank_fingerprint",
+                      "read_fleet_fingerprints", "note_fingerprint"}
 
 
 def _is_skew_export(node: ast.Call) -> bool:

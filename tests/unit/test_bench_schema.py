@@ -75,6 +75,31 @@ def test_round12_overlap_row_validates_and_gates():
         "offload_gpt2_large_overlap_overlap_fraction") == ("higher", 0.10)
 
 
+def test_round15_integrity_leg_fields_validate_and_gate():
+    """The multichip integrity leg's receipts: which rank the
+    fingerprint consensus indicted, the verdict, and the resized fleet
+    — plus the fleet-wide ``integrity_violations`` pinned at 0 by
+    ``bench_diff`` (any seeded fault the consensus misses is a gated
+    regression)."""
+    from deepspeed_tpu.tools.bench_schema import threshold_for
+
+    record = {
+        "metric": "dryrun_multichip",
+        "leg_integrity_status": "ok",
+        "leg_integrity_evicted_rank": 2,
+        "leg_integrity_verdict": "outlier",
+        "leg_integrity_resized_to": 2,
+        "leg_integrity_resume_step": 1,
+        "integrity_violations": 0,
+    }
+    assert validate_record(record) == []
+    assert threshold_for("integrity_violations") == ("lower", 0.0)
+    # leg-pattern fields stay informational unless listed; the verdict
+    # and rank are identity fields, never gated numerically
+    assert field_type("leg_integrity_verdict") is str
+    assert validate_record({"leg_integrity_evicted_rank": "two"}) != []
+
+
 def test_unknown_and_mistyped_fields_are_flagged():
     probs = validate_record({
         "offload_gpt2_large_host_state_bytes_per_step": "lots",

@@ -404,6 +404,51 @@ class TrainEngine:
     assert ids == ["DSH205"]
 
 
+def test_dsh205_fingerprint_export_on_step_path(tmp_path):
+    # PR 15: the integrity plane's fingerprint publish/read/vote APIs
+    # carry the same print-cadence-only contract as the skew exchange —
+    # per-step calls are flagged
+    ids = lint_source(tmp_path, """
+from resilience.integrity import (publish_rank_fingerprint,
+                                  read_fleet_fingerprints)
+
+class TrainEngine:
+    def train_batch(self, it):
+        publish_rank_fingerprint(self._run_dir, 0, self._history)
+        fleet = read_fleet_fingerprints(self._run_dir)
+""")
+    assert ids and set(ids) == {"DSH205"}
+
+
+def test_dsh205_fingerprint_vote_guarded_is_clean(tmp_path):
+    # the engine's contract shape: _sample_integrity (note_fingerprint =
+    # publish + read + vote) reachable only through the cadence guard;
+    # the heartbeat beat() is per-step BY DESIGN and stays unflagged
+    ids = lint_source(tmp_path, """
+class TrainEngine:
+    def _sample_integrity(self, fp):
+        self._integrity.note_fingerprint(self.global_steps, fp)
+
+    def train_batch(self, it):
+        self._heartbeat.beat(self.global_steps + 1)
+        if self.global_steps % self.steps_per_print() == 0:
+            self._sample_integrity(0)
+""")
+    assert ids == []
+
+
+def test_dsh205_fingerprint_vote_unguarded_helper_is_flagged(tmp_path):
+    ids = lint_source(tmp_path, """
+class TrainEngine:
+    def _sample_integrity(self, fp):
+        self._integrity.note_fingerprint(self.global_steps, fp)
+
+    def train_batch(self, it):
+        self._sample_integrity(0)
+""")
+    assert ids == ["DSH205"]
+
+
 def test_non_engine_class_is_not_driver_scope(tmp_path):
     # benchmarks/profilers sync deliberately; only Engine/Scaler classes
     # carry step-cadence semantics

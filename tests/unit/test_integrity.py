@@ -1,0 +1,945 @@
+"""Fleet integrity plane (``deepspeed_tpu/resilience/integrity``):
+state-fingerprint consensus, hang quorum, eviction verdicts, the
+supervisor's EvictionLedger, the chaos bitflip/hang injectors, and the
+engine wiring — SDC detection by majority vote with the fingerprint
+riding the existing batched ``steps_per_print`` fetch.
+
+The real-launcher chaos e2e (bitflip → evict → resize → parity, hang →
+quorum exit → one resize) lives in ``test_integrity_e2e.py``; these are
+the cheap in-process halves."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu as deepspeed
+from deepspeed_tpu.elasticity.supervisor import EvictionLedger
+from deepspeed_tpu.parallel import make_mesh
+from deepspeed_tpu.resilience import (EXIT_DIVERGENCE_ABORT,
+                                      EXIT_INTEGRITY_EVICT, ChaosMonkey,
+                                      FleetIntegrityError,
+                                      POISON_EXIT_CODES,
+                                      TrainingDivergedError)
+from deepspeed_tpu.resilience import integrity as integ
+from deepspeed_tpu.resilience.config import DeepSpeedResilienceConfig
+
+from .simple_model import SimpleModel, base_config, random_batches
+
+HIDDEN = 16
+
+
+# --------------------------------------------------------------- config
+def test_integrity_config_defaults_and_parse():
+    cfg = DeepSpeedResilienceConfig({})
+    assert cfg.integrity is False
+    assert cfg.integrity_window == 8
+    assert cfg.integrity_action == "evict"
+    assert cfg.integrity_peer_timeout_secs == 0.0
+
+    cfg = DeepSpeedResilienceConfig({"resilience": {
+        "enabled": True, "integrity": True, "integrity_window": 3,
+        "integrity_action": "warn", "integrity_peer_timeout_secs": 2.5}})
+    assert cfg.integrity and cfg.integrity_window == 3
+    assert cfg.integrity_action == "warn"
+    assert cfg.integrity_peer_timeout_secs == 2.5
+
+    with pytest.raises(AssertionError, match="integrity_action"):
+        DeepSpeedResilienceConfig({"resilience": {
+            "integrity_action": "explode"}})
+    with pytest.raises(AssertionError, match="integrity_window"):
+        DeepSpeedResilienceConfig({"resilience": {"integrity_window": 0}})
+
+
+def test_exit_code_contract():
+    """87 is respawnable (the supervisor resizes on it); the poison set
+    is untouched — no-majority and repeated eviction escalate to 86,
+    which never respawns."""
+    assert EXIT_INTEGRITY_EVICT == 87
+    assert EXIT_INTEGRITY_EVICT not in POISON_EXIT_CODES
+    assert EXIT_DIVERGENCE_ABORT in POISON_EXIT_CODES
+    err = FleetIntegrityError("x", suspect=3, kind=integ.KIND_SDC)
+    assert err.exit_code == EXIT_INTEGRITY_EVICT
+    assert err.suspect == 3 and err.kind == "sdc_outlier"
+
+
+# --------------------------------------------- fingerprint consensus
+def _publish(run_dir, rank, hist):
+    integ.publish_rank_fingerprint(
+        str(run_dir), rank,
+        {s: integ.canonical_fingerprint(v) for s, v in hist.items()})
+
+
+def test_canonical_fingerprint_is_uint32_hex():
+    assert integ.canonical_fingerprint(0) == "00000000"
+    assert integ.canonical_fingerprint(0xDEADBEEF) == "deadbeef"
+    # wraps like the device-side uint32 accumulator
+    assert integ.canonical_fingerprint(2 ** 32 + 5) == "00000005"
+
+
+def test_consensus_all_agree_is_ok(tmp_path):
+    for r in range(4):
+        _publish(tmp_path, r, {7: 111, 8: 222})
+    fleet = integ.read_fleet_fingerprints(str(tmp_path), world_size=4)
+    assert set(fleet) == {0, 1, 2, 3}
+    v = integ.fingerprint_consensus(fleet, 4)
+    assert v["verdict"] == integ.VERDICT_OK
+    assert v["step"] == 8 and v["voters"] == 4 and v["suspects"] == []
+    assert v["fingerprint"] == integ.canonical_fingerprint(222)
+
+
+def test_consensus_names_the_outlier(tmp_path):
+    for r in range(4):
+        _publish(tmp_path, r, {8: 222 if r != 2 else 999})
+    fleet = integ.read_fleet_fingerprints(str(tmp_path), world_size=4)
+    v = integ.fingerprint_consensus(fleet, 4)
+    assert v["verdict"] == integ.VERDICT_OUTLIER
+    assert v["suspects"] == [2]
+    assert v["fingerprint"] == integ.canonical_fingerprint(222)
+
+
+def test_consensus_catches_lagging_outlier_in_window(tmp_path):
+    """A suspect whose publishes lag the fleet head is still judged:
+    corruption propagates, so the older step's disagreement stands."""
+    _publish(tmp_path, 3, {7: 999})                      # stuck at 7, wrong
+    for r in range(3):
+        _publish(tmp_path, r, {7: 111, 8: 222})
+    fleet = integ.read_fleet_fingerprints(str(tmp_path), world_size=4)
+    v = integ.fingerprint_consensus(fleet, 4)
+    # step 8 has only 3 voters (quorum ok, all agree) -> candidate ok;
+    # step 7 has 4 voters with rank 3 disagreeing -> outlier wins
+    assert v["verdict"] == integ.VERDICT_OUTLIER
+    assert v["suspects"] == [3] and v["step"] == 7
+
+
+def test_consensus_no_majority_is_unrecoverable(tmp_path):
+    for r in range(4):
+        _publish(tmp_path, r, {8: 111 if r < 2 else 222})
+    fleet = integ.read_fleet_fingerprints(str(tmp_path), world_size=4)
+    v = integ.fingerprint_consensus(fleet, 4)
+    assert v["verdict"] == integ.VERDICT_NO_MAJORITY
+    assert v["suspects"] == [0, 1, 2, 3]     # nobody can say who is right
+    assert v["fingerprint"] is None
+
+
+def test_consensus_below_quorum_is_pending(tmp_path):
+    _publish(tmp_path, 0, {8: 111})
+    fleet = integ.read_fleet_fingerprints(str(tmp_path), world_size=4)
+    assert integ.fingerprint_consensus(fleet, 4)["verdict"] == \
+        integ.VERDICT_PENDING
+    # a 2-rank fleet still needs BOTH ranks (min quorum floor of 2):
+    # one rank alone can never convict its peer
+    assert integ.fingerprint_consensus(fleet, 2)["verdict"] == \
+        integ.VERDICT_PENDING
+
+
+def test_fleet_read_drops_foreign_stale_and_torn(tmp_path):
+    _publish(tmp_path, 0, {8: 111})
+    _publish(tmp_path, 9, {8: 111})                      # beyond world
+    (tmp_path / "integrity-rank1.json").write_text('{"rank": 1, "fing')
+    (tmp_path / "latency-rank0.json").write_text("{}")   # other family
+    old = {"rank": 2, "ts": time.time() - 10_000,
+           "fingerprints": {"8": "deadbeef"}}
+    (tmp_path / "integrity-rank2.json").write_text(json.dumps(old))
+    fleet = integ.read_fleet_fingerprints(str(tmp_path), world_size=4,
+                                          max_age_secs=600)
+    assert set(fleet) == {0}
+
+
+def test_fleet_read_skips_non_numeric_ts(tmp_path):
+    """Valid JSON with a garbage ts (foreign tool, operator debris)
+    must be SKIPPED, not crash every voting rank's step loop through
+    read_fleet_fingerprints -> note_fingerprint -> train_batch."""
+    _publish(tmp_path, 0, {8: 111})
+    bad = {"rank": 1, "ts": "yesterday", "fingerprints": {"8": "aa"}}
+    (tmp_path / "integrity-rank1.json").write_text(json.dumps(bad))
+    worse = {"rank": 2, "ts": [1, 2], "fingerprints": {"8": "aa"}}
+    (tmp_path / "integrity-rank2.json").write_text(json.dumps(worse))
+    fleet = integ.read_fleet_fingerprints(str(tmp_path), world_size=4,
+                                          max_age_secs=600)
+    assert set(fleet) == {0}
+    # without a max_age filter the ts is never parsed: files readable
+    assert set(integ.read_fleet_fingerprints(str(tmp_path),
+                                             world_size=4)) == {0, 1, 2}
+
+
+def test_integrity_plane_votes_and_trims_window(tmp_path):
+    plane = integ.IntegrityPlane(tmp_path, rank=0, fleet_size=3, window=2)
+    for r in (1, 2):
+        _publish(tmp_path, r, {1: 10, 2: 20})
+    v = plane.note_fingerprint(1, 10)
+    # newest quorum step is 2 (the two peers ahead of us agree there)
+    assert v["verdict"] == integ.VERDICT_OK
+    assert v["step"] == 2 and v["voters"] == 2
+    plane.note_fingerprint(2, 20)
+    plane.note_fingerprint(3, 30)
+    assert sorted(plane.history) == [2, 3]               # window trimmed
+    own = json.load(open(tmp_path / "integrity-rank0.json"))
+    assert sorted(own["fingerprints"]) == ["2", "3"]
+
+
+# ------------------------------------------------- heartbeat + quorum
+def test_hang_quorum_names_the_stale_laggard(tmp_path):
+    now = time.time()
+    for r in range(3):
+        integ.publish_rank_heartbeat(str(tmp_path), r, 5)
+    # rank 3 never entered step 5 and its beat is stale
+    integ.publish_rank_heartbeat(str(tmp_path), 3, 4)
+    beats = integ.read_fleet_heartbeats(str(tmp_path), world_size=4)
+    beats[3]["ts"] = now - 60
+    v = integ.hang_quorum(beats, self_rank=0, fleet_size=4,
+                          peer_timeout_secs=5, now=now)
+    assert v is not None and v["suspect"] == 3
+    assert v["suspect_step"] == 4 and v["head_step"] == 5
+    assert v["leaders"] == 3
+
+
+def test_hang_quorum_abstains_when_not_at_head_or_no_majority():
+    now = 1000.0
+    fleet = {0: {"step": 4, "ts": now - 60},
+             1: {"step": 5, "ts": now}, 2: {"step": 5, "ts": now},
+             3: {"step": 5, "ts": now}}
+    # rank 0 lags: IT must not vote (its local watchdog owns its fate)
+    assert integ.hang_quorum(fleet, 0, 4, 5, now=now) is None
+    # leaders are not a strict majority of the FLEET: abstain
+    small = {0: {"step": 5, "ts": now}, 1: {"step": 4, "ts": now - 60}}
+    assert integ.hang_quorum(small, 0, 4, 5, now=now) is None
+    # a lagging peer with a FRESH beat is slow, not hung
+    fresh = {0: {"step": 5, "ts": now}, 1: {"step": 5, "ts": now},
+             2: {"step": 5, "ts": now}, 3: {"step": 4, "ts": now - 1}}
+    assert integ.hang_quorum(fresh, 0, 4, 5, now=now) is None
+
+
+def test_fleet_heartbeat_fires_verdict_and_eviction_exit(tmp_path):
+    """Healthy ranks at the head detect the stale laggard, commit the
+    verdict file, run the flush hook, and exit 87 — instead of blocking
+    in a collective until N local watchdogs time out."""
+    exits, fired = [], []
+    hb = integ.FleetHeartbeat(
+        tmp_path, rank=0, fleet_size=3, peer_timeout_secs=0.2,
+        poll_interval=0.05, exit_fn=exits.append,
+        on_fire=lambda v: fired.append(v))
+    integ.publish_rank_heartbeat(str(tmp_path), 1, 7)
+    stale = {"rank": 2, "step": 6, "ts": time.time() - 60}
+    (tmp_path / "heartbeat-rank2.json").write_text(json.dumps(stale))
+    hb.start()
+    time.sleep(0.2)
+    assert not hb.fired          # not armed before OUR first beat
+    hb.beat(7)
+    deadline = time.time() + 5
+    while not hb.fired and time.time() < deadline:
+        time.sleep(0.05)
+    assert hb.fired and exits == [EXIT_INTEGRITY_EVICT]
+    assert fired and fired[0]["suspect"] == 2
+    v = integ.read_verdict(str(tmp_path))
+    assert v["kind"] == integ.KIND_HANG and v["suspect"] == 2
+    hb.stop()
+
+
+def test_fleet_heartbeat_warn_action_does_not_evict(tmp_path):
+    """integrity_action='warn' is the operator's explicit opt-out of
+    automated eviction: a hang-quorum conviction runs the telemetry
+    hook but writes NO verdict file and never exits — a momentary
+    stall on a sharded mesh must not tear the fleet down."""
+    exits, fired = [], []
+    hb = integ.FleetHeartbeat(
+        tmp_path, rank=0, fleet_size=3, peer_timeout_secs=0.2,
+        poll_interval=0.05, exit_fn=exits.append, action="warn",
+        on_fire=lambda v: fired.append(v))
+    integ.publish_rank_heartbeat(str(tmp_path), 1, 7)
+    stale = {"rank": 2, "step": 6, "ts": time.time() - 60}
+    (tmp_path / "heartbeat-rank2.json").write_text(json.dumps(stale))
+    hb.start()
+    hb.beat(7)
+    deadline = time.time() + 5
+    while not hb.fired and time.time() < deadline:
+        time.sleep(0.05)
+    assert hb.fired and fired and fired[0]["suspect"] == 2
+    assert exits == []                                   # no eviction
+    assert integ.read_verdict(str(tmp_path)) is None     # no verdict
+    hb.stop()
+    with pytest.raises(AssertionError, match="integrity action"):
+        integ.FleetHeartbeat(tmp_path, rank=0, fleet_size=3,
+                             peer_timeout_secs=1.0, action="explode")
+
+
+def test_integrity_plane_reset_history_unpublishes(tmp_path):
+    """After an in-process rollback the abandoned timeline's published
+    fingerprints must disappear immediately — a mixed stale/replayed
+    window could convict a rank the rollback already fixed."""
+    plane = integ.IntegrityPlane(tmp_path, rank=0, fleet_size=3)
+    plane.note_fingerprint(1, 111)
+    plane.note_fingerprint(2, 222)
+    assert (tmp_path / "integrity-rank0.json").exists()
+    plane.reset_history()
+    assert plane.history == {} and plane.last_verdict is None
+    assert not (tmp_path / "integrity-rank0.json").exists()
+    assert integ.read_fleet_fingerprints(str(tmp_path)) == {}
+
+
+def test_fleet_heartbeat_pause_disarms(tmp_path):
+    exits = []
+    hb = integ.FleetHeartbeat(tmp_path, rank=0, fleet_size=3,
+                              peer_timeout_secs=0.1, poll_interval=0.02,
+                              exit_fn=exits.append)
+    integ.publish_rank_heartbeat(str(tmp_path), 1, 7)
+    stale = {"rank": 2, "step": 6, "ts": time.time() - 60}
+    (tmp_path / "heartbeat-rank2.json").write_text(json.dumps(stale))
+    hb.beat(7)
+    hb.pause()                   # restore/final-save window
+    hb.start()
+    time.sleep(0.3)
+    assert not hb.fired and exits == []
+    hb.stop()
+
+
+def test_fleet_heartbeat_pause_keeps_own_beat_fresh(tmp_path):
+    """Conviction happens on the PEERS' side, so a paused rank (long
+    sync save, restore) must keep republishing its last beat with a
+    fresh timestamp — going silent past the peers' timeout would get a
+    healthy host evicted for a routine save."""
+    hb = integ.FleetHeartbeat(tmp_path, rank=0, fleet_size=3,
+                              peer_timeout_secs=5.0, poll_interval=0.02,
+                              exit_fn=lambda c: None)
+    hb.beat(7)
+    first_ts = integ.read_fleet_heartbeats(str(tmp_path))[0]["ts"]
+    hb.pause()
+    hb.start()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        beats = integ.read_fleet_heartbeats(str(tmp_path))
+        if beats[0]["ts"] > first_ts:
+            break
+        time.sleep(0.02)
+    refreshed = integ.read_fleet_heartbeats(str(tmp_path))[0]
+    assert refreshed["ts"] > first_ts, "paused rank went silent"
+    assert refreshed["step"] == 7          # still the pre-pause step
+    hb.stop()
+
+
+def test_fleet_heartbeat_publish_is_time_throttled(tmp_path):
+    """beat() per optimizer step must NOT mean one file write per step:
+    sub-min_publish_secs steps coalesce (time-based throttle only; the
+    MONITOR thread — not started here — owns catching the published
+    beat up to a swallowed step advance, off the hot path)."""
+    hb = integ.FleetHeartbeat(tmp_path, rank=0, fleet_size=2,
+                              peer_timeout_secs=60.0,
+                              min_publish_secs=30.0,
+                              exit_fn=lambda c: None)
+    for step in range(1, 50):
+        hb.beat(step)
+    published = integ.read_fleet_heartbeats(str(tmp_path))[0]
+    assert published["step"] == 1          # only the first beat wrote
+    assert hb._last_step == 49             # the monitor still tracks us
+
+
+def test_fleet_heartbeat_monitor_catches_up_throttled_beat(tmp_path):
+    """A long step FOLLOWING a sub-throttle one must not leave this
+    rank published one step behind the head with a growing-stale ts —
+    the exact shape the quorum convicts, so without catch-up a healthy
+    rank blocked behind a genuinely hung peer could be named instead of
+    the peer.  The monitor thread republishes the swallowed step
+    advance within one poll_interval; only real main-thread progress
+    triggers it, so afterwards the timestamp ages normally and a
+    genuine mid-step hang still reads stale."""
+    hb = integ.FleetHeartbeat(tmp_path, rank=0, fleet_size=3,
+                              peer_timeout_secs=60.0, poll_interval=0.02,
+                              min_publish_secs=30.0,
+                              exit_fn=lambda c: None)
+    hb.beat(7)                             # published
+    hb.beat(8)                             # swallowed by the throttle
+    assert integ.read_fleet_heartbeats(str(tmp_path))[0]["step"] == 7
+    hb.start()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if integ.read_fleet_heartbeats(str(tmp_path))[0]["step"] == 8:
+            break
+        time.sleep(0.02)
+    published = integ.read_fleet_heartbeats(str(tmp_path))[0]
+    assert published["step"] == 8, "monitor never caught up the beat"
+    ts = published["ts"]
+    time.sleep(0.2)                        # > several poll intervals
+    assert integ.read_fleet_heartbeats(str(tmp_path))[0]["ts"] == ts, (
+        "monitor refreshed the ts without progress — a real hang "
+        "would be masked from the peers' staleness check")
+    hb.stop()
+
+
+def test_consensus_tie_with_lagging_publisher_is_not_poison(tmp_path):
+    """fleet=5, 4 voters split 2-2: a tie among the VOTERS, but rank
+    4's pending vote could still make either value a 3/5 fleet
+    majority — poisoning here (exit 86, never respawns) would tear
+    down a run one more publish could have saved by eviction.  The
+    step is undecidable (pending), and once the straggler votes the
+    minority bloc IS convicted."""
+    _publish(tmp_path, 0, {4: 0xAA})
+    _publish(tmp_path, 1, {4: 0xAA})
+    _publish(tmp_path, 2, {4: 0xBB})
+    _publish(tmp_path, 3, {4: 0xBB})
+    fleet = integ.read_fleet_fingerprints(str(tmp_path), world_size=5)
+    v = integ.fingerprint_consensus(fleet, 5)
+    assert v["verdict"] == integ.VERDICT_PENDING, v
+    # the lagging rank breaks the tie: 3/5 fleet majority -> outlier
+    _publish(tmp_path, 4, {4: 0xAA})
+    fleet = integ.read_fleet_fingerprints(str(tmp_path), world_size=5)
+    v = integ.fingerprint_consensus(fleet, 5)
+    assert v["verdict"] == integ.VERDICT_OUTLIER
+    assert v["suspects"] == [2, 3]
+    # full participation with no possible fleet majority stays poison
+    fleet = {0: {4: "aa"}, 1: {4: "aa"}, 2: {4: "bb"}, 3: {4: "bb"}}
+    v = integ.fingerprint_consensus(fleet, 4)
+    assert v["verdict"] == integ.VERDICT_NO_MAJORITY
+
+
+def test_consensus_plurality_of_voters_cannot_evict(tmp_path):
+    """fleet=5, only 3 published, split 2-1: the pair is a majority of
+    the VOTERS but not of the fleet — convicting would let 2/5 ranks
+    evict a peer the unpublished rest may agree with.  The step is
+    skipped (pending here), NOT an outlier and NOT a poison split."""
+    _publish(tmp_path, 0, {4: 0xAA})
+    _publish(tmp_path, 1, {4: 0xAA})
+    _publish(tmp_path, 2, {4: 0xBB})
+    fleet = integ.read_fleet_fingerprints(str(tmp_path), world_size=5)
+    v = integ.fingerprint_consensus(fleet, 5)
+    assert v["verdict"] == integ.VERDICT_PENDING, v
+    # once a fleet majority holds the value, the outlier IS convicted
+    _publish(tmp_path, 3, {4: 0xAA})
+    fleet = integ.read_fleet_fingerprints(str(tmp_path), world_size=5)
+    v = integ.fingerprint_consensus(fleet, 5)
+    assert v["verdict"] == integ.VERDICT_OUTLIER and v["suspects"] == [2]
+
+
+# ------------------------------------------------------- verdict file
+def test_verdict_first_writer_wins(tmp_path):
+    p1 = integ.write_verdict(str(tmp_path), integ.KIND_SDC, 2, "first",
+                             rank=0, step=9)
+    p2 = integ.write_verdict(str(tmp_path), integ.KIND_HANG, 3, "second")
+    assert p1 == p2
+    v = integ.read_verdict(str(tmp_path))
+    assert v["kind"] == "sdc_outlier" and v["suspect"] == 2
+    assert v["rank"] == 0 and v["step"] == 9
+
+
+def test_verdict_commit_is_atomic_over_torn_first_writer(tmp_path):
+    """A first writer killed mid-dump must not suppress every other
+    accuser: the verdict only ever appears fully written (per-writer
+    tmp + os.link), and a pre-existing TORN file at the verdict path
+    is the pathology the link commit avoids — simulate the old
+    open('x') torn state and show a reader sees None (the launcher
+    resizes blind), then show the new commit path never produces it."""
+    # new path: the committed file is complete JSON even while a
+    # concurrent .w<pid> tmp exists
+    p = integ.write_verdict(str(tmp_path), integ.KIND_SDC, 2, "full")
+    assert p and integ.read_verdict(str(tmp_path))["suspect"] == 2
+    assert not [n for n in os.listdir(tmp_path) if ".w" in n]  # tmp gone
+    # second accuser: first writer still wins, no tmp debris
+    integ.write_verdict(str(tmp_path), integ.KIND_HANG, 3, "late")
+    assert integ.read_verdict(str(tmp_path))["suspect"] == 2
+    assert not [n for n in os.listdir(tmp_path) if ".w" in n]
+    # full clear scrubs a mid-commit writer's orphaned tmp too
+    (tmp_path / (integ.VERDICT_FILE + ".w12345")).write_text("{")
+    integ.clear_fleet_state(str(tmp_path))
+    assert os.listdir(tmp_path) == []
+
+
+def test_verdict_tmp_path_is_unique_per_writer(tmp_path, monkeypatch):
+    """Accusers on DIFFERENT nodes share the run dir and can share a
+    pid (pid_max wraps): the per-writer tmp must be unique per WRITE,
+    not per pid, or two colliding writers truncate each other's
+    in-progress JSON and os.link publishes a torn verdict — which
+    reads as no-verdict and un-aims every node's resize."""
+    seen = []
+    real_link = os.link
+    monkeypatch.setattr(
+        os, "link", lambda src, dst: (seen.append(src),
+                                      real_link(src, dst)))
+    integ.write_verdict(str(tmp_path), integ.KIND_SDC, 1, "a")
+    (tmp_path / integ.VERDICT_FILE).unlink()
+    integ.write_verdict(str(tmp_path), integ.KIND_SDC, 1, "b")
+    assert len(seen) == 2 and seen[0] != seen[1]
+
+
+def test_read_verdict_rejects_unaimable_debris(tmp_path):
+    """A "verdict" without an int-coercible suspect is shared-run-dir
+    debris (foreign writer, other schema version): the supervisor
+    cannot aim a resize with it, and passing it through would
+    TypeError the launcher monitor loop — the one process that must
+    outlive everything.  read_verdict validates, so the launcher
+    resizes blind instead of dying."""
+    path = tmp_path / integ.VERDICT_FILE
+    for debris in ('{"kind": "sdc_outlier"}',            # no suspect
+                   '{"suspect": null, "kind": "x"}',     # null suspect
+                   '{"suspect": "rank two"}',            # non-numeric
+                   '[1, 2, 3]',                          # non-dict
+                   '{"torn'):                            # torn JSON
+        path.write_text(debris)
+        assert integ.read_verdict(str(tmp_path)) is None, debris
+    path.write_text('{"suspect": "2", "kind": "sdc_outlier"}')
+    v = integ.read_verdict(str(tmp_path))
+    assert v is not None and v["suspect"] == 2           # coerced int
+
+
+def test_verdict_consumed_marker_sibling_contract(tmp_path):
+    """Consumption RENAMES the verdict to the consumed marker instead
+    of deleting it: deletion races sibling nodes' monitor polls in a
+    shared run dir and the node that owns the suspect's slot would
+    resize blind.  The rename frees VERDICT_FILE for the next life's
+    first-writer-wins commit, the resize-path clear preserves the
+    marker, and the default (startup) clear scrubs it."""
+    integ.write_verdict(str(tmp_path), integ.KIND_SDC, 2, "first")
+    assert integ.mark_verdict_consumed(str(tmp_path)) is not None
+    # fresh file gone, marker readable only via the sibling fallback
+    assert integ.read_verdict(str(tmp_path)) is None
+    sibling = integ.read_verdict(str(tmp_path), include_consumed=True)
+    assert sibling is not None and sibling["suspect"] == 2
+    # the fresh path is free again: a NEW conviction commits (the old
+    # open-'x'-blocked-forever shape is gone) and shadows the marker
+    integ.write_verdict(str(tmp_path), integ.KIND_HANG, 3, "second")
+    fresh = integ.read_verdict(str(tmp_path), include_consumed=True)
+    assert fresh["suspect"] == 3 and fresh["kind"] == integ.KIND_HANG
+    integ.mark_verdict_consumed(str(tmp_path))           # overwrites
+    assert integ.read_verdict(
+        str(tmp_path), include_consumed=True)["suspect"] == 3
+    # resize-path clear keeps the marker, scrubs everything else
+    _publish(tmp_path, 0, {1: 1})
+    integ.publish_rank_heartbeat(str(tmp_path), 0, 1)
+    integ.clear_fleet_state(str(tmp_path), keep_consumed=True)
+    assert os.listdir(tmp_path) == [integ.VERDICT_CONSUMED_FILE]
+    # startup clear (reused run dir) scrubs the marker with the rest
+    integ.clear_fleet_state(str(tmp_path))
+    assert os.listdir(tmp_path) == []
+    # nothing to rename: fail-soft
+    assert integ.mark_verdict_consumed(str(tmp_path)) is None
+
+
+def test_eviction_ledger_malformed_env_degrades(monkeypatch):
+    """A malformed DS_INTEGRITY_MAX_EVICTIONS must degrade to the
+    default, never kill the launcher at startup."""
+    monkeypatch.setenv("DS_INTEGRITY_MAX_EVICTIONS", "one")
+    ledger = EvictionLedger()
+    assert ledger.max_evictions == 1
+
+
+def test_clear_fleet_state_removes_every_artifact(tmp_path):
+    _publish(tmp_path, 0, {1: 1})
+    integ.publish_rank_heartbeat(str(tmp_path), 0, 1)
+    integ.write_verdict(str(tmp_path), integ.KIND_SDC, 1, "x")
+    (tmp_path / "integrity-rank3.json.tmp").write_text("{")
+    (tmp_path / "events-rank0.jsonl").write_text("{}\n")  # NOT ours
+    removed = integ.clear_fleet_state(str(tmp_path))
+    assert removed == 4
+    assert sorted(os.listdir(tmp_path)) == ["events-rank0.jsonl"]
+    assert integ.read_verdict(str(tmp_path)) is None
+
+
+def test_clear_fleet_state_targeted_rank(tmp_path):
+    """An ordinary (non-87) single-rank respawn clears only THAT rank's
+    fingerprint/heartbeat files: the dead life's stale beat would
+    otherwise read as a hang through the backoff + re-init window and
+    the quorum would falsely evict the new life — while peers' state
+    and any committed verdict must survive the targeted clear."""
+    for r in (0, 1):
+        _publish(tmp_path, r, {1: 1})
+        integ.publish_rank_heartbeat(str(tmp_path), r, 1)
+    integ.write_verdict(str(tmp_path), integ.KIND_SDC, 9, "x")
+    (tmp_path / "heartbeat-rank1.json.tmp").write_text("{")
+    removed = integ.clear_fleet_state(str(tmp_path), rank=1)
+    assert removed == 3          # rank 1's fp + beat + beat .tmp
+    assert set(integ.read_fleet_fingerprints(str(tmp_path))) == {0}
+    assert set(integ.read_fleet_heartbeats(str(tmp_path))) == {0}
+    assert integ.read_verdict(str(tmp_path)) is not None
+
+
+# ---------------------------------------------------- eviction ledger
+def test_eviction_ledger_blocklist_and_budget(monkeypatch):
+    monkeypatch.delenv("DS_INTEGRITY_MAX_EVICTIONS", raising=False)
+    ledger = EvictionLedger()
+    assert ledger.max_evictions == 1
+    assert ledger.filter_slots([0, 1, 2, 3]) == [0, 1, 2, 3]
+    assert ledger.record(suspect=2, slot=2, kind="sdc_outlier")
+    assert ledger.blocked_slots == {2}
+    assert ledger.filter_slots([0, 1, 2, 3]) == [0, 1, 3]
+    # the SECOND verdict is a repeated eviction: poison, not resize
+    assert not ledger.record(suspect=1, slot=1, kind="hang_quorum")
+    assert ledger.blocked_slots == {1, 2}
+
+
+def test_eviction_ledger_env_budget(monkeypatch):
+    monkeypatch.setenv("DS_INTEGRITY_MAX_EVICTIONS", "2")
+    ledger = EvictionLedger()
+    assert ledger.record(0, 0, "sdc_outlier")
+    assert ledger.record(1, 1, "sdc_outlier")
+    assert not ledger.record(2, 2, "sdc_outlier")
+    # a verdict whose suspect has no live slot still charges the budget
+    assert EvictionLedger(max_evictions=1).record(5, None, "hang_quorum")
+
+
+# ------------------------------------------------------ chaos injectors
+def _make_engine(cpu_devices, dp=4, **overrides):
+    cfg = base_config(steps_per_print=10 ** 9)
+    cfg.update(overrides)
+    mesh = make_mesh({"data": dp}, devices=cpu_devices[:dp])
+    engine, *_ = deepspeed.initialize(model=SimpleModel(HIDDEN, nlayers=2),
+                                      config=cfg, mesh=mesh)
+    return engine
+
+
+@pytest.fixture
+def fleet_of_two(monkeypatch):
+    """Launcher-style fleet identity: the fingerprint consensus only
+    arms for >= 2 ranks (a single process can never reach quorum)."""
+    monkeypatch.setenv("DS_PROCESS_ID", "0")
+    monkeypatch.setenv("DS_NUM_PROCESSES", "2")
+
+
+def test_chaos_bitflip_changes_one_element(cpu_devices):
+    import jax
+
+    engine = _make_engine(cpu_devices)
+    engine.train_batch(iter([random_batches(1, 16, HIDDEN, seed=0)[0]]))
+    before = np.array(jax.device_get(engine.state["master"]))
+    monkey = ChaosMonkey(seed=5)
+    idx, bit = monkey.bitflip_state(engine)
+    after = np.array(jax.device_get(engine.state["master"]))
+    diff = np.flatnonzero(before.reshape(-1).view(np.uint32)
+                          != after.reshape(-1).view(np.uint32))
+    assert list(diff) == [idx]
+    assert 0 <= bit < 32
+    assert monkey.log == [(f"master[{idx}]", "bitflip")]
+    # same seed -> same flip (the fleet-reproducibility contract)
+    assert ChaosMonkey(seed=5).bitflip_state(engine) == (idx, bit)
+    engine.close()
+
+
+def test_chaos_bitflip_changes_the_fingerprint(cpu_devices):
+    """The injected SDC is invisible to loss/NaN guards but MUST move
+    the state checksum — the detectability contract."""
+    import jax
+
+    engine = _make_engine(cpu_devices)
+    engine.train_batch(iter([random_batches(1, 16, HIDDEN, seed=0)[0]]))
+    engine._integrity = integ.IntegrityPlane(".", 0, 1)  # arm the jit
+    clean = int(jax.device_get(engine._integrity_fingerprint_device()))
+    ChaosMonkey(seed=1).bitflip_state(engine)
+    flipped = int(jax.device_get(engine._integrity_fingerprint_device()))
+    assert clean != flipped
+    engine._integrity = None
+    engine.close()
+
+
+def test_fingerprint_sees_every_single_bit_flip(cpu_devices):
+    """The checksum's position weights are forced ODD, so flipping ANY
+    single bit of ANY element moves the uint32 sum — including the MSB
+    (fp32 sign bit) at ODD flat indices, which an even weight (the
+    naive ``i*K + 1`` form: even for odd ``i``) would make invisible
+    mod 2^32.  Exactly the silent-SDC class the plane exists for."""
+    import jax
+
+    engine = _make_engine(cpu_devices)
+    engine.train_batch(iter([random_batches(1, 16, HIDDEN, seed=0)[0]]))
+    engine._integrity = integ.IntegrityPlane(".", 0, 1)  # arm the jit
+    clean = int(jax.device_get(engine._integrity_fingerprint_device()))
+    for idx, bit in ((1, 31), (3, 31), (0, 31), (2, 0)):
+        before = engine.state["master"]
+        host = np.array(jax.device_get(before))
+        flat = host.reshape(-1).view(np.uint32)
+        flat[idx] ^= np.uint32(1 << bit)
+        engine.state["master"] = jax.device_put(host, before.sharding)
+        flipped = int(jax.device_get(
+            engine._integrity_fingerprint_device()))
+        assert flipped != clean, (
+            f"MSB/bit-{bit} flip at flat index {idx} left the "
+            f"fingerprint unchanged — even position weight?")
+        engine.state["master"] = before
+    engine._integrity = None
+    engine.close()
+
+
+def test_integrity_fingerprint_disabled_under_offload(cpu_devices,
+                                                      tmp_path,
+                                                      fleet_of_two):
+    """ZeRO-Offload homes (master, opt) on the host BECAUSE it does not
+    fit on device: the in-jit checksum would re-upload it every print
+    cadence, so the fingerprint consensus refuses to arm (loud warning)
+    while the config still validates — heartbeat-only integrity."""
+    engine = _make_engine(
+        cpu_devices,
+        **{"steps_per_print": 1,
+           "zero_optimization": {"stage": 2, "cpu_offload": True},
+           "telemetry": {"enabled": True, "run_dir": str(tmp_path)},
+           "resilience": {"enabled": True, "integrity": True}})
+    assert engine._integrity is None
+    engine.train_batch(iter([random_batches(1, 16, HIDDEN, seed=0)[0]]))
+    assert not (tmp_path / "integrity-rank0.json").exists()
+    engine.close()
+
+
+def test_drain_watchdog_malformed_env_degrades(monkeypatch):
+    """A malformed DS_TERM_DRAIN_DEADLINE_SECS inside the SIGTERM
+    handler must fall back to the default, never raise and abort the
+    drain + final save it protects."""
+    from deepspeed_tpu.checkpoint.manager import _arm_drain_watchdog
+
+    monkeypatch.setenv("DS_TERM_DRAIN_DEADLINE_SECS", "90s")
+    timer = _arm_drain_watchdog(grace=30.0)
+    assert timer is not None            # default: 90% of the grace
+    timer.cancel()
+    monkeypatch.setenv("DS_TERM_DRAIN_DEADLINE_SECS", "0")
+    assert _arm_drain_watchdog(grace=30.0) is None
+
+
+def test_chaos_bitflip_and_hang_target_a_specific_rank(cpu_devices):
+    engine = _make_engine(cpu_devices)
+    engine.train_batch(iter([random_batches(1, 16, HIDDEN, seed=0)[0]]))
+
+    # non-victim rank: the schedule passes through untouched
+    monkey = ChaosMonkey(seed=3)
+    out = list(monkey.wrap_iter(iter(range(4)), bitflip_steps=[1],
+                                bitflip_engine=engine, hang_steps=[2],
+                                hang_event=threading.Event(),
+                                rank=1, target_rank=0))
+    assert out == list(range(4)) and monkey.log == []
+
+    # victim rank: bitflip lands at pull 1, hang at pull 2 (pre-set
+    # event = released hang: returns immediately but logs the block)
+    released = threading.Event()
+    released.set()
+    victim = ChaosMonkey(seed=3)
+    out = list(victim.wrap_iter(iter(range(4)), bitflip_steps=[1],
+                                bitflip_engine=engine, hang_steps=[2],
+                                hang_event=released, rank=0,
+                                target_rank=0))
+    assert out == list(range(4))
+    assert [k for _, k in victim.log] == ["bitflip", "hang"]
+    engine.close()
+
+
+def test_chaos_bitflip_requires_engine():
+    with pytest.raises(AssertionError, match="bitflip_engine"):
+        list(ChaosMonkey(0).wrap_iter(iter([1]), bitflip_steps=[0]))
+
+
+# ----------------------------------------------------- engine wiring
+def _tel_res_config(run_dir, **res):
+    res.setdefault("enabled", True)
+    res.setdefault("integrity", True)
+    return base_config(steps_per_print=1,
+                       telemetry={"enabled": True, "run_dir": str(run_dir)},
+                       resilience=res)
+
+
+def _read_events(run_dir, event_type):
+    from deepspeed_tpu.telemetry import read_events
+
+    return [r for r in read_events(run_dir) if r["type"] == event_type]
+
+
+def test_engine_heartbeat_arming_needs_three_ranks(cpu_devices, tmp_path,
+                                                   monkeypatch):
+    """A 2-rank fleet can never reach a convicting hang majority (both
+    at head = no suspect; one lagging = no majority), so the engine
+    must not pay an inert monitor thread — and a 3-rank fleet arms
+    with the configured action."""
+    monkeypatch.setenv("DS_PROCESS_ID", "0")
+    for n, armed in (("2", False), ("3", True)):
+        monkeypatch.setenv("DS_NUM_PROCESSES", n)
+        engine = _make_engine(
+            cpu_devices,
+            **_tel_res_config(tmp_path / n, integrity_action="warn",
+                              integrity_peer_timeout_secs=30.0))
+        if armed:
+            assert engine._fleet_heartbeat is not None
+            assert engine._fleet_heartbeat.action == "warn"
+        else:
+            assert engine._fleet_heartbeat is None
+        engine.close()
+
+
+def test_engine_fingerprint_is_replica_deterministic(cpu_devices,
+                                                     tmp_path,
+                                                     fleet_of_two):
+    """Two same-seed engines (simulated dp replicas) publish BIT-EXACT
+    fingerprints step for step — the property the majority vote rests
+    on — and a bitflip on one desyncs it."""
+    batches = random_batches(2, 16, HIDDEN, seed=0)
+    fps = []
+    for sub in ("a", "b"):
+        engine = _make_engine(
+            cpu_devices, **{"steps_per_print": 1,
+                            "telemetry": {"enabled": True,
+                                          "run_dir": str(tmp_path / sub)},
+                            "resilience": {"enabled": True,
+                                           "integrity": True}})
+        for b in batches:
+            engine.train_batch(iter([b]))
+        own = json.load(open(tmp_path / sub / "integrity-rank0.json"))
+        fps.append(own["fingerprints"])
+        engine.close()
+    assert fps[0] == fps[1] and sorted(fps[0]) == ["1", "2"]
+
+
+def test_engine_sdc_outlier_evicts_with_verdict(cpu_devices, tmp_path,
+                                                fleet_of_two):
+    """The tentpole loop, in process: three simulated peers agree, this
+    rank's corrupted state disagrees -> FleetIntegrityError(87), the
+    supervisor-facing verdict file names the suspect, telemetry carries
+    EVENT_INTEGRITY, and the watchdog threads are stopped first."""
+    engine = _make_engine(cpu_devices,
+                          **_tel_res_config(tmp_path))
+    batches = random_batches(2, 16, HIDDEN, seed=0)
+    engine.train_batch(iter([batches[0]]))
+    engine._integrity.fleet_size = 4          # simulate the fleet
+    for r in (1, 2, 3):
+        integ.publish_rank_fingerprint(
+            str(tmp_path), r, {1: "deadbeef", 2: "deadbeef"})
+    with pytest.raises(FleetIntegrityError) as exc:
+        engine.train_batch(iter([batches[1]]))
+    assert exc.value.exit_code == EXIT_INTEGRITY_EVICT
+    assert exc.value.suspect == 0 and exc.value.kind == "sdc_outlier"
+    v = integ.read_verdict(str(tmp_path))
+    assert v["kind"] == "sdc_outlier" and v["suspect"] == 0
+    events = _read_events(tmp_path, "integrity")
+    assert events and events[-1]["data"]["verdict"] == "outlier"
+    assert events[-1]["data"]["suspects"] == [0]
+    assert events[-1]["data"]["kind"] == "fingerprint"
+    snap = json.load(open(tmp_path / "metrics-rank0.json"))
+    assert snap["integrity/violations"]["value"] >= 1.0
+    engine.close()
+
+
+def test_engine_no_majority_poisons(cpu_devices, tmp_path,
+                                    fleet_of_two):
+    """A 2-2 split leaves nobody to trust: TrainingDivergedError (86,
+    poison — the launcher never respawns it), and NO eviction verdict
+    is written."""
+    engine = _make_engine(cpu_devices, **_tel_res_config(tmp_path))
+    batches = random_batches(2, 16, HIDDEN, seed=0)
+    engine.train_batch(iter([batches[0]]))
+    engine._integrity.fleet_size = 4
+    integ.publish_rank_fingerprint(str(tmp_path), 1, {1: "deadbeef",
+                                                      2: "deadbeef"})
+    own = json.load(open(tmp_path / "integrity-rank0.json"))
+    fp1 = own["fingerprints"]["1"]
+    for r in (2, 3):
+        integ.publish_rank_fingerprint(str(tmp_path), r, {1: fp1})
+    # step 1 now has votes {me: fp1, 1: dead, 2: fp1, 3: fp1} -> ok...
+    # make step 2 the split: two agree with whatever I compute is
+    # impossible to prearrange, so split the OLDER step instead
+    integ.publish_rank_fingerprint(str(tmp_path), 2, {1: "deadbeef"})
+    with pytest.raises(TrainingDivergedError) as exc:
+        engine.train_batch(iter([batches[1]]))
+    assert exc.value.exit_code == EXIT_DIVERGENCE_ABORT
+    assert integ.read_verdict(str(tmp_path)) is None
+    engine.close()
+
+
+def test_engine_warn_action_continues(cpu_devices, tmp_path,
+                                      fleet_of_two):
+    """integrity_action=warn (sharded meshes, future per-shard work):
+    the outlier verdict is telemetry-only — training continues, nothing
+    raises, no verdict file."""
+    engine = _make_engine(
+        cpu_devices, **_tel_res_config(tmp_path, integrity_action="warn"))
+    batches = random_batches(3, 16, HIDDEN, seed=0)
+    engine.train_batch(iter([batches[0]]))
+    engine._integrity.fleet_size = 4
+    for r in (1, 2, 3):
+        integ.publish_rank_fingerprint(str(tmp_path), r, {1: "deadbeef"})
+    engine.train_batch(iter([batches[1]]))
+    engine.train_batch(iter([batches[2]]))
+    assert integ.read_verdict(str(tmp_path)) is None
+    events = _read_events(tmp_path, "integrity")
+    assert any(e["data"]["verdict"] == "outlier" for e in events)
+    engine.close()
+
+
+def test_engine_consensus_ok_across_simulated_fleet(cpu_devices,
+                                                    tmp_path,
+                                                    fleet_of_two):
+    """Peers that agree with this rank's real fingerprints produce ok
+    verdicts and no escalation."""
+    engine = _make_engine(cpu_devices, **_tel_res_config(tmp_path))
+    batches = random_batches(2, 16, HIDDEN, seed=0)
+    engine.train_batch(iter([batches[0]]))
+    own = json.load(open(tmp_path / "integrity-rank0.json"))
+    engine._integrity.fleet_size = 4
+    for r in (1, 2, 3):
+        integ.publish_rank_fingerprint(
+            str(tmp_path), r,
+            {int(s): fp for s, fp in own["fingerprints"].items()})
+    engine.train_batch(iter([batches[1]]))   # votes: step 1 unanimous
+    events = _read_events(tmp_path, "integrity")
+    assert events[-1]["data"]["verdict"] == "ok"
+    assert events[-1]["data"]["voters"] == 4
+    engine.close()
+
+
+def test_report_integrity_section_and_json(tmp_path):
+    """The report CLI's fleet-integrity section: non-ok verdicts and
+    hang fires reconstructed from run-dir artifacts alone (text + the
+    structured ``--json`` document), and the launcher's ``evict`` phase
+    spelled out in the elastic timeline."""
+    from deepspeed_tpu.telemetry import report as report_mod
+    from deepspeed_tpu.telemetry.events import EventLog
+
+    w = EventLog(str(tmp_path), rank=0)
+    w.emit("integrity", step=1, verdict="ok", kind="fingerprint",
+           suspects=[], voters=4, voted_step=1,
+           majority_fingerprint="aa", fingerprint="aa")
+    w.emit("integrity", step=2, verdict="outlier", kind="fingerprint",
+           suspects=[2], voters=4, voted_step=2,
+           majority_fingerprint="bb", fingerprint="bb")
+    w.emit("integrity", step=2, verdict="outlier", kind="hang_quorum",
+           suspects=[3], stalled_secs=4.2, suspect_step=1, head_step=2,
+           voters=3)
+    w.emit("elastic", phase="evict", suspect=2, slot=2,
+           kind="sdc_outlier", detail="fp", eviction=1, exit_code=87)
+    w.close()
+
+    text, records = report_mod.generate_report(str(tmp_path))
+    assert "fleet integrity" in text
+    assert "fingerprint votes: 2 (1 ok/pending, 1 flagged)" in text
+    assert "fingerprint outlier: rank(s) [2]" in text
+    assert "hang quorum: rank(s) [3] stalled 4.2s" in text
+    assert "integrity verdict (sdc_outlier): rank 2 / slot 2" in text
+    for r in records:
+        from deepspeed_tpu.telemetry.events import validate_event
+        assert validate_event(r) == [], r
+
+    # an integrity-typed line WITHOUT "data" (older/foreign writer,
+    # hand-patched artifact) must not crash the report — every section
+    # reads defensively
+    ev_file = next(tmp_path.glob("events-rank0*.jsonl"))
+    with open(ev_file, "a") as f:
+        f.write(json.dumps({"type": "integrity", "ts": 1.0, "rank": 0,
+                            "seq": 999}) + "\n")
+    text_d, _ = report_mod.generate_report(str(tmp_path))
+    assert "fingerprint votes: 2 (1 ok/pending, 1 flagged)" in text_d
+
+    doc = report_mod.report_json(str(tmp_path))
+    # only non-ok verdicts ride the structured section (the ok votes
+    # stay in the raw event list)
+    assert [d["suspects"] for d in doc["integrity"]] == [[2], [3]]
+    assert doc["elastic"][0]["phase"] == "evict"
+
+    # a run with no integrity events prints no section at all
+    other = tmp_path / "plain"
+    other.mkdir()
+    w2 = EventLog(str(other), rank=0)
+    w2.emit("run_start", world_size=1)
+    w2.close()
+    text2, _ = report_mod.generate_report(str(other))
+    assert "fleet integrity" not in text2
+
+
+def test_engine_integrity_requires_telemetry(cpu_devices):
+    """No run dir = no exchange medium: the plane disables itself with
+    a warning instead of crashing or silently pretending to guard."""
+    engine = _make_engine(cpu_devices,
+                          resilience={"enabled": True, "integrity": True})
+    assert engine._integrity is None and engine._fleet_heartbeat is None
+    engine.train_batch(iter([random_batches(1, 16, HIDDEN, seed=0)[0]]))
+    engine.close()

@@ -1,0 +1,481 @@
+"""Fleet-integrity recovery, proven end-to-end on the real launcher.
+
+THE chaos e2e pair (PR 15 acceptance):
+
+- **bitflip**: a seeded SDC on one rank of a 4-process fleet → the
+  fingerprint consensus names that rank → every rank exits 87 → the
+  supervisor evicts the suspect's slot, rolls the fleet back to the
+  latest committed checkpoint, and resizes WITHOUT the suspect → the
+  remaining steps match an uninterrupted same-batch reference to rtol
+  1e-3.
+- **hang**: one rank wedges before entering a step → the healthy
+  majority's heartbeat quorum convicts it and exits 87 → ONE eviction
+  resize completes well inside the local watchdog timeout (wall-clock
+  bound asserted): one resize, not N independent watchdog timeouts.
+
+Cheaper companions with stdlib children: the launcher's verdict
+consumption (eviction blocklist, fleet-state clearing), repeated
+eviction escalating to poison 86, and the preemption drain's hard
+deadline (a hung checkpoint writer exits respawnable 85 instead of
+pinning the process until SIGKILL)."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+
+ELASTIC_BLOCK = {"enabled": True, "max_train_batch_size": 16,
+                 "micro_batch_sizes": [2, 4], "min_gpus": 1,
+                 "max_gpus": 8, "version": 0.1}
+
+
+def _launch_main(tmp_path, script_body=None, script_args=(), max_restarts=0,
+                 extra_argv=(), script_path=None, slots=(0,)):
+    from deepspeed_tpu.launcher import launch
+    from deepspeed_tpu.launcher.runner import encode_world_info
+
+    if script_path is None:
+        script_path = tmp_path / "child.py"
+        script_path.write_text(script_body)
+    wi = encode_world_info({socket.gethostname(): list(slots)})
+    argv = ["--world_info", wi, "--node_rank", "0",
+            "--master_addr", "127.0.0.1", "--master_port", "29999",
+            "--max-restarts", str(max_restarts), *extra_argv,
+            str(script_path), *script_args]
+    old_int = signal.getsignal(signal.SIGINT)
+    old_term = signal.getsignal(signal.SIGTERM)
+    try:
+        with pytest.raises(SystemExit) as exc:
+            launch.main(argv)
+        return exc.value.code
+    finally:
+        signal.signal(signal.SIGINT, old_int)
+        signal.signal(signal.SIGTERM, old_term)
+
+
+def _elastic_argv(tmp_path, devices):
+    cfg = tmp_path / "elastic.json"
+    cfg.write_text(json.dumps({"elasticity": ELASTIC_BLOCK}))
+    return ["--elastic-config", str(cfg), "--elastic-devices",
+            str(devices), "--telemetry-dir", str(tmp_path / "tel")]
+
+
+def _launcher_events(tmp_path, event_type=None):
+    path = tmp_path / "tel" / "events-launcher.jsonl"
+    if not path.exists():
+        return []
+    recs = [json.loads(line) for line in path.read_text().splitlines()]
+    if event_type is not None:
+        recs = [r for r in recs if r["type"] == event_type]
+    return recs
+
+
+# ---------------------------------------------------------------------------
+# launcher-level eviction semantics (stdlib children: no jax in the kids)
+# ---------------------------------------------------------------------------
+
+# Two-slot fleet; first life's rank 0 commits an integrity verdict
+# naming rank 1 and exits 87 while rank 1 idles (it will be drained by
+# the resize).  Every life appends its identity to the lives file.
+_EVICT_CHILD = f"""
+import json, os, sys, time
+sys.path.insert(0, {REPO!r})
+out, marker = sys.argv[1], sys.argv[2]
+rec = {{"rank": os.environ["DS_PROCESS_ID"],
+       "nprocs": os.environ["DS_NUM_PROCESSES"],
+       "slot": os.environ["DS_LOCAL_RANK"]}}
+with open(out, "a") as f:
+    f.write(json.dumps(rec) + "\\n")
+lives = 0
+if os.path.exists(marker):
+    lives = len(open(marker).read())
+if rec["rank"] != "0":
+    time.sleep(120)          # drained by the resize SIGTERM
+if lives >= int(sys.argv[3]):
+    sys.exit(0)              # recovered life: clean finish
+with open(marker, "a") as f:
+    f.write("x")
+from deepspeed_tpu.resilience import integrity
+integrity.write_verdict(os.environ["DS_TELEMETRY_DIR"], "sdc_outlier",
+                        (1 + lives) % 2, f"seeded verdict {{lives}}",
+                        rank=0, step=3)
+sys.exit(87)
+"""
+
+
+def test_launcher_eviction_resize_blocklists_suspect_slot(tmp_path,
+                                                          monkeypatch):
+    """Exit 87 with a verdict naming rank 1: the supervisor charges the
+    suspect's device, blocklists its slot, clears the fleet state, and
+    respawns ONLY from the surviving slot — evict → plan → resize in
+    the launcher stream."""
+    monkeypatch.setenv("DS_MONITOR_POLL_SECS", "0.05")
+    monkeypatch.setenv("DS_RESTART_BACKOFF_SECS", "0.05")
+    monkeypatch.setenv("DS_TERM_GRACE_SECS", "2")
+    monkeypatch.delenv("DS_INTEGRITY_MAX_EVICTIONS", raising=False)
+    out, marker = tmp_path / "lives.jsonl", tmp_path / "marker"
+    code = _launch_main(
+        tmp_path, _EVICT_CHILD, slots=(0, 1),
+        script_args=(str(out), str(marker), "1"), max_restarts=2,
+        extra_argv=_elastic_argv(tmp_path, devices=2))
+    assert code == 0
+    lives = [json.loads(line) for line in out.read_text().splitlines()]
+    # first life: ranks 0+1 over 2 procs; recovered life: ONE proc on
+    # the non-evicted slot 0
+    assert sorted((r["rank"], r["nprocs"], r["slot"]) for r in lives) == [
+        ("0", "1", "0"), ("0", "2", "0"), ("1", "2", "1")]
+    phases = [(p["data"]["phase"], p["data"])
+              for p in _launcher_events(tmp_path, "elastic")]
+    assert [p for p, _ in phases] == ["evict", "plan", "resize"]
+    evict = phases[0][1]
+    assert evict["suspect"] == 1 and evict["slot"] == 1
+    assert evict["kind"] == "sdc_outlier" and evict["eviction"] == 1
+    assert phases[1][1]["trigger"].startswith("integrity eviction")
+    assert phases[2][1]["evicted_slots"] == [1]
+    assert phases[2][1]["world_size"] == 1
+    # the consumed verdict and fleet state were cleared for the new
+    # life — but the verdict was RENAMED to the consumed marker, not
+    # deleted: a sibling node's launcher sharing the run dir still
+    # needs to read it to aim its own resize (each launcher dedups by
+    # the verdict ts, so the lingering marker is inert here)
+    assert not (tmp_path / "tel" / "integrity-verdict.json").exists()
+    from deepspeed_tpu.resilience import integrity
+    marker_file = tmp_path / "tel" / integrity.VERDICT_CONSUMED_FILE
+    assert marker_file.exists()
+    sibling_view = integrity.read_verdict(str(tmp_path / "tel"),
+                                          include_consumed=True)
+    assert sibling_view is not None and sibling_view["suspect"] == 1
+
+
+def test_launcher_repeated_eviction_poisons(tmp_path, monkeypatch):
+    """A second integrity verdict after an eviction already resized
+    around a suspect is unrecoverable: the launcher escalates to poison
+    86 and never respawns, restart budget notwithstanding."""
+    from deepspeed_tpu.resilience import EXIT_DIVERGENCE_ABORT
+
+    monkeypatch.setenv("DS_MONITOR_POLL_SECS", "0.05")
+    monkeypatch.setenv("DS_RESTART_BACKOFF_SECS", "0.05")
+    monkeypatch.setenv("DS_TERM_GRACE_SECS", "2")
+    monkeypatch.delenv("DS_INTEGRITY_MAX_EVICTIONS", raising=False)
+    out, marker = tmp_path / "lives.jsonl", tmp_path / "marker"
+    code = _launch_main(
+        tmp_path, _EVICT_CHILD, slots=(0, 1),
+        script_args=(str(out), str(marker), "99"), max_restarts=3,
+        extra_argv=_elastic_argv(tmp_path, devices=2))
+    assert code == EXIT_DIVERGENCE_ABORT
+    lives = [json.loads(line) for line in out.read_text().splitlines()]
+    assert len(lives) == 3          # 2 first-life ranks + ONE resized life
+    phases = [p["data"]["phase"]
+              for p in _launcher_events(tmp_path, "elastic")]
+    # second evict is recorded, then the run poisons: no second resize
+    assert phases == ["evict", "plan", "resize", "evict"]
+
+
+# First life publishes its heartbeat then crashes with an ordinary
+# (non-87) code; second life proves the launcher cleared ITS stale beat
+# (the quorum would otherwise falsely convict the new life) while the
+# pre-seeded peer's beat survived the targeted clear.
+_ORDINARY_RESPAWN_CHILD = f"""
+import os, sys
+sys.path.insert(0, {REPO!r})
+from deepspeed_tpu.resilience import integrity
+tel = os.environ["DS_TELEMETRY_DIR"]
+marker = sys.argv[1]
+if os.path.exists(marker):
+    mine = os.path.join(tel, integrity.heartbeat_filename(0))
+    peer = os.path.join(tel, integrity.heartbeat_filename(1))
+    sys.exit(0 if (not os.path.exists(mine) and os.path.exists(peer))
+             else 3)
+open(marker, "w").write("x")
+# the first life publishes its own beat AND simulates a healthy peer's
+# (published here, AFTER the launcher's startup clear, so it must
+# survive the targeted respawn clear)
+integrity.publish_rank_heartbeat(tel, 0, 5)
+integrity.publish_rank_heartbeat(tel, 1, 5)
+sys.exit(1)
+"""
+
+
+def test_launcher_ordinary_respawn_clears_own_heartbeat(tmp_path,
+                                                        monkeypatch):
+    """A rank respawned after an ORDINARY crash (exit 1, no verdict)
+    must not leave its previous life's heartbeat behind — through the
+    backoff + re-init window that stale beat reads as "step lags the
+    head, beat stale" and the hang quorum would falsely evict the new
+    life.  The clear is targeted: peers' state survives."""
+    from deepspeed_tpu.resilience import integrity
+
+    monkeypatch.setenv("DS_MONITOR_POLL_SECS", "0.05")
+    monkeypatch.setenv("DS_RESTART_BACKOFF_SECS", "0.05")
+    tel = tmp_path / "tel"
+    tel.mkdir()
+    # debris from a PREVIOUS run: the launcher's startup clear must
+    # scrub it before the first spawn (a stale verdict consumed at this
+    # run's first death would blocklist an innocent slot)
+    integrity.publish_rank_heartbeat(str(tel), 7, 99)
+    integrity.write_verdict(str(tel), integrity.KIND_SDC, 7, "old run")
+    marker = tmp_path / "marker"
+    code = _launch_main(
+        tmp_path, _ORDINARY_RESPAWN_CHILD, script_args=(str(marker),),
+        max_restarts=1, extra_argv=("--telemetry-dir", str(tel)))
+    assert code == 0
+    assert integrity.read_verdict(str(tel)) is None   # startup-cleared
+
+
+# ---------------------------------------------------------------------------
+# preemption drain hard deadline (satellite: hung writer exits respawnable)
+# ---------------------------------------------------------------------------
+
+_HUNG_WRITER_CHILD = f"""
+import os, signal, sys, time
+sys.path.insert(0, {REPO!r})
+marker = sys.argv[1]
+if os.path.exists(marker):
+    sys.exit(0)              # respawned life: the recovery worked
+open(marker, "w").write("x")
+from deepspeed_tpu.checkpoint.manager import CheckpointManager
+mgr = CheckpointManager()
+mgr.install_preemption_handler(lambda: time.sleep(600))  # stuck storage
+signal.raise_signal(signal.SIGTERM)                      # preemption notice
+time.sleep(600)
+"""
+
+
+def test_preemption_drain_hard_deadline_exits_respawnable(tmp_path):
+    """A checkpoint writer that hangs during the SIGTERM grace-window
+    save must NOT pin the process until the launcher's SIGKILL: the
+    drain watchdog exits 85 (respawnable) at the hard deadline."""
+    from deepspeed_tpu.resilience import EXIT_STEP_HANG
+
+    script = tmp_path / "hung.py"
+    script.write_text(_HUNG_WRITER_CHILD)
+    env = dict(os.environ, DS_TERM_GRACE_SECS="30",
+               DS_TERM_DRAIN_DEADLINE_SECS="0.5")
+    t0 = time.monotonic()
+    proc = subprocess.run([sys.executable, str(script),
+                           str(tmp_path / "marker")],
+                          env=env, capture_output=True, text=True,
+                          timeout=60)
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == EXIT_STEP_HANG, proc.stderr[-2000:]
+    assert elapsed < 20, f"drain deadline did not bound the hang: " \
+                         f"{elapsed:.1f}s"
+    assert "hard deadline" in proc.stdout + proc.stderr
+
+
+def test_preemption_drain_deadline_respawns_under_launcher(tmp_path,
+                                                           monkeypatch):
+    """The full loop with the launcher supervising: hung-writer life
+    exits 85, the supervisor respawns, the second life finishes clean —
+    lost capacity, not a lost run."""
+    monkeypatch.setenv("DS_MONITOR_POLL_SECS", "0.05")
+    monkeypatch.setenv("DS_RESTART_BACKOFF_SECS", "0.05")
+    monkeypatch.setenv("DS_TERM_GRACE_SECS", "30")
+    monkeypatch.setenv("DS_TERM_DRAIN_DEADLINE_SECS", "0.5")
+    t0 = time.monotonic()
+    code = _launch_main(
+        tmp_path, _HUNG_WRITER_CHILD,
+        script_args=(str(tmp_path / "marker"),), max_restarts=1,
+        extra_argv=["--telemetry-dir", str(tmp_path / "tel")])
+    assert code == 0
+    assert time.monotonic() - t0 < 30   # never served the full grace
+    (exit_rec,) = [r for r in _launcher_events(tmp_path, "proc_exit")
+                   if r["data"]["code"] != 0]
+    assert exit_rec["data"]["code"] == 85
+
+
+# ---------------------------------------------------------------------------
+# THE chaos e2e pair: real launcher, real training fleet, virtual CPU
+# ---------------------------------------------------------------------------
+
+SCRIPT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "integrity_train_script.py")
+TOTAL_STEPS = 10
+_CHAOS_ENV = ("DS_CHAOS_BITFLIP_STEP", "DS_CHAOS_HANG_STEP",
+              "DS_CHAOS_TARGET_RANK", "DS_CHAOS_SEED",
+              "DS_INTEGRITY_PEER_TIMEOUT", "DS_WATCHDOG_SECS",
+              "DS_STEP_SLEEP_SECS")
+
+
+@pytest.fixture(scope="module")
+def reference_run(tmp_path_factory):
+    """The uninterrupted single-replica run on the same seeded batch
+    stream: per-step losses + the final record (each fleet rank is a
+    full replica, so ONE reference serves both chaos legs)."""
+    base = tmp_path_factory.mktemp("integrity-ref")
+    env = {k: v for k, v in os.environ.items() if k not in _CHAOS_ENV}
+    env["DS_TELEMETRY_DIR"] = str(base / "tel")
+    env["DS_PROCESS_ID"] = "0"
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, str(base / "ckpt"), str(base / "out")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=280)
+    assert proc.returncode == 0, (
+        f"reference run failed\nstdout:\n{proc.stdout[-3000:]}\n"
+        f"stderr:\n{proc.stderr[-3000:]}")
+    losses = {}
+    for name in os.listdir(base / "out"):
+        if name.startswith("steps-"):
+            for line in open(base / "out" / name):
+                rec = json.loads(line)
+                losses[rec["step"]] = rec["loss"]
+    final = json.load(open(base / "out" / "final.json"))
+    assert final["steps"] == TOTAL_STEPS and sorted(losses) == list(
+        range(1, TOTAL_STEPS + 1))
+    return {"losses": losses, "final": final}
+
+
+def _rank0_steps(out_dir):
+    """{step: loss} across every life of fleet rank 0 — asserting no
+    step was ever trained twice (replay) on the logging rank."""
+    steps = {}
+    for name in sorted(os.listdir(out_dir)):
+        if not name.startswith("steps-rank0-"):
+            continue
+        for line in open(os.path.join(out_dir, name)):
+            rec = json.loads(line)
+            assert rec["step"] not in steps, f"step {rec['step']} replayed"
+            steps[rec["step"]] = rec["loss"]
+    return steps
+
+
+def _chaos_env(monkeypatch, **extra):
+    monkeypatch.setenv("DS_MONITOR_POLL_SECS", "0.05")
+    monkeypatch.setenv("DS_RESTART_BACKOFF_SECS", "0.05")
+    monkeypatch.setenv("DS_TERM_GRACE_SECS", "3")
+    monkeypatch.setenv("DS_ELASTIC_DEVICES_PER_FAILURE", "1")
+    monkeypatch.delenv("DS_INTEGRITY_MAX_EVICTIONS", raising=False)
+    for k in _CHAOS_ENV:
+        monkeypatch.delenv(k, raising=False)
+    for k, v in extra.items():
+        monkeypatch.setenv(k, str(v))
+
+
+def _merged_events(run_dir, event_type):
+    from deepspeed_tpu.telemetry import read_events
+
+    return [r for r in read_events(str(run_dir)) if r["type"] == event_type]
+
+
+def test_chaos_bitflip_evict_resize_parity_end_to_end(tmp_path,
+                                                      monkeypatch,
+                                                      reference_run):
+    """Seeded SDC on rank 2 of a 4-replica fleet: the fingerprint
+    consensus names rank 2, the fleet exits 87, the supervisor evicts
+    slot 2 and resizes 4 -> 2, every surviving rank rolls back to the
+    latest committed checkpoint, and the re-trained steps match the
+    uninterrupted reference to rtol 1e-3."""
+    from deepspeed_tpu.resilience.chaos import ChaosMonkey
+
+    # seeded flip step in [3, 5]: committed checkpoints exist, several
+    # steps remain after the resize
+    flip_step = 3 + ChaosMonkey(seed=13).schedule_steps(3, 1)[0]
+    _chaos_env(monkeypatch, DS_CHAOS_BITFLIP_STEP=flip_step,
+               DS_CHAOS_TARGET_RANK=2, DS_CHAOS_SEED=13,
+               DS_STEP_SLEEP_SECS=0.1)
+
+    code = _launch_main(
+        tmp_path, script_path=SCRIPT, slots=(0, 1, 2, 3),
+        script_args=(str(tmp_path / "ckpt"), str(tmp_path / "out")),
+        max_restarts=2,
+        extra_argv=_elastic_argv(tmp_path, devices=4) + [
+            "--compile-cache-dir", str(tmp_path / "xla-cache")])
+    assert code == 0
+
+    # the fleet finished all 10 steps after the eviction resize
+    final = json.load(open(tmp_path / "out" / "final.json"))
+    assert final["steps"] == TOTAL_STEPS
+
+    # the launcher stream shows ONE aimed resize: evict names rank 2 /
+    # slot 2, the respawn excludes it
+    phases = [(p["data"]["phase"], p["data"])
+              for p in _launcher_events(tmp_path, "elastic")]
+    assert [p for p, _ in phases] == ["evict", "plan", "resize"]
+    evict = phases[0][1]
+    assert evict["suspect"] == 2 and evict["slot"] == 2
+    assert evict["kind"] == "sdc_outlier"
+    assert phases[2][1]["evicted_slots"] == [2]
+    assert phases[2][1]["world_size"] == 2
+
+    # the engines' merged stream carries the outlier verdict naming 2
+    outliers = [r for r in _merged_events(tmp_path / "tel", "integrity")
+                if r["data"]["verdict"] == "outlier"]
+    assert outliers and all(r["data"]["suspects"] == [2]
+                            for r in outliers)
+    assert all(r["data"]["kind"] == "fingerprint" for r in outliers)
+
+    # loss continuity: every step rank 0 trained (across both lives, no
+    # replay) matches the uninterrupted reference; the flip itself must
+    # never leak into the surviving timeline
+    steps = _rank0_steps(tmp_path / "out")
+    assert TOTAL_STEPS in steps and flip_step in steps
+    for s, loss in steps.items():
+        np.testing.assert_allclose(
+            loss, reference_run["losses"][s], rtol=1e-3,
+            err_msg=f"loss diverged from the uninterrupted reference at "
+                    f"step {s} (bitflip was at {flip_step})")
+    np.testing.assert_allclose(final["final_loss"],
+                               reference_run["final"]["final_loss"],
+                               rtol=1e-3)
+
+
+def test_chaos_hang_quorum_one_resize_end_to_end(tmp_path, monkeypatch,
+                                                 reference_run):
+    """Rank 2 wedges before step 2; the healthy majority's hang quorum
+    convicts it and exits 87 — the launcher completes ONE eviction
+    resize and the run finishes well inside the local watchdog timeout
+    (which is armed 300s loose to prove the quorum, not N watchdogs,
+    recovered the fleet)."""
+    _chaos_env(monkeypatch, DS_CHAOS_HANG_STEP=2, DS_CHAOS_TARGET_RANK=2,
+               DS_INTEGRITY_PEER_TIMEOUT=1.2, DS_WATCHDOG_SECS=300,
+               DS_STEP_SLEEP_SECS=0.35)
+
+    t0 = time.monotonic()
+    code = _launch_main(
+        tmp_path, script_path=SCRIPT, slots=(0, 1, 2, 3),
+        script_args=(str(tmp_path / "ckpt"), str(tmp_path / "out")),
+        max_restarts=2,
+        extra_argv=_elastic_argv(tmp_path, devices=4) + [
+            "--compile-cache-dir", str(tmp_path / "xla-cache")])
+    elapsed = time.monotonic() - t0
+    assert code == 0
+    # the wall-clock bound IS the claim: one quorum eviction, not N
+    # independent 300s watchdog timeouts (and not even one of them)
+    assert elapsed < 240, f"hang recovery took {elapsed:.0f}s"
+
+    final = json.load(open(tmp_path / "out" / "final.json"))
+    assert final["steps"] == TOTAL_STEPS
+
+    phases = [(p["data"]["phase"], p["data"])
+              for p in _launcher_events(tmp_path, "elastic")]
+    assert [p for p, _ in phases] == ["evict", "plan", "resize"]
+    evict = phases[0][1]
+    assert evict["suspect"] == 2 and evict["slot"] == 2
+    assert evict["kind"] == "hang_quorum"
+    assert phases[2][1]["evicted_slots"] == [2]
+
+    # at least one healthy rank exited with the eviction code (87) —
+    # the detecting accusers, not the victim
+    exit_codes = [r["data"]["code"]
+                  for r in _launcher_events(tmp_path, "proc_exit")]
+    assert 87 in exit_codes
+
+    # the hang-quorum verdict rode the engines' telemetry before the
+    # os._exit (flush-on-fire)
+    hangs = [r for r in _merged_events(tmp_path / "tel", "integrity")
+             if r["data"]["kind"] == "hang_quorum"]
+    assert hangs and all(r["data"]["suspects"] == [2] for r in hangs)
+
+    # rollback correctness: the surviving timeline matches the
+    # uninterrupted reference
+    steps = _rank0_steps(tmp_path / "out")
+    for s, loss in steps.items():
+        np.testing.assert_allclose(loss, reference_run["losses"][s],
+                                   rtol=1e-3)

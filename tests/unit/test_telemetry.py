@@ -134,6 +134,7 @@ def _sample_data(event_type):
                    "unexplained": 0.13},
         "predicted_step_seconds": 0.37, "measured_step_seconds": 0.5,
         "step_unexplained_fraction": 0.26,
+        "verdict": "outlier", "suspects": [2],
     }
     return {k: samples[k] for k in EVENT_TYPES[event_type]}
 
@@ -367,6 +368,29 @@ def test_engine_zero_added_host_syncs(cpu_devices, tmp_path, monkeypatch):
                    "comm_ledger": True}))
     assert comm == base, (f"comm observability added host syncs: {comm} "
                           f"device_get calls vs {base} baseline")
+    # fleet integrity plane on top (PR 15): the in-jit state fingerprint
+    # is a dispatched device scalar that joins the SAME batched
+    # steps_per_print transfer, and the consensus vote is host
+    # arithmetic + run-dir file I/O — still ZERO added device_get calls
+    # with the plane armed
+    # fleet identity >= 2 so the consensus arms (a single process can
+    # never reach quorum; the engine refuses the wasted checksum)
+    monkeypatch.setenv("DS_NUM_PROCESSES", "2")
+    integ = count_gets(tel_config(
+        tmp_path / "i", trace=True,
+        resilience=dict(resilience, integrity=True)))
+    assert integ == base, (f"integrity plane added host syncs: {integ} "
+                           f"device_get calls vs {base} baseline")
+    # ...and the plane really voted inside the counted window: one
+    # fingerprint-kind EVENT_INTEGRITY per print, with this rank's
+    # canonical fingerprint attached
+    integ_events = [r for r in read_events(tmp_path / "i")
+                    if r["type"] == "integrity"]
+    assert integ_events, "no integrity events at the print cadence"
+    for rec in integ_events:
+        assert validate_event(rec) == []
+        assert rec["data"]["kind"] == "fingerprint"
+        assert rec["data"]["fingerprint"]
 
     # program verification on top (DSP6xx + the DSO7xx overlap
     # analysis, profiling/verify + profiling/overlap): the artifact
