@@ -1,0 +1,19 @@
+"""Serving subsystem: paged-KV-cache inference with continuous batching,
+priced and verified by the training-side toolchain (memory/comm ledgers,
+program dumper, DSP6xx verifier, attribution doctor, EVENT telemetry).
+"""
+
+from .config import DeepSpeedInferenceConfig
+from .engine import DECODE_PROGRAM, InferenceEngine, prefill_program_name
+from .kv_cache import (NULL_BLOCK, BlockAllocator, init_kv_cache,
+                       kv_cache_bytes)
+from .model import build_decode, build_prefill, reference_generate
+from .scheduler import (ContinuousBatchScheduler, Request, REASON_EOS,
+                        REASON_LENGTH)
+
+__all__ = ["DeepSpeedInferenceConfig", "DECODE_PROGRAM", "InferenceEngine",
+           "prefill_program_name", "NULL_BLOCK", "BlockAllocator",
+           "init_kv_cache", "kv_cache_bytes", "build_decode",
+           "build_prefill", "reference_generate",
+           "ContinuousBatchScheduler", "Request", "REASON_EOS",
+           "REASON_LENGTH"]

@@ -1,0 +1,436 @@
+"""InferenceEngine: continuous-batching serving over a paged KV cache,
+priced and verified by the training-side toolchain.
+
+Program split (all shapes static, all programs ledgered):
+
+- ``serve_prefill_<bucket>`` — one per declared prefill bucket, compiled
+  on first use; cache buffers donated.
+- ``serve_decode`` — ONE fixed-width program for the whole serve; cache
+  buffers donated, so the per-token K/V append is an in-place
+  ``dynamic_update_slice`` that XLA aliases onto the input allocation
+  (``engine.verify_programs()`` proves the ``input_output_alias``
+  materialized — DSP601; a silently-copied cache is the classic decode
+  perf bug).
+
+Observability rides the training machinery unchanged: the
+MemoryLedger/CommLedger AOT hook records every serve program's memory
+analysis + HLO walk at compile time, the ProgramDumper lands
+``<run_dir>/programs/serve_*.{hlo,json}`` sidecars for the offline
+verifier, decode iterations feed a StepLatencyRing for the attribution
+doctor, and EVENT-stream telemetry narrates admissions / finishes /
+queue depth.  The ONLY per-iteration host sync is the next-token fetch
+the serve loop needs anyway — telemetry adds zero (the device_get-
+counting test pins this).
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.gpt2 import GPT2LMHeadTPU
+from ..module_inject.replace_module import cast_weights
+from ..profiling.comm import CommLedger, SERVE_DECODE_PROGRAM
+from ..profiling.memory import MemoryLedger
+from ..profiling.step_profiler import StepLatencyRing
+from ..runtime import constants as C
+from ..telemetry import events as TEL
+from ..telemetry.config import DeepSpeedTelemetryConfig
+from ..telemetry.manager import TelemetryManager
+from ..utils.logging import logger
+from .config import DeepSpeedInferenceConfig
+from .kv_cache import BlockAllocator, init_kv_cache
+from .model import build_decode, build_prefill
+from .scheduler import ContinuousBatchScheduler, Request
+
+# one string shared with the step pricer (profiling/comm.py), so the
+# live receipts and the offline doctor name the same step program
+DECODE_PROGRAM = SERVE_DECODE_PROGRAM
+
+
+def prefill_program_name(bucket):
+    return f"serve_prefill_{int(bucket)}"
+
+
+class InferenceEngine:
+    """Serve a GPT-2 family model with continuous batching.
+
+    ``model`` is a :class:`~deepspeed_tpu.models.gpt2.GPT2LMHeadTPU`
+    (or anything exposing ``.config`` with the same geometry fields);
+    ``params`` its parameter pytree (use
+    :func:`~deepspeed_tpu.module_inject.ingest_gpt2_model` to convert an
+    HF Flax checkpoint).  ``config`` is the usual DeepSpeed config dict;
+    the ``inference`` block is DSC4xx-schema-validated like every other
+    section.
+    """
+
+    def __init__(self, model, params, config=None):
+        param_dict = dict(config or {})
+        self._validate_config(param_dict)
+        self.inference_config = DeepSpeedInferenceConfig(param_dict)
+        icfg = self.inference_config
+        self.model = model
+        mc = model.config
+        assert mc.max_position_embeddings >= icfg.max_seq_len, (
+            f"inference.max_seq_len ({icfg.max_seq_len}) exceeds the "
+            f"model's max_position_embeddings "
+            f"({mc.max_position_embeddings})")
+        self.steps_per_print = int(param_dict.get(
+            C.STEPS_PER_PRINT, C.STEPS_PER_PRINT_DEFAULT))
+        if icfg.weights_dtype == "bfloat16":
+            params = cast_weights(params, jnp.bfloat16)
+        self.params = jax.device_put(params)
+        cache_dtype = (jnp.bfloat16 if icfg.weights_dtype == "bfloat16"
+                       else jnp.float32)
+        self._k_cache, self._v_cache = init_kv_cache(
+            mc.num_layers, icfg.kv_blocks, icfg.kv_block_size,
+            mc.num_heads, mc.hidden_size // mc.num_heads,
+            dtype=cache_dtype)
+        self.allocator = BlockAllocator(icfg.kv_blocks)
+        self.scheduler = ContinuousBatchScheduler(icfg, self.allocator)
+
+        # -- telemetry + ledgers (the training engine's wiring, reused) --
+        self.telemetry_config = DeepSpeedTelemetryConfig(param_dict)
+        self.telemetry = TelemetryManager(self.telemetry_config,
+                                          rank=jax.process_index())
+        from ..profiling.config import DeepSpeedProfilingConfig
+
+        profiling_config = DeepSpeedProfilingConfig(param_dict)
+        tel_on = self.telemetry.enabled
+        comm_on = profiling_config.comm_ledger_enabled(tel_on)
+        mem_on = profiling_config.memory_ledger_enabled(tel_on)
+        self.comm_ledger = CommLedger(
+            enabled=comm_on, telemetry=self.telemetry,
+            mesh_axes={"data": 1})
+        self.comm_ledger.overlap_context_fn = self.program_verify_context
+        dump_on = profiling_config.program_dump_enabled(comm_on)
+        self.memory_ledger = MemoryLedger(
+            enabled=mem_on or comm_on or dump_on,
+            telemetry=self.telemetry, comm_ledger=self.comm_ledger,
+            record_memory=mem_on)
+        if dump_on and self.telemetry.run_dir:
+            from ..profiling.verify import ProgramDumper
+
+            self.memory_ledger.dumper = ProgramDumper(
+                self.telemetry.run_dir, rank=jax.process_index(),
+                context_fn=self.program_verify_context,
+                donation_fn=lambda name: self._donation_specs.get(name))
+
+        # -- compiled programs (cache args 1/2 donated everywhere) -------
+        self._donation_specs = {DECODE_PROGRAM: (1, 2)}
+        self._decode = self.memory_ledger.wrap(
+            DECODE_PROGRAM,
+            jax.jit(build_decode(mc, icfg), donate_argnums=(1, 2)))
+        self._prefills = {}
+        for bucket in icfg.prefill_buckets:
+            name = prefill_program_name(bucket)
+            self._donation_specs[name] = (1, 2)
+            self._prefills[bucket] = self.memory_ledger.wrap(
+                name, jax.jit(build_prefill(mc, icfg, bucket),
+                              donate_argnums=(1, 2)))
+
+        self._step_latencies = StepLatencyRing()
+        self._driver_latencies = StepLatencyRing()
+        self.decode_iterations = 0
+        self.generated_tokens = 0
+        self._results = {}
+        self._next_request_id = 0
+        if self.telemetry.enabled:
+            self.telemetry.emit(TEL.EVENT_RUN_START, world_size=1,
+                                mode="serving", **{
+                                    "max_batch_slots": icfg.max_batch_slots,
+                                    "kv_blocks": icfg.kv_blocks,
+                                    "prefill_buckets": list(
+                                        icfg.prefill_buckets)})
+        logger.info(
+            "InferenceEngine: %d layers, %d slots, %d KV blocks x %d "
+            "tokens, prefill buckets %s, weights %s",
+            mc.num_layers, icfg.max_batch_slots, icfg.kv_blocks,
+            icfg.kv_block_size, list(icfg.prefill_buckets),
+            icfg.weights_dtype)
+
+    @staticmethod
+    def _validate_config(param_dict):
+        from ..tools.dslint.schema import validate_config_dict
+
+        strict = bool(param_dict.get(C.STRICT_CONFIG,
+                                     C.STRICT_CONFIG_DEFAULT))
+        issues = validate_config_dict(param_dict)
+        for issue in issues:
+            logger.warning(f"InferenceEngine config: {issue.message}")
+        if strict and issues:
+            raise ValueError(
+                "strict_config: rejected unknown configuration keys: "
+                + "; ".join(i.message for i in issues))
+
+    @classmethod
+    def from_hf_gpt2(cls, hf_params, model_config, config=None):
+        """Serve an HF Flax GPT-2 checkpoint: weight surgery through
+        ``module_inject`` (fused-layer injection + embedding remap),
+        then the standard constructor (which applies the configured
+        serve dtype)."""
+        from ..module_inject import ingest_gpt2_model
+
+        params = ingest_gpt2_model(hf_params)
+        model = GPT2LMHeadTPU(model_config)
+        return cls(model, params, config=config)
+
+    # ------------------------------------------------------------------
+    # request front-end
+    # ------------------------------------------------------------------
+    def submit(self, prompt, max_new_tokens=None, request_id=None):
+        """Queue one generation request; returns its id.  Rejects (by
+        raising) prompts longer than the largest prefill bucket and
+        requests whose worst case exceeds ``max_seq_len`` — at
+        SUBMISSION, never mid-serve."""
+        if request_id is None:
+            request_id = f"req-{self._next_request_id}"
+            self._next_request_id += 1
+        request = Request(
+            request_id, prompt,
+            max_new_tokens if max_new_tokens is not None
+            else self.inference_config.max_new_tokens)
+        self.scheduler.submit(request)
+        self._results[request_id] = request
+        return request_id
+
+    # ------------------------------------------------------------------
+    # the serve loop
+    # ------------------------------------------------------------------
+    def _run_prefill(self, request):
+        icfg = self.inference_config
+        sched = self.scheduler
+        ids = np.zeros((1, request.bucket), np.int32)
+        ids[0, :len(request.prompt)] = request.prompt
+        table = np.asarray(sched.block_table_row(request), np.int32)
+        first, self._k_cache, self._v_cache = self._prefills[
+            request.bucket](self.params, self._k_cache, self._v_cache,
+                            jnp.asarray(ids),
+                            jnp.int32(len(request.prompt)), table)
+        token = int(jax.device_get(first))
+        now = time.monotonic()
+        request.first_token_at = now
+        request.step_times.append(now - request.submitted)
+        request.generated.append(token)
+        self.generated_tokens += 1
+        if self.telemetry.enabled:
+            self.telemetry.emit(
+                TEL.EVENT_SERVING, step=self.decode_iterations,
+                kind="admit", request=request.request_id,
+                prompt_tokens=len(request.prompt), bucket=request.bucket,
+                blocks=len(request.blocks), slot=request.slot,
+                queue_depth=sched.queue_depth)
+            self.telemetry.counter("serving/admitted").inc()
+
+    def _emit_finish(self, request):
+        if not self.telemetry.enabled:
+            return
+        self.telemetry.emit(
+            TEL.EVENT_SERVING, step=self.decode_iterations, kind="finish",
+            request=request.request_id, reason=request.finish_reason,
+            generated_tokens=len(request.generated),
+            queue_depth=self.scheduler.queue_depth)
+        self.telemetry.counter("serving/finished").inc()
+
+    def _decode_once(self):
+        """One continuous-batch decode iteration over the active slots.
+        The single ``device_get`` here is the serve loop's OWN next-token
+        fetch — the baseline the zero-added-syncs test measures against."""
+        icfg = self.inference_config
+        sched = self.scheduler
+        t_prep = time.monotonic()
+        width = icfg.max_blocks_per_seq
+        tables = np.zeros((icfg.max_batch_slots, width), np.int32)
+        ctx_lens = np.zeros((icfg.max_batch_slots,), np.int32)
+        tokens = np.zeros((icfg.max_batch_slots,), np.int32)
+        before = []
+        for request in sched.slots:
+            if request is None:
+                continue
+            tables[request.slot] = sched.block_table_row(request)
+            # position of the token being decoded = current context - 1
+            # (the last generated token is the decode input)
+            ctx_lens[request.slot] = request.context_len - 1
+            tokens[request.slot] = request.generated[-1]
+            before.append(request)
+        t0 = time.monotonic()
+        self._driver_latencies.record(t0 - t_prep)
+        next_dev, self._k_cache, self._v_cache = self._decode(
+            self.params, self._k_cache, self._v_cache, tables, ctx_lens,
+            tokens)
+        next_tokens = jax.device_get(next_dev)
+        now = time.monotonic()
+        self._step_latencies.record(now - t0)
+        self.decode_iterations += 1
+        for request in before:
+            request.generated.append(int(next_tokens[request.slot]))
+            request.step_times.append(now - t0)
+            self.generated_tokens += 1
+
+    def _sample_telemetry(self):
+        """Print-cadence sampling: queue/occupancy gauges, one
+        EVENT_SERVING queue record, and the attribution gauges — all
+        host arithmetic on already-fetched scalars, zero device syncs."""
+        if not self.telemetry.enabled:
+            return
+        sched = self.scheduler
+        self.telemetry.gauge("serving/queue_depth").set(
+            float(sched.queue_depth))
+        self.telemetry.gauge("serving/active_slots").set(
+            float(sched.active_count))
+        self.telemetry.gauge("serving/free_blocks").set(
+            float(self.allocator.free_blocks))
+        self.telemetry.gauge("serving/generated_tokens").set(
+            float(self.generated_tokens))
+        self.telemetry.emit(
+            TEL.EVENT_SERVING, step=self.decode_iterations, kind="queue",
+            queue_depth=sched.queue_depth, active=sched.active_count,
+            free_blocks=self.allocator.free_blocks,
+            reserved_tokens=sched.reserved_tokens())
+        # the same comm/latency snapshot the training engine publishes:
+        # it is the measured side the offline doctor reconciles against
+        snap = self._step_latencies.latency_snapshot()
+        if snap["n"]:
+            from ..profiling import comm as comm_prof
+
+            for key in ("last", "mean", "p50", "p95", "max"):
+                self.telemetry.gauge(
+                    f"comm/latency/{key}_secs").set(snap[key])
+            self.telemetry.emit(TEL.EVENT_COMM, step=self.decode_iterations,
+                                kind=comm_prof.KIND_LATENCY, **snap)
+        receipt = self.attribution_receipt()
+        if receipt is not None:
+            self.telemetry.gauge(
+                "serving/attribution/predicted_step_seconds").set(
+                    float(receipt["predicted_step_seconds"]))
+            if receipt["measured_step_seconds"] is not None:
+                self.telemetry.emit(TEL.EVENT_ATTRIBUTION,
+                                    step=self.decode_iterations, **receipt)
+
+    def step(self):
+        """One engine iteration: recycle finished slots, admit from the
+        queue (each admission prefills immediately), then advance every
+        active slot one token.  Returns the requests finished DURING
+        this iteration."""
+        sched = self.scheduler
+        finished = sched.sweep_finished(self.inference_config.eos_token_id)
+        for request in finished:
+            self._emit_finish(request)
+        while True:
+            request = sched.try_admit()
+            if request is None:
+                break
+            self._run_prefill(request)
+        if sched.active_count:
+            self._decode_once()
+        if (self.decode_iterations
+                and self.decode_iterations % self.steps_per_print == 0):
+            self._sample_telemetry()
+        return finished
+
+    def run(self):
+        """Drain the queue: iterate until every submitted request has
+        finished; returns ``{request_id: result dict}`` (tokens, finish
+        reason, TTFT, per-token p50/p99)."""
+        while not self.scheduler.idle():
+            self.step()
+        # final sweep: the last decode's tokens may have finished slots
+        for request in self.scheduler.sweep_finished(
+                self.inference_config.eos_token_id):
+            self._emit_finish(request)
+        self._sample_telemetry()
+        return {rid: r.result() for rid, r in self._results.items()}
+
+    # ------------------------------------------------------------------
+    # receipts (the training engine's surface, serving programs)
+    # ------------------------------------------------------------------
+    def serving_receipt(self):
+        """Aggregate serve metrics over every finished request —
+        the record ``examples/bench_serving.py`` registers under
+        ``bench_schema``."""
+        finished = [r for r in self._results.values()
+                    if r.state == "finished"]
+        lats = sorted(t for r in finished for t in r.step_times)
+        ttfts = sorted(r.first_token_at - r.submitted for r in finished
+                       if r.first_token_at is not None)
+
+        def pct(vals, p):
+            if not vals:
+                return None
+            return float(vals[min(len(vals) - 1, int(p * len(vals)))])
+
+        wall = None
+        if finished:
+            start = min(r.submitted for r in finished)
+            end = max(r.finished_at for r in finished)
+            wall = max(end - start, 1e-9)
+        return {
+            "requests": len(finished),
+            "generated_tokens": self.generated_tokens,
+            "decode_iterations": self.decode_iterations,
+            "per_token_p50_seconds": pct(lats, 0.50),
+            "per_token_p99_seconds": pct(lats, 0.99),
+            "ttft_p50_seconds": pct(ttfts, 0.50),
+            "tokens_per_second_per_chip": (
+                self.generated_tokens / wall if wall else None),
+            "programs_compiled": len(self.memory_ledger.entries()),
+        }
+
+    def comm_receipt(self):
+        """Collective receipt for ONE decode iteration (count/payload/
+        wire from the compile-time HLO walk); None until decode has
+        compiled or with the ledger off."""
+        return self.comm_ledger.step_entry(1, prefer=DECODE_PROGRAM)
+
+    def overlap_receipt(self):
+        """Static exposed-wire verdict for the decode program; None
+        until it has an overlap summary."""
+        return self.comm_ledger.step_overlap(1, prefer=DECODE_PROGRAM)
+
+    def attribution_receipt(self):
+        """Reconciled per-decode-iteration budget (compute / exposed
+        wire / host driver vs the measured p50) — the serving phase
+        table ``python -m deepspeed_tpu.profiling.doctor`` renders."""
+        from ..profiling import attribution as attr_prof
+
+        if not self.comm_ledger.enabled:
+            return None
+        vals = self._driver_latencies.recent()
+        budget = attr_prof.step_budget(
+            self.comm_ledger.overlap_entries(), 1, prefer=DECODE_PROGRAM,
+            driver_seconds=float(min(vals)) if vals else 0.0)
+        if budget is None:
+            return None
+        snap = self._step_latencies.latency_snapshot()
+        return attr_prof.reconcile(budget,
+                                   snap["p50"] if snap["n"] else None)
+
+    def program_verify_context(self):
+        """Mesh/parameter/donation context for the DSP6xx verifier and
+        the ``programs/`` sidecars (single-replica serving: a 1-wide
+        data axis, no master, no declared host stream)."""
+        leaves = jax.tree_util.tree_leaves(self.params)
+        return {
+            "mesh_axes": {"data": 1},
+            "data_axis": "data",
+            "param_bytes": int(sum(
+                np.prod(l.shape) * l.dtype.itemsize for l in leaves)),
+            "master_provenance": None,
+            "host_state_wire_bytes": None,
+            "host_stream_schedule": None,
+            "collective_schedule": None,
+            "device_kind": getattr(jax.devices()[0], "device_kind", ""),
+        }
+
+    def verify_programs(self):
+        """DSP6xx pass over every compiled serve program — the KV-cache
+        donation must materialize as ``input_output_alias`` on the
+        decode program (DSP601) or this returns a violation."""
+        from ..profiling.verify import verify_engine_programs
+
+        return verify_engine_programs(self)
+
+    def close(self):
+        # TelemetryManager.close emits the EVENT_RUN_END itself
+        self.telemetry.close(reason="serve_done")

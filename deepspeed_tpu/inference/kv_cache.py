@@ -1,0 +1,76 @@
+"""Paged KV cache: preallocated device buffers + a host-side block
+allocator (vLLM-style block tables, adapted to XLA static shapes).
+
+The cache is two device arrays of fixed shape
+
+    ``[layers, kv_blocks, kv_block_size, heads, head_dim]``
+
+allocated ONCE at engine construction.  Sequences never own contiguous
+cache memory: each holds a *block table* (host list of block ids) and
+the prefill/decode programs scatter/gather through it.  Both programs
+take the cache arrays as donated arguments and return the updated
+arrays, so XLA aliases the output buffer onto the input allocation —
+an in-place update, verified as a materialized ``input_output_alias``
+by dsverify DSP601 (a silently-copied KV cache is the classic decode
+perf bug this subsystem exists to never ship).
+
+Block 0 is reserved as the *null block*: inactive decode slots point
+their whole table at it and park their write offset there, so the
+fixed-width decode program needs no masking on the write path — dead
+slots harmlessly overwrite scratch.
+"""
+
+import jax.numpy as jnp
+
+# block id every table slot starts at (and dead slots stay at): the
+# reserved scratch block the allocator never hands out
+NULL_BLOCK = 0
+
+
+class BlockAllocator:
+    """Host-side free list over the preallocated KV blocks.
+
+    Pure Python bookkeeping — nothing here touches the device.  The
+    scheduler allocates a sequence's whole worst-case block budget at
+    admission (prompt bucket plus the generation cap), which makes
+    admission the ONLY place an out-of-blocks condition can surface;
+    mid-decode the table is already paid for.
+    """
+
+    def __init__(self, num_blocks):
+        assert num_blocks > 1, "need at least one block beyond the null block"
+        self.num_blocks = int(num_blocks)
+        # LIFO free list, block 0 excluded (the null block)
+        self._free = list(range(self.num_blocks - 1, 0, -1))
+
+    @property
+    def free_blocks(self):
+        return len(self._free)
+
+    def allocate(self, n):
+        """``n`` block ids, or None when the pool cannot cover them (the
+        caller defers admission; never a partial grant)."""
+        if n > len(self._free):
+            return None
+        taken = [self._free.pop() for _ in range(n)]
+        return taken
+
+    def release(self, blocks):
+        for b in blocks:
+            assert b != NULL_BLOCK, "the null block is never released"
+            self._free.append(int(b))
+
+
+def init_kv_cache(num_layers, num_blocks, block_size, heads, head_dim,
+                  dtype=jnp.float32):
+    """The (k, v) cache device buffers, zero-initialized."""
+    shape = (num_layers, num_blocks, block_size, heads, head_dim)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def kv_cache_bytes(num_layers, num_blocks, block_size, heads, head_dim,
+                   dtype=jnp.float32):
+    """Footprint of one engine's K+V buffers (capacity-planning aid)."""
+    itemsize = jnp.dtype(dtype).itemsize
+    return 2 * num_layers * num_blocks * block_size * heads * head_dim \
+        * itemsize

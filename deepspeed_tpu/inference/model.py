@@ -1,0 +1,162 @@
+"""Prefill/decode forwards over a GPT-2 param tree with a paged KV cache.
+
+Two program families, both closed over the static model/cache geometry
+so every shape in the traced graph is fixed:
+
+- ``prefill``: one request, padded to a declared bucket length — full
+  causal self-attention over the padded prompt, per-layer K/V written
+  into the request's cache blocks, next token read at the true last
+  position.  One compiled program per bucket.
+- ``decode``: the fixed-width continuous batch — one token per slot,
+  K/V appended in place through the block table
+  (``lax.dynamic_update_slice`` into the DONATED cache buffers), paged
+  gather of each slot's context, one-position attention.  Exactly one
+  compiled program for the whole serve, regardless of batch occupancy.
+
+The math mirrors :class:`~deepspeed_tpu.models.layers.TransformerLayer`
+(pre-LN path) and :meth:`~deepspeed_tpu.models.gpt2.GPT2LMHeadTPU.hidden`
+operation for operation — fp32 layernorm, fused-QKV dense, fp32-softmax
+attention, tanh-GELU MLP, tied LM head — so greedy decode through the
+cache is token-identical to the naive full-forward reference (the e2e
+parity test pins this).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..models.layers import dense, gelu, layer_norm
+from ..ops.transformer.attention import dot_product_attention
+
+
+def _write_prefill_blocks(cache, layer_idx, seq_kv, block_table, block_size):
+    """Scatter one layer's [S, h, d] K-or-V rows into ``cache`` through
+    ``block_table`` (whole blocks: S is a bucket, a multiple of the
+    block size).  Returns the updated cache (aliased via donation)."""
+    s = seq_kv.shape[0]
+    blocks = seq_kv.reshape(s // block_size, block_size,
+                            *seq_kv.shape[1:])
+    for j in range(s // block_size):
+        update = blocks[j][None, None]          # [1, 1, bs, h, d]
+        cache = jax.lax.dynamic_update_slice(
+            cache, update.astype(cache.dtype),
+            (layer_idx, block_table[j], 0, 0, 0))
+    return cache
+
+
+def build_prefill(model_config, icfg, bucket_len):
+    """The bucket's prefill callable
+    ``(params, k_cache, v_cache, input_ids[1, S], true_len, block_table)
+    -> (next_token, k_cache, v_cache)`` — jit it with
+    ``donate_argnums=(1, 2)`` so the cache writes alias in place."""
+    c = model_config
+    bs = icfg.kv_block_size
+    heads, head_dim = c.num_heads, c.hidden_size // c.num_heads
+    assert bucket_len % bs == 0
+
+    def prefill(params, k_cache, v_cache, input_ids, true_len, block_table):
+        s = input_ids.shape[1]
+        x = jnp.take(params["wte"], input_ids, axis=0) \
+            + params["wpe"][None, :s]
+        # pad keys masked out of every softmax row; the causal structure
+        # already hides them from positions < true_len, so this only
+        # pins the (discarded) pad rows
+        visible = (jnp.arange(s)[None, :] < true_len).astype(jnp.float32)
+        for i in range(c.num_layers):
+            lp = params["blocks"][f"layer_{i}"]
+            y = layer_norm(lp["ln_attn"], x, c.layer_norm_eps)
+            qkv = dense(lp["qkv"], y).reshape(1, s, 3, heads, head_dim)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            k_cache = _write_prefill_blocks(k_cache, i, k[0], block_table,
+                                            bs)
+            v_cache = _write_prefill_blocks(v_cache, i, v[0], block_table,
+                                            bs)
+            ctx = dot_product_attention(q, k, v, key_padding_mask=visible,
+                                        causal=True)
+            x = x + dense(lp["attn_out"], ctx.reshape(1, s, c.hidden_size))
+            z = layer_norm(lp["ln_mlp"], x, c.layer_norm_eps)
+            x = x + dense(lp["fc2"], gelu(dense(lp["fc1"], z)))
+        x = layer_norm(params["ln_f"], x, c.layer_norm_eps)
+        last = jax.lax.dynamic_slice(
+            x, (0, true_len - 1, 0), (1, 1, c.hidden_size))
+        logits = last[0, 0] @ params["wte"].T.astype(last.dtype)
+        return jnp.argmax(logits).astype(jnp.int32), k_cache, v_cache
+
+    return prefill
+
+
+def build_decode(model_config, icfg):
+    """The decode callable ``(params, k_cache, v_cache, block_tables,
+    ctx_lens, tokens) -> (next_tokens, k_cache, v_cache)`` for the fixed
+    ``max_batch_slots``-wide continuous batch — jit it with
+    ``donate_argnums=(1, 2)``.
+
+    ``ctx_lens[b]`` is the context length BEFORE this token, i.e. the
+    new token's position; inactive slots park at position 0 of the null
+    block and their output is discarded on the host."""
+    c = model_config
+    bs = icfg.kv_block_size
+    n_slots = icfg.max_batch_slots
+    max_seq = icfg.max_seq_len
+    heads, head_dim = c.num_heads, c.hidden_size // c.num_heads
+
+    def decode(params, k_cache, v_cache, block_tables, ctx_lens, tokens):
+        x = jnp.take(params["wte"], tokens, axis=0) \
+            + jnp.take(params["wpe"], ctx_lens, axis=0)       # [B, h]
+        x = x[:, None, :]                                     # [B, 1, h]
+        block_ids = jnp.take_along_axis(
+            block_tables, (ctx_lens // bs)[:, None], axis=1)[:, 0]
+        offsets = ctx_lens % bs
+        # after the write, each slot's valid context includes its own
+        # new token at position ctx_len
+        visible = (jnp.arange(max_seq)[None, :]
+                   <= ctx_lens[:, None]).astype(jnp.float32)
+        for i in range(c.num_layers):
+            lp = params["blocks"][f"layer_{i}"]
+            y = layer_norm(lp["ln_attn"], x, c.layer_norm_eps)
+            qkv = dense(lp["qkv"], y).reshape(n_slots, 3, heads, head_dim)
+            q, k_new, v_new = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+            for b in range(n_slots):
+                upd_k = k_new[b][None, None, None].astype(k_cache.dtype)
+                upd_v = v_new[b][None, None, None].astype(v_cache.dtype)
+                start = (i, block_ids[b], offsets[b], 0, 0)
+                k_cache = jax.lax.dynamic_update_slice(k_cache, upd_k,
+                                                       start)
+                v_cache = jax.lax.dynamic_update_slice(v_cache, upd_v,
+                                                       start)
+            # paged gather: [B, blocks_per_seq, bs, h, d] -> [B, S, h, d]
+            k_ctx = jnp.take(k_cache[i], block_tables, axis=0).reshape(
+                n_slots, max_seq, heads, head_dim)
+            v_ctx = jnp.take(v_cache[i], block_tables, axis=0).reshape(
+                n_slots, max_seq, heads, head_dim)
+            ctx = dot_product_attention(q[:, None].astype(x.dtype),
+                                        k_ctx.astype(x.dtype),
+                                        v_ctx.astype(x.dtype),
+                                        key_padding_mask=visible)
+            x = x + dense(lp["attn_out"], ctx.reshape(n_slots, 1,
+                                                      c.hidden_size))
+            z = layer_norm(lp["ln_mlp"], x, c.layer_norm_eps)
+            x = x + dense(lp["fc2"], gelu(dense(lp["fc1"], z)))
+        x = layer_norm(params["ln_f"], x, c.layer_norm_eps)
+        logits = x[:, 0] @ params["wte"].T.astype(x.dtype)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), \
+            k_cache, v_cache
+
+    return decode
+
+
+def reference_generate(model, params, prompt, max_new_tokens,
+                       eos_token_id=-1):
+    """The naive one-request-at-a-time reference: full forward over the
+    whole growing context per token, greedy argmax.  O(n^2) recompute
+    and one retrace per length — it exists to be the parity oracle the
+    cached engine must match token for token, not to be fast."""
+    ids = list(int(t) for t in prompt)
+    out = []
+    for _ in range(max_new_tokens):
+        logits = model.logits(params, jnp.asarray([ids], jnp.int32))
+        nxt = int(jnp.argmax(logits[0, -1]))
+        out.append(nxt)
+        ids.append(nxt)
+        if eos_token_id >= 0 and nxt == eos_token_id:
+            break
+    return out

@@ -1,0 +1,205 @@
+"""Continuous-batching scheduler: Orca-style iteration-level admission
+over the paged KV cache.
+
+Pure host bookkeeping — the scheduler decides WHO runs; the engine
+dispatches the compiled programs.  Per engine iteration:
+
+1. finished slots (generation cap or EOS) release their blocks and free
+   their slot — mid-batch, without draining the other sequences;
+2. queued requests admit in FIFO order while a slot is free, the token
+   budget holds, and the allocator can grant the request's WHOLE
+   worst-case block span (prefill bucket ∪ prompt+generation cap) —
+   allocation is all-at-admission, so decode can never hit
+   out-of-blocks;
+3. every active slot advances one token through the fixed-shape decode
+   program.
+
+The token budget is the Orca admission knob: the sum of each active
+request's worst case (prompt + remaining generation) stays under
+``inference.token_budget``, bounding both cache pressure and
+per-iteration latency under load.
+"""
+
+import time
+from collections import deque
+
+from .kv_cache import NULL_BLOCK
+
+# request lifecycle
+QUEUED = "queued"
+ACTIVE = "active"
+FINISHED = "finished"
+
+# finish reasons
+REASON_EOS = "eos"
+REASON_LENGTH = "max_new_tokens"
+
+
+class Request:
+    """One generation request and its measured lifecycle.
+
+    Timing fields are host wall-clock (``time.monotonic``): ``submitted``
+    at entry, ``first_token_at`` when prefill emits (TTFT), ``step_times``
+    one per generated token (the per-token latency record the serving
+    bench quotes p50/p99 from)."""
+
+    __slots__ = ("request_id", "prompt", "max_new_tokens", "state",
+                 "generated", "blocks", "slot", "bucket", "submitted",
+                 "first_token_at", "finished_at", "finish_reason",
+                 "step_times")
+
+    def __init__(self, request_id, prompt, max_new_tokens):
+        assert len(prompt) > 0, "empty prompt"
+        self.request_id = request_id
+        self.prompt = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        self.state = QUEUED
+        self.generated = []
+        self.blocks = []
+        self.slot = None
+        self.bucket = None
+        self.submitted = time.monotonic()
+        self.first_token_at = None
+        self.finished_at = None
+        self.finish_reason = None
+        self.step_times = []
+
+    @property
+    def context_len(self):
+        return len(self.prompt) + len(self.generated)
+
+    def worst_case_tokens(self):
+        return len(self.prompt) + self.max_new_tokens
+
+    def result(self):
+        lat = sorted(self.step_times)
+
+        def pct(p):
+            if not lat:
+                return None
+            return lat[min(len(lat) - 1, int(p * len(lat)))]
+
+        return {
+            "request_id": self.request_id,
+            "tokens": list(self.generated),
+            "finish_reason": self.finish_reason,
+            "ttft_seconds": (self.first_token_at - self.submitted
+                             if self.first_token_at is not None else None),
+            "latency_seconds": (self.finished_at - self.submitted
+                                if self.finished_at is not None else None),
+            "per_token_p50_seconds": pct(0.50),
+            "per_token_p99_seconds": pct(0.99),
+        }
+
+
+class ContinuousBatchScheduler:
+    """Slot/block/budget bookkeeping for one
+    :class:`~deepspeed_tpu.inference.engine.InferenceEngine`."""
+
+    def __init__(self, icfg, allocator):
+        self.icfg = icfg
+        self.allocator = allocator
+        self.waiting = deque()
+        self.slots = [None] * icfg.max_batch_slots
+        self.admitted_total = 0
+        self.finished_total = 0
+
+    # -- state views ---------------------------------------------------
+    @property
+    def queue_depth(self):
+        return len(self.waiting)
+
+    def active_requests(self):
+        return [r for r in self.slots if r is not None]
+
+    @property
+    def active_count(self):
+        return sum(1 for r in self.slots if r is not None)
+
+    def reserved_tokens(self):
+        """Worst-case token debt of the active set (the budget term)."""
+        return sum(r.worst_case_tokens() for r in self.slots
+                   if r is not None)
+
+    def idle(self):
+        return not self.waiting and self.active_count == 0
+
+    # -- admission ------------------------------------------------------
+    def submit(self, request):
+        icfg = self.icfg
+        if request.worst_case_tokens() > icfg.max_seq_len:
+            raise ValueError(
+                f"request {request.request_id!r}: prompt "
+                f"({len(request.prompt)}) + max_new_tokens "
+                f"({request.max_new_tokens}) exceeds inference.max_seq_len "
+                f"({icfg.max_seq_len})")
+        icfg.bucket_for(len(request.prompt))  # reject over-long prompts
+        self.waiting.append(request)
+
+    def _blocks_needed(self, request, bucket):
+        bs = self.icfg.kv_block_size
+        span = max(bucket, request.worst_case_tokens())
+        return -(-span // bs)  # ceil
+
+    def try_admit(self):
+        """Admit the queue head if a slot, the token budget, and the
+        block pool all allow it; None otherwise (FIFO — no overtaking,
+        so admission latency stays predictable under load)."""
+        if not self.waiting:
+            return None
+        free_slots = [i for i, r in enumerate(self.slots) if r is None]
+        if not free_slots:
+            return None
+        request = self.waiting[0]
+        if self.reserved_tokens() + request.worst_case_tokens() \
+                > self.icfg.token_budget:
+            return None
+        bucket = self.icfg.bucket_for(len(request.prompt))
+        blocks = self.allocator.allocate(self._blocks_needed(request,
+                                                             bucket))
+        if blocks is None:
+            return None
+        self.waiting.popleft()
+        request.state = ACTIVE
+        request.slot = free_slots[0]
+        request.bucket = bucket
+        request.blocks = blocks
+        self.slots[request.slot] = request
+        self.admitted_total += 1
+        return request
+
+    def block_table_row(self, request):
+        """The request's block table padded to the fixed
+        ``max_blocks_per_seq`` width with the null block."""
+        width = self.icfg.max_blocks_per_seq
+        row = list(request.blocks)[:width]
+        return row + [NULL_BLOCK] * (width - len(row))
+
+    # -- recycling ------------------------------------------------------
+    def finish(self, request, reason):
+        """Release the request's slot and blocks mid-batch (the
+        continuous-batching move: siblings keep decoding)."""
+        assert self.slots[request.slot] is request
+        self.slots[request.slot] = None
+        self.allocator.release(request.blocks)
+        request.blocks = []
+        request.state = FINISHED
+        request.finish_reason = reason
+        request.finished_at = time.monotonic()
+        self.finished_total += 1
+
+    def sweep_finished(self, eos_token_id):
+        """Mark every slot that hit its cap or emitted EOS; returns the
+        finished requests."""
+        done = []
+        for request in list(self.slots):
+            if request is None:
+                continue
+            if (eos_token_id >= 0 and request.generated
+                    and request.generated[-1] == eos_token_id):
+                self.finish(request, REASON_EOS)
+                done.append(request)
+            elif len(request.generated) >= request.max_new_tokens:
+                self.finish(request, REASON_LENGTH)
+                done.append(request)
+        return done

@@ -99,6 +99,104 @@ def replace_transformer_layer(hf_encoder_params, revert=False,
     return out
 
 
+def inject_gpt2_layer(hf_block):
+    """HF FlaxGPT2Block params → ``TransformerLayer`` params.
+
+    GPT-2's ``c_attn`` already stores the fused ``[h, 3h]`` qkv kernel
+    (HF keeps the original TF Conv1D layout, which in Flax lands as a
+    plain ``[in, out]`` dense kernel), so unlike the BERT policy there
+    is no concat — the surgery is a pure re-keying: ``ln_1``/``ln_2``
+    become the pre-LN ``ln_attn``/``ln_mlp`` our layer's
+    ``pre_layer_norm`` path reads."""
+    att = hf_block["attn"]
+    mlp = hf_block["mlp"]
+    return {
+        "qkv": {"kernel": att["c_attn"]["kernel"],
+                "bias": att["c_attn"]["bias"]},
+        "attn_out": {"kernel": att["c_proj"]["kernel"],
+                     "bias": att["c_proj"]["bias"]},
+        "fc1": {"kernel": mlp["c_fc"]["kernel"],
+                "bias": mlp["c_fc"]["bias"]},
+        "fc2": {"kernel": mlp["c_proj"]["kernel"],
+                "bias": mlp["c_proj"]["bias"]},
+        "ln_attn": {"scale": hf_block["ln_1"]["scale"],
+                    "bias": hf_block["ln_1"]["bias"]},
+        "ln_mlp": {"scale": hf_block["ln_2"]["scale"],
+                   "bias": hf_block["ln_2"]["bias"]},
+    }
+
+
+def revert_gpt2_layer(ours):
+    """``TransformerLayer`` params → HF FlaxGPT2Block params (checkpoint
+    export).  Exact inverse of :func:`inject_gpt2_layer` — the fused qkv
+    kernel passes through whole, so no ``hidden_size`` is needed."""
+    return {
+        "ln_1": {"scale": ours["ln_attn"]["scale"],
+                 "bias": ours["ln_attn"]["bias"]},
+        "attn": {
+            "c_attn": {"kernel": ours["qkv"]["kernel"],
+                       "bias": ours["qkv"]["bias"]},
+            "c_proj": {"kernel": ours["attn_out"]["kernel"],
+                       "bias": ours["attn_out"]["bias"]},
+        },
+        "ln_2": {"scale": ours["ln_mlp"]["scale"],
+                 "bias": ours["ln_mlp"]["bias"]},
+        "mlp": {
+            "c_fc": {"kernel": ours["fc1"]["kernel"],
+                     "bias": ours["fc1"]["bias"]},
+            "c_proj": {"kernel": ours["fc2"]["kernel"],
+                       "bias": ours["fc2"]["bias"]},
+        },
+    }
+
+
+def replace_gpt2_transformer_layer(hf_blocks, revert=False):
+    """Convert every block of an HF Flax GPT-2 transformer
+    (``{'h': {'0': ..., '1': ...}}`` or ``{'0': ...}``) to fused-layer
+    params keyed ``layer_i`` — or back with ``revert=True`` — mirroring
+    the BERT pair above."""
+    blocks = hf_blocks.get("h", hf_blocks)
+    out = {}
+    for key, sub in blocks.items():
+        idx = int(str(key).split("_")[-1]) if not str(key).isdigit() \
+            else int(key)
+        if revert:
+            out[str(idx)] = revert_gpt2_layer(sub)
+        else:
+            out[f"layer_{idx}"] = inject_gpt2_layer(sub)
+    return out
+
+
+def ingest_gpt2_model(hf_params):
+    """Full HF ``FlaxGPT2LMHeadModel`` param tree →
+    :class:`~deepspeed_tpu.models.gpt2.GPT2LMHeadTPU` params: embeddings
+    remapped (``wte.embedding`` → ``wte``), every block through the
+    injection policy, final layernorm carried over.  Accepts either the
+    full tree (``{'transformer': {...}}``) or the transformer subtree."""
+    t = hf_params.get("transformer", hf_params)
+    return {
+        "wte": t["wte"]["embedding"],
+        "wpe": t["wpe"]["embedding"],
+        "blocks": replace_gpt2_transformer_layer(t),
+        "ln_f": {"scale": t["ln_f"]["scale"], "bias": t["ln_f"]["bias"]},
+    }
+
+
+def cast_weights(params, dtype):
+    """Cast every floating-point leaf of a param tree to ``dtype``
+    (serving-time bf16 ingestion; integer leaves — e.g. token tables —
+    pass through untouched)."""
+    import jax
+
+    def cast(leaf):
+        arr = jnp.asarray(leaf)
+        if jnp.issubdtype(arr.dtype, jnp.floating):
+            return arr.astype(dtype)
+        return arr
+
+    return jax.tree_util.tree_map(cast, params)
+
+
 def replace_module(params, policy, match):
     """Generic walker (reference ``replace_module``, ``:161-193``): apply
     ``policy(subtree)`` to every subtree for which ``match(path, subtree)``

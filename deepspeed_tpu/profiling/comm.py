@@ -219,23 +219,32 @@ def collective_summary(ops):
     return entry
 
 
+# the serving engine's fixed-width decode program: the "step" of a
+# serve the way train_step is the step of a training run (one token per
+# active slot per dispatch).  Named here so the step pricer, the
+# engine's receipts, and the offline doctor agree on one string.
+SERVE_DECODE_PROGRAM = "serve_decode"
+
+
 def step_program_weights(available, grad_accumulation_steps=1,
                          prefer=None):
     """``(program_label, [(name, multiplicity), ...])`` pricing ONE
     optimizer step over the recorded program set ``available`` (any
     container supporting ``in``).
 
-    The fused program (``train_step`` / ``train_step_compressed``) IS
-    the step when present — ``prefer`` names the one the engine is
-    CURRENTLY dispatching (a 1-bit Adam run holds both, and past
-    freeze_step the compressed one is the live step).  Otherwise the
-    step-wise programs are weighted by the micro-batch multiplicity
-    (``fwd_bwd``·acc + ``accum``·(acc-1) + ``apply_update`` +
-    ``cast_params``).  ``(None, [])`` when nothing priced yet.  The ONE
-    implementation behind :meth:`CommLedger.step_entry`,
-    :meth:`CommLedger.step_overlap`, and the attribution model's step
-    budget — the receipts must never disagree on what "one step" is."""
-    fused_order = ("train_step", "train_step_compressed")
+    The fused program (``train_step`` / ``train_step_compressed``, or
+    ``serve_decode`` for a serving run) IS the step when present —
+    ``prefer`` names the one the engine is CURRENTLY dispatching (a
+    1-bit Adam run holds both, and past freeze_step the compressed one
+    is the live step).  Otherwise the step-wise programs are weighted
+    by the micro-batch multiplicity (``fwd_bwd``·acc + ``accum``·(acc-1)
+    + ``apply_update`` + ``cast_params``).  ``(None, [])`` when nothing
+    priced yet.  The ONE implementation behind
+    :meth:`CommLedger.step_entry`, :meth:`CommLedger.step_overlap`, and
+    the attribution model's step budget — the receipts must never
+    disagree on what "one step" is."""
+    fused_order = ("train_step", "train_step_compressed",
+                   SERVE_DECODE_PROGRAM)
     if prefer is not None:
         fused_order = (prefer,) + tuple(f for f in fused_order
                                         if f != prefer)

@@ -507,6 +507,55 @@ RING_ATTENTION_ENABLED = "enabled"
 RING_ATTENTION_ENABLED_DEFAULT = False
 
 #############################################
+# Inference / serving (deepspeed_tpu/inference; new — the reference
+# v0.3.11 predates its inference engine entirely.  Orca-style
+# continuous batching over a vLLM-style paged KV cache, adapted to
+# XLA's static-shape world: every knob here is a SHAPE, so the engine
+# compiles exactly len(prefill_buckets) + 1 programs and never
+# retraces mid-serve.)
+#############################################
+INFERENCE = "inference"
+# tokens per KV-cache block (the paged-allocation granularity; the
+# prefill buckets and max_seq_len must be multiples of it)
+INFERENCE_KV_BLOCK_SIZE = "kv_block_size"
+INFERENCE_KV_BLOCK_SIZE_DEFAULT = 16
+# total preallocated KV blocks per layer (the device-memory budget:
+# 2 * layers * kv_blocks * kv_block_size * hidden * dtype bytes)
+INFERENCE_KV_BLOCKS = "kv_blocks"
+INFERENCE_KV_BLOCKS_DEFAULT = 256
+# decode batch width: the FIXED slot count of the decode program
+# (continuous batching recycles slots per iteration; the shape never
+# changes, so the decode program compiles once)
+INFERENCE_MAX_BATCH_SLOTS = "max_batch_slots"
+INFERENCE_MAX_BATCH_SLOTS_DEFAULT = 4
+# longest context (prompt + generated) a sequence may reach; bounds the
+# per-slot block-table width
+INFERENCE_MAX_SEQ_LEN = "max_seq_len"
+INFERENCE_MAX_SEQ_LEN_DEFAULT = 64
+# padded prefill lengths, ascending: each prompt compiles against the
+# smallest bucket that fits, so prefill retraces are bounded by
+# len(buckets) — the dslint DSR3xx bucketed-shape discipline
+INFERENCE_PREFILL_BUCKETS = "prefill_buckets"
+INFERENCE_PREFILL_BUCKETS_DEFAULT = (16, 32, 64)
+# admission budget: a request is admitted only while the sum of
+# (context + remaining generation) tokens over active slots stays
+# under this — the Orca iteration-level admission knob
+INFERENCE_TOKEN_BUDGET = "token_budget"
+INFERENCE_TOKEN_BUDGET_DEFAULT = 2048
+# per-request generation cap when the request does not set one
+INFERENCE_MAX_NEW_TOKENS = "max_new_tokens"
+INFERENCE_MAX_NEW_TOKENS_DEFAULT = 16
+# stop token: a slot emitting it is finished and recycled mid-batch
+# (-1 disables — fixed-length generation)
+INFERENCE_EOS_TOKEN_ID = "eos_token_id"
+INFERENCE_EOS_TOKEN_ID_DEFAULT = -1
+# serve-time weight dtype: "bfloat16" casts every floating-point leaf
+# at ingestion (module_inject surgery included); "float32" keeps the
+# checkpoint dtype (the CPU-parity setting)
+INFERENCE_WEIGHTS_DTYPE = "weights_dtype"
+INFERENCE_WEIGHTS_DTYPE_DEFAULT = "float32"
+
+#############################################
 # Config validation (dslint schema; new — reference config.py:432 only
 # checked a handful of keys by hand)
 #############################################

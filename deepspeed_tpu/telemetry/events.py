@@ -87,6 +87,13 @@ EVENT_ELASTIC = "elastic"
 # says what voted ("fingerprint" majority vote vs "hang_quorum"
 # staleness); ``suspects`` names the ranks a non-ok verdict indicts
 EVENT_INTEGRITY = "integrity"
+# serving subsystem (inference/engine): ``kind`` selects the payload
+# shape — "admit" (a request entered the continuous batch: prompt
+# tokens, prefill bucket, block grant, slot), "finish" (a slot was
+# recycled mid-batch: finish reason, generated tokens), "queue" (the
+# steps_per_print-cadence occupancy snapshot: queue depth, active
+# slots, free KV blocks, reserved token budget)
+EVENT_SERVING = "serving"
 
 # type -> required data keys.  The report CLI and the golden-schema test
 # validate against this table; emitting an unknown type or dropping a
@@ -117,6 +124,7 @@ EVENT_TYPES = {
                         "step_unexplained_fraction"),
     EVENT_ELASTIC: ("phase",),
     EVENT_INTEGRITY: ("verdict", "kind", "suspects"),
+    EVENT_SERVING: ("kind",),
 }
 
 

@@ -11,7 +11,7 @@ import ast
 from typing import List
 
 from .analysis import ModuleIndex, body_nodes
-from .core import (ParsedFile, Rule, call_name, diag,
+from .core import (ParsedFile, Rule, call_name, diag, dotted_name,
                    register_file_checker, register_rule)
 
 register_rule(Rule(
@@ -52,6 +52,26 @@ register_rule(Rule(
               "silently traces only one branch.",
     autofix_hint="Use jnp.where / lax.cond / lax.select for data-"
                  "dependent control flow."))
+
+register_rule(Rule(
+    id="DSR305", name="retrace-unbucketed-length", severity="warning",
+    summary="loop-varying array built inline at a jit boundary",
+    rationale="An array constructed from loop-accumulated data "
+              "(jnp.asarray over a growing list) changes SHAPE every "
+              "iteration, so the jitted callee recompiles per length — "
+              "the decode-loop bug where a serve retraces once per "
+              "token instead of once per declared bucket.",
+    autofix_hint="Pad to a declared bucket length before the jit "
+                 "boundary (a helper named pad_*/bucket_* is recognized "
+                 "as the fix)."))
+
+# DSR305 machinery: array constructors whose result shape follows the
+# data, loop-growth methods, and the helper-name markers that signal
+# the shape was normalized to a declared bucket before the boundary
+_ARRAY_CTORS = {"asarray", "array"}
+_ARRAY_CTOR_OWNERS = {"jnp", "np", "numpy", "jax.numpy"}
+_GROWTH_METHODS = {"append", "extend", "insert"}
+_SHAPE_FIX_MARKERS = ("pad", "bucket")
 
 _MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp,
                      ast.DictComp, ast.SetComp)
@@ -157,6 +177,139 @@ def _static_arg_diags(pf: ParsedFile, call: ast.Call, target) -> List:
     return out
 
 
+def _jit_boundary_names(index: ModuleIndex):
+    """Plain names that ARE jit boundaries when called: targets of
+    ``name = jax.jit(fn)`` assignments plus jit/pmap-decorated
+    functions (a bare function later wrapped by call-form jit is NOT a
+    boundary when called directly, so it is deliberately excluded)."""
+    names = set()
+    for node in ast.walk(index.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                       ast.Call):
+            leaf = call_name(node.value).rsplit(".", 1)[-1]
+            if leaf in ("jit", "pmap"):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                expr = dec.func if isinstance(dec, ast.Call) else dec
+                if dotted_name(expr).rsplit(".", 1)[-1] in ("jit", "pmap"):
+                    names.add(node.name)
+    return names
+
+
+def _names_in(node):
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _is_shape_following_ctor(node):
+    """jnp.asarray / np.array style calls: output shape follows input
+    data, so a growing input means a new shape every call."""
+    if not isinstance(node, ast.Call):
+        return False
+    name = call_name(node)
+    owner, _, leaf = name.rpartition(".")
+    return leaf in _ARRAY_CTORS and owner in _ARRAY_CTOR_OWNERS
+
+
+def _has_shape_fix(node):
+    """Whether the expression passes through a pad_*/ *_bucket* helper —
+    the recognized 'length was normalized to a declared bucket' step."""
+    for call in ast.walk(node):
+        if isinstance(call, ast.Call):
+            leaf = call_name(call).rsplit(".", 1)[-1].lower()
+            if any(marker in leaf for marker in _SHAPE_FIX_MARKERS):
+                return True
+    return False
+
+
+def _loop_dependent_names(loop):
+    """Names whose value varies per loop iteration: the loop targets,
+    anything grown in place (.append/.extend/+=), and — transitively —
+    anything assigned from an expression over those."""
+    dep = set()
+    if isinstance(loop, ast.For):
+        dep |= _names_in(loop.target)
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(loop):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _GROWTH_METHODS
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id not in dep):
+                dep.add(node.func.value.id)
+                changed = True
+            elif (isinstance(node, ast.AugAssign)
+                  and isinstance(node.target, ast.Name)
+                  and node.target.id not in dep):
+                dep.add(node.target.id)
+                changed = True
+            elif isinstance(node, ast.Assign) \
+                    and _names_in(node.value) & dep:
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id not in dep:
+                        dep.add(t.id)
+                        changed = True
+    return dep
+
+
+def _unbucketed_ctor(expr, dep):
+    """The shape-following array constructor inside ``expr`` that
+    consumes loop-dependent data with no pad/bucket step, or None."""
+    if _has_shape_fix(expr):
+        return None
+    for node in ast.walk(expr):
+        if _is_shape_following_ctor(node) and _names_in(node) & dep:
+            return node
+    return None
+
+
+def _unbucketed_length_diags(pf: ParsedFile, index: ModuleIndex) -> List:
+    boundaries = _jit_boundary_names(index)
+    if not boundaries:
+        return []
+    out = []
+    for loop in ast.walk(index.tree):
+        if not isinstance(loop, (ast.For, ast.While)):
+            continue
+        dep = _loop_dependent_names(loop)
+        if not dep:
+            continue
+        # names assigned (in this loop) from an unbucketed loop-shaped
+        # array — passing one to a jitted callee fires the same way the
+        # inline construction does
+        tainted = set()
+        for node in ast.walk(loop):
+            if isinstance(node, ast.Assign) \
+                    and _unbucketed_ctor(node.value, dep) is not None:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        tainted.add(t.id)
+        for call in ast.walk(loop):
+            if not (isinstance(call, ast.Call)
+                    and isinstance(call.func, ast.Name)
+                    and call.func.id in boundaries):
+                continue
+            args = list(call.args) + [kw.value for kw in call.keywords]
+            for arg in args:
+                hit = _unbucketed_ctor(arg, dep)
+                if hit is None and isinstance(arg, ast.Name) \
+                        and arg.id in tainted:
+                    hit = arg
+                if hit is not None:
+                    out.append(diag(
+                        pf, hit, "DSR305",
+                        f"array built from loop-varying data reaches "
+                        f"jitted '{call.func.id}' without a declared "
+                        "bucket: the callee recompiles once per length "
+                        "(pad to a bucket before the jit boundary)"))
+                    break
+    return out
+
+
 @register_file_checker
 def check_retrace(pf: ParsedFile) -> List:
     index = ModuleIndex(pf.tree)
@@ -217,4 +370,7 @@ def check_retrace(pf: ParsedFile) -> List:
     # DSR302 at jit call sites
     for call, target, _ in _jit_call_targets(index):
         out.extend(_static_arg_diags(pf, call, target))
+
+    # DSR305: loop-varying lengths reaching a jit boundary unbucketed
+    out.extend(_unbucketed_length_diags(pf, index))
     return out

@@ -123,6 +123,7 @@ _SECTION_PREFIXES = (
     ("COMPILATION_", "compilation"), ("PROFILING_", "profiling"),
     ("ACT_CHKPT_", "activation_checkpointing"),
     ("FLOPS_PROFILER_", "flops_profiler"),
+    ("INFERENCE_", "inference"),
 )
 
 # constant-name prefix -> (section, sub-block key) for one-level-nested

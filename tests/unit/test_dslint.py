@@ -602,6 +602,111 @@ def step(x):
     assert ids == []
 
 
+def test_dsr305_unbucketed_decode_loop(tmp_path):
+    # the decode-loop bug: the per-request context grows every
+    # iteration and reaches the jitted step as a fresh-shaped array, so
+    # the serve retraces once per token
+    ids = lint_source(tmp_path, """
+import jax
+import jax.numpy as jnp
+
+def decode(params, ids):
+    return ids.sum()
+
+step = jax.jit(decode)
+
+def serve(params, prompt, n):
+    ids = list(prompt)
+    for _ in range(n):
+        nxt = step(params, jnp.asarray(ids))
+        ids.append(int(nxt))
+    return ids
+""")
+    assert ids == ["DSR305"]
+
+
+def test_dsr305_bucketed_twin_is_clean(tmp_path):
+    # identical loop, but the length is normalized to a declared bucket
+    # before the boundary — the fix the rule's autofix hint names
+    ids = lint_source(tmp_path, """
+import jax
+import jax.numpy as jnp
+
+def decode(params, ids):
+    return ids.sum()
+
+step = jax.jit(decode)
+
+def pad_to_bucket(ids, bucket=64):
+    return ids + [0] * (bucket - len(ids))
+
+def serve(params, prompt, n):
+    ids = list(prompt)
+    for _ in range(n):
+        nxt = step(params, jnp.asarray(pad_to_bucket(ids)))
+        ids.append(int(nxt))
+    return ids
+""")
+    assert ids == []
+
+
+def test_dsr305_tainted_name_fires(tmp_path):
+    # two-step form: the unbucketed array lands in a local first
+    ids = lint_source(tmp_path, """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(x):
+    return x.sum()
+
+def serve(prompt, n):
+    ids = list(prompt)
+    for _ in range(n):
+        batch = jnp.asarray(ids)
+        nxt = step(batch)
+        ids.append(int(nxt))
+    return ids
+""")
+    assert ids == ["DSR305"]
+
+
+def test_dsr305_non_jit_callee_is_clean(tmp_path):
+    # the naive reference loop is allowed: model.logits is not an
+    # in-module jit boundary, so growing the context only costs the
+    # reference (which exists to be slow), not a compiled program
+    ids = lint_source(tmp_path, """
+import jax.numpy as jnp
+
+def serve(model, params, prompt, n):
+    ids = list(prompt)
+    for _ in range(n):
+        logits = model.logits(params, jnp.asarray([ids]))
+        ids.append(int(logits.argmax()))
+    return ids
+""")
+    assert ids == []
+
+
+def test_dsr305_loop_invariant_array_is_clean(tmp_path):
+    # arrays built in the loop from loop-INVARIANT data keep one shape
+    ids = lint_source(tmp_path, """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(x):
+    return x.sum()
+
+def serve(prompt, n):
+    out = []
+    for _ in range(n):
+        out.append(float(step(jnp.asarray(prompt))))
+    return out
+""")
+    assert ids == []
+
+
 # ---------------------------------------------------------------------------
 # pragmas
 # ---------------------------------------------------------------------------

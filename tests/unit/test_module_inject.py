@@ -9,9 +9,13 @@ import numpy as np
 import pytest
 
 from deepspeed_tpu.models.layers import TransformerLayer
-from deepspeed_tpu.module_inject import (inject_bert_layer, replace_module,
+from deepspeed_tpu.module_inject import (cast_weights, ingest_gpt2_model,
+                                         inject_gpt2_layer, replace_module,
                                          replace_transformer_layer,
-                                         revert_bert_layer)
+                                         inject_bert_layer,
+                                         replace_gpt2_transformer_layer,
+                                         revert_bert_layer,
+                                         revert_gpt2_layer)
 
 H, HEADS, INTER = 64, 4, 128
 
@@ -81,3 +85,84 @@ def test_replace_module_generic_walker():
                          policy=lambda sub: {"x": sub["x"] * 10},
                          match=lambda path, sub: path.endswith("hit"))
     assert out == {"a": {"hit": {"x": 10}}, "b": {"x": 2}}
+
+
+# ------------------------------------------------------------- GPT-2
+def _gpt2_block_params(seed=0):
+    """Synthetic HF FlaxGPT2Block param tree (no transformers needed:
+    the layout is fixed — c_attn already holds the fused [h, 3h] qkv)."""
+    rng = np.random.default_rng(seed)
+
+    def w(*shape):
+        return jnp.asarray(rng.normal(size=shape).astype(np.float32))
+
+    return {
+        "ln_1": {"scale": w(H), "bias": w(H)},
+        "attn": {"c_attn": {"kernel": w(H, 3 * H), "bias": w(3 * H)},
+                 "c_proj": {"kernel": w(H, H), "bias": w(H)}},
+        "ln_2": {"scale": w(H), "bias": w(H)},
+        "mlp": {"c_fc": {"kernel": w(H, INTER), "bias": w(INTER)},
+                "c_proj": {"kernel": w(INTER, H), "bias": w(H)}},
+    }
+
+
+def test_gpt2_revert_roundtrip_exact():
+    hf = _gpt2_block_params(seed=3)
+    ours = inject_gpt2_layer(hf)
+    assert set(ours) == {"qkv", "attn_out", "fc1", "fc2", "ln_attn",
+                         "ln_mlp"}
+    back = revert_gpt2_layer(ours)
+    flat1 = jax.tree_util.tree_flatten_with_path(hf)[0]
+    flat2 = {jax.tree_util.keystr(k): v
+             for k, v in jax.tree_util.tree_flatten_with_path(back)[0]}
+    assert len(flat1) == len(flat2)
+    for path, leaf in flat1:
+        key = jax.tree_util.keystr(path)
+        np.testing.assert_array_equal(np.asarray(leaf),
+                                      np.asarray(flat2[key]), err_msg=key)
+
+
+def test_replace_gpt2_transformer_layer_walks_blocks():
+    hf = _gpt2_block_params()
+    ours = replace_gpt2_transformer_layer({"h": {"0": hf, "1": hf}})
+    assert set(ours) == {"layer_0", "layer_1"}
+    assert ours["layer_0"]["qkv"]["kernel"].shape == (H, 3 * H)
+    back = replace_gpt2_transformer_layer(ours, revert=True)
+    assert set(back) == {"0", "1"}
+    np.testing.assert_array_equal(
+        np.asarray(back["0"]["attn"]["c_attn"]["kernel"]),
+        np.asarray(hf["attn"]["c_attn"]["kernel"]))
+
+
+def test_ingest_gpt2_model_maps_embeddings_and_blocks():
+    rng = np.random.default_rng(1)
+    hf = {"transformer": {
+        "wte": {"embedding": jnp.asarray(
+            rng.normal(size=(128, H)).astype(np.float32))},
+        "wpe": {"embedding": jnp.asarray(
+            rng.normal(size=(32, H)).astype(np.float32))},
+        "h": {"0": _gpt2_block_params(seed=4)},
+        "ln_f": {"scale": jnp.ones(H), "bias": jnp.zeros(H)},
+    }}
+    params = ingest_gpt2_model(hf)
+    assert set(params) == {"wte", "wpe", "blocks", "ln_f"}
+    assert params["wte"].shape == (128, H)
+    assert set(params["blocks"]) == {"layer_0"}
+    # the ingested tree is directly consumable by GPT2LMHeadTPU
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadTPU
+
+    model = GPT2LMHeadTPU(GPT2Config(
+        vocab_size=128, hidden_size=H, num_layers=1, num_heads=HEADS,
+        max_position_embeddings=32, embd_dropout=0.0, attn_dropout=0.0,
+        resid_dropout=0.0))
+    logits = model.logits(params, jnp.asarray([[1, 2, 3]], jnp.int32))
+    assert logits.shape == (1, 3, 128)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_cast_weights_bf16_skips_integer_leaves():
+    tree = {"w": jnp.ones((2, 2), jnp.float32),
+            "ids": jnp.asarray([1, 2], jnp.int32)}
+    out = cast_weights(tree, jnp.bfloat16)
+    assert out["w"].dtype == jnp.bfloat16
+    assert out["ids"].dtype == jnp.int32
