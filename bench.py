@@ -104,7 +104,14 @@ def memory_receipts(record, engine, prefix=None):
         from deepspeed_tpu.profiling.memory import device_memory_summary
 
         tag = (lambda f: f"{prefix}_{f}") if prefix else (lambda f: f)
+        # training engines compile "train_step"; serving engines
+        # (examples/bench_serving.py rides the same helper) compile the
+        # paged decode program instead
         temps = engine.memory_ledger.predicted_temp_bytes("train_step")
+        if temps is None:
+            from deepspeed_tpu.profiling.comm import SERVE_DECODE_PROGRAM
+            temps = engine.memory_ledger.predicted_temp_bytes(
+                SERVE_DECODE_PROGRAM)
         if temps is not None:
             record[tag("predicted_temp_bytes")] = int(temps)
         summary = device_memory_summary()
@@ -198,6 +205,15 @@ def dsp_receipts(record, engine, prefix=None):
         # hard-failing bench_diff (same rationale as the planner's
         # exit code)
         record[tag("dsp_violations")] = int(report["errors"])
+        # per-device parameter residency (profiling/sharding, DSS8xx):
+        # the compiled step's materialized ÷shard receipt, lower-is-
+        # better gated in bench_schema — the bench half of ROADMAP
+        # item 2's parameter-memory ÷ dp criterion
+        sharding = report.get("sharding") or {}
+        pb = (sharding.get("train_step") or {}).get(
+            "param_bytes_per_device")
+        if pb is not None:
+            record[tag("param_bytes_per_device")] = int(pb)
         warnings = int(report["violations"]) - int(report["errors"])
         if not prefix and warnings:
             record["dsp_warnings"] = warnings

@@ -421,7 +421,31 @@ class InferenceEngine:
             "host_stream_schedule": None,
             "collective_schedule": None,
             "device_kind": getattr(jax.devices()[0], "device_kind", ""),
+            # declared sharding (profiling/sharding, DSS8xx): single-
+            # replica serving declares everything replicated on a
+            # 1-wide data axis — weights as the params family, the two
+            # paged KV buffers as kv_cache — so the decode program's
+            # residency still gets a priced receipt
+            "declared_sharding": self._declared_sharding(leaves),
         }
+
+    def _declared_sharding(self, param_leaves):
+        from ..profiling import sharding as sharding_prof
+        try:
+            mesh_axes = {"data": 1}
+            families = {
+                "params": sharding_prof.build_declared_family(
+                    (int(np.prod(l.shape)) * l.dtype.itemsize, [], 1)
+                    for l in param_leaves),
+                "kv_cache": sharding_prof.build_declared_family(
+                    (int(np.prod(c.shape)) * c.dtype.itemsize, [], 1)
+                    for c in (self._k_cache, self._v_cache)),
+            }
+            return {"tag": "serve|data1", "mesh_axes": mesh_axes,
+                    "families": families}
+        except Exception as e:
+            logger.debug("declared_sharding unavailable: %s", e)
+            return None
 
     def verify_programs(self):
         """DSP6xx pass over every compiled serve program — the KV-cache

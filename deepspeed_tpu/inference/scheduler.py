@@ -133,6 +133,18 @@ class ContinuousBatchScheduler:
                 f"({len(request.prompt)}) + max_new_tokens "
                 f"({request.max_new_tokens}) exceeds inference.max_seq_len "
                 f"({icfg.max_seq_len})")
+        if request.worst_case_tokens() > icfg.token_budget:
+            # try_admit() can NEVER seat this request — even an empty
+            # batch leaves the budget short — and FIFO admission means
+            # it would park at the queue head starving everything
+            # behind it forever.  Loud at submit time, not a hang
+            raise ValueError(
+                f"request {request.request_id!r}: prompt "
+                f"({len(request.prompt)}) + max_new_tokens "
+                f"({request.max_new_tokens}) exceeds "
+                f"inference.token_budget ({icfg.token_budget}); this "
+                "request could never be admitted (raise token_budget "
+                "or shorten the request)")
         icfg.bucket_for(len(request.prompt))  # reject over-long prompts
         self.waiting.append(request)
 

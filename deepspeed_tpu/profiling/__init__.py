@@ -9,6 +9,7 @@ from .flops_profiler import (FlopsProfiler, count_fn_flops, get_model_profile)
 from .memory import (HostBufferRegistry, MemoryLedger, device_memory_summary,
                      see_memory_usage)
 from .overlap import analyze_hlo, parse_hlo_transfers, transfer_summary
+from .sharding import analyze_sharding, entry_parameters
 from .step_profiler import (model_scope_breakdown, timed_loop, timed_scan,
                             wall_breakdown)
 from .utilization import (DEFAULT_PEAK_TFLOPS, PEAK_TFLOPS, chip_peak_tflops,
@@ -25,5 +26,6 @@ __all__ = ["CommLedger", "collective_summary", "parse_hlo_collectives",
            "DEFAULT_PEAK_TFLOPS", "chip_peak_tflops", "chip_specs",
            "model_flops_utilization", "analyze_hlo",
            "parse_hlo_transfers", "transfer_summary",
+           "analyze_sharding", "entry_parameters",
            "step_program_weights", "program_budget", "step_budget",
            "reconcile", "straggler_explanation", "flops_cross_check"]

@@ -151,6 +151,25 @@ def plan(config, model, sample_batch, mesh=None, capacity_bytes=None,
                 verify["overlap"]["overlap_fraction"]
                 if verify is not None and verify.get("overlap")
                 else None),
+            # static residency verdict (profiling/sharding, DSS8xx):
+            # the per-device parameter bytes the compiled step's entry
+            # layout actually materializes, with the shard divisor —
+            # ROADMAP item 2's planner-verified ÷dp receipt
+            "param_bytes_per_device": (
+                (verify["sharding"].get("train_step") or {}).get(
+                    "param_bytes_per_device")
+                if verify is not None and verify.get("sharding")
+                else None),
+            "param_bytes_global": (
+                (verify["sharding"].get("train_step") or {}).get(
+                    "param_bytes_global")
+                if verify is not None and verify.get("sharding")
+                else None),
+            "param_shard_divisor": (
+                (verify["sharding"].get("train_step") or {}).get(
+                    "param_shard_divisor")
+                if verify is not None and verify.get("sharding")
+                else None),
             "predicted_peak_hbm_bytes": predicted_peak_bytes(entry),
             "predicted_temp_bytes": (entry or {}).get("temp_size_in_bytes"),
             "argument_bytes": (entry or {}).get("argument_size_in_bytes"),
@@ -365,6 +384,12 @@ def _print_report(r):
         print(f"  exposed wire ......... "
               f"{r['exposed_wire_seconds'] * 1e3:.3f} ms/step "
               f"(overlap fraction {r['overlap_fraction']:.2f})")
+    if r.get("param_bytes_per_device") is not None:
+        div = r.get("param_shard_divisor") or 1
+        print(f"  params per device .... "
+              f"{_fmt_bytes(r['param_bytes_per_device'])} "
+              f"(global {_fmt_bytes(r.get('param_bytes_global'))} "
+              f"÷{div} shard)")
     print(f"  device capacity ...... {_fmt_bytes(r['capacity_bytes'])} "
           f"(headroom {r['headroom']:.2f})")
     if r["fit"] is None:

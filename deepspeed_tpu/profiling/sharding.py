@@ -1,0 +1,261 @@
+"""Static sharding auditor: per-tensor HLO layouts → per-device
+resident bytes, reconciled against the engine-DECLARED sharding spec.
+
+The substrate of the DSS8xx rule family (``tools/dslint/programs.py``).
+GSPMD writes the layout it actually materialized into the optimized
+HLO as ``sharding={...}`` annotations on the entry computation's
+parameters — ``{replicated}``, ``{devices=[2,1,2]<=[4]
+last_tile_dim_replicate}`` tile assignments, or ``{maximal device=N}``.
+This module parses those annotations (reusing the PR 8/11 parser
+infrastructure: :func:`overlap.parse_hlo_computations` for the
+instruction walk, ``comm``'s dtype/shape tables for byte math) into a
+per-tensor layout map, prices **per-device resident bytes by family**
+(params, master, optimizer state, KV cache, activations at the entry
+boundary), and reconciles the result against the spec the engine
+declared — the same mesh/PartitionSpec tuples its jits were built
+with, carried in ``program_verify_context()["declared_sharding"]`` and
+the ``<run_dir>/programs/`` sidecars.
+
+Why this exists now: ROADMAP item 2 (parameter sharding past the
+paper's ZeRO-2 ceiling) is only a capacity win if the ÷dp actually
+MATERIALIZES.  A stage-3 step whose parameters compile replicated
+trains correctly, benchmarks plausibly, and silently pays ×dp memory —
+the same finite-loss silence as the PR 8 flatten replica-sum bug.  The
+auditor makes that a static CI failure (DSS801) and a planner/bench
+receipt (``param_bytes_per_device``) before stage 3 lands.
+
+Like the rest of the profiling parsers this is stdlib+regex only — no
+jax import — so dslint can borrow it lazily (and says "UNVERIFIED"
+loudly via DSS804 when it cannot, the DSP614 contract).
+"""
+
+import re
+from typing import Dict, List, Optional
+
+from . import comm as comm_prof
+from . import overlap as overlap_prof
+
+# a declared-sharded tensor smaller than this cannot meaningfully fold
+# memory: DSS801 stays quiet below it (CI fixtures are MiB-scale; the
+# stage-3 tensors this rule guards are GiB-scale)
+MIN_AUDIT_BYTES = 1 << 20
+
+# family reconciliation order: the step-level state families first so
+# a byte-size collision between a declared family and a stray entry
+# tensor resolves toward the declared state
+_FAMILY_ORDER = ("params", "master", "optimizer", "kv_cache")
+
+_SHARDING_ATTR_RE = re.compile(r"sharding=\{(?P<body>[^}]*)\}")
+_TILE_RE = re.compile(r"devices=\[(?P<dims>[0-9,]+)\]")
+# boundary resharding collectives a producer/consumer layout mismatch
+# lowers to (the DSS802 evidence); ``-done`` halves never match
+_RESHARD_RE = re.compile(r"\b(?:all-to-all|collective-permute)(?:-start)?\(")
+
+
+def parse_sharding_attr(attr_text: str) -> Optional[dict]:
+    """One instruction's ``sharding={...}`` annotation →
+    ``{kind, tile, divisor}``; None when the instruction carries no
+    annotation (single-device modules annotate nothing).
+
+    ``divisor`` is the number of distinct shards the tensor is split
+    into — the per-device resident bytes are ``global_bytes //
+    divisor``.  A ``last_tile_dim_replicate`` factor replicates shards
+    and does not divide residency; ``{replicated}`` and
+    ``{maximal device=N}`` both resolve to divisor 1 (maximal puts the
+    WHOLE tensor on one device)."""
+    m = _SHARDING_ATTR_RE.search(attr_text)
+    if m is None:
+        return None
+    body = m.group("body")
+    if "replicated" in body:
+        return {"kind": "replicated", "tile": [], "divisor": 1}
+    if "maximal" in body:
+        return {"kind": "maximal", "tile": [], "divisor": 1}
+    tm = _TILE_RE.search(body)
+    if tm is None:
+        return {"kind": "unknown", "tile": [], "divisor": 1}
+    dims = [int(d) for d in tm.group("dims").split(",") if d]
+    split = dims[:-1] if "last_tile_dim_replicate" in body else dims
+    divisor = 1
+    for d in split:
+        divisor *= max(int(d), 1)
+    return {"kind": "devices", "tile": dims, "divisor": max(divisor, 1)}
+
+
+def entry_parameters(hlo_text: str) -> Optional[List[dict]]:
+    """Per-tensor layout map of the entry computation's parameters:
+    ``[{name, param, local_bytes, global_bytes, divisor, kind}]``.
+    None when the text holds no computation (header-only artifact).
+
+    Shapes in a partitioned module print LOCAL (per-shard); the global
+    footprint is ``local_bytes × divisor``.  Tuple-shaped parameters
+    (no single shape literal) are skipped — XLA's default pytree
+    lowering flattens every leaf to its own parameter."""
+    comps, entry_name, _ = overlap_prof.parse_hlo_computations(hlo_text)
+    if entry_name is None or entry_name not in comps:
+        return None
+    out = []
+    for instr in comps[entry_name].instructions:
+        if instr.op != "parameter":
+            continue
+        shapes = comm_prof._shape_bytes_list(instr.outs)
+        if len(shapes) != 1:
+            continue
+        sharding = parse_sharding_attr(instr.attrs)
+        divisor = sharding["divisor"] if sharding else 1
+        try:
+            param_no = int(instr.operands.strip())
+        except ValueError:
+            param_no = -1
+        out.append({
+            "name": instr.name,
+            "param": param_no,
+            "local_bytes": int(shapes[0]),
+            "global_bytes": int(shapes[0]) * divisor,
+            "divisor": int(divisor),
+            "kind": sharding["kind"] if sharding else "unannotated",
+        })
+    return out
+
+
+def count_reshard_ops(hlo_text: str) -> int:
+    """Boundary-reshard collective count (all-to-all /
+    collective-permute, sync or ``-start`` async form) in one module —
+    the DSS802 supporting evidence."""
+    return len(_RESHARD_RE.findall(hlo_text))
+
+
+# ---------------------------------------------------------------------------
+# declared-spec helpers (engine side builds with these; no jax here)
+# ---------------------------------------------------------------------------
+
+def spec_axes_and_divisor(spec, mesh_axes: Dict[str, int]):
+    """``(axis names, shard divisor)`` of one PartitionSpec-like value
+    (an iterable of axis names / None / nested tuples) against the mesh
+    axis sizes — exactly how GSPMD divides the tensor."""
+    axes = []
+    for entry in (spec or ()):
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            axes.extend(str(a) for a in entry)
+        else:
+            axes.append(str(entry))
+    divisor = 1
+    for a in axes:
+        divisor *= max(int(mesh_axes.get(a, 1)), 1)
+    return axes, max(divisor, 1)
+
+
+def build_declared_family(leaf_entries) -> dict:
+    """One declared family from ``(global_bytes, axes, divisor)``
+    leaf tuples — the sidecar-serializable shape the reconciler
+    consumes."""
+    leaves = [{"bytes": int(b), "axes": [str(a) for a in axes],
+               "divisor": max(int(divisor), 1)}
+              for b, axes, divisor in leaf_entries if int(b) > 0]
+    return {"leaves": leaves,
+            "total_bytes": sum(leaf["bytes"] for leaf in leaves)}
+
+
+# ---------------------------------------------------------------------------
+# reconciliation: declared spec vs materialized entry layout
+# ---------------------------------------------------------------------------
+
+def _family_sort_key(name):
+    try:
+        return (_FAMILY_ORDER.index(name), name)
+    except ValueError:
+        return (len(_FAMILY_ORDER), name)
+
+
+def analyze_sharding(hlo_text: str,
+                     declared: Optional[dict] = None) -> Optional[dict]:
+    """The full sharding summary of one program: the entry layout map,
+    per-family per-device resident bytes, and — when a declared spec is
+    given — the declared-vs-materialized mismatches DSS801 fires on.
+
+    Matching is greedy largest-first on EXACT global bytes within each
+    family (preferring an entry tensor whose divisor agrees, so
+    same-sized families — fp32 master vs Adam moments — never
+    cross-claim a mismatch).  Entry parameters no family claims are the
+    ``activations`` residue: the batch/scalar/carry tensors resident at
+    the program boundary."""
+    params = entry_parameters(hlo_text)
+    if params is None:
+        return None
+    by_bytes: Dict[int, List[int]] = {}
+    for i, p in enumerate(params):
+        by_bytes.setdefault(p["global_bytes"], []).append(i)
+    unmatched = set(range(len(params)))
+
+    families = {}
+    declared_families = (declared or {}).get("families") or {}
+    if not isinstance(declared_families, dict):
+        declared_families = {}
+    for fam in sorted(declared_families, key=_family_sort_key):
+        spec = declared_families.get(fam) or {}
+        leaves = spec.get("leaves") if isinstance(spec, dict) else None
+        leaves = [l for l in (leaves or []) if isinstance(l, dict)]
+        matched = per_dev = declared_per_dev = unclaimed = 0
+        div_bytes: Dict[int, int] = {}
+        mismatches = []
+        for leaf in sorted(leaves,
+                           key=lambda l: -int(l.get("bytes") or 0)):
+            b = int(leaf.get("bytes") or 0)
+            if b <= 0:
+                continue
+            ddiv = max(int(leaf.get("divisor") or 1), 1)
+            declared_per_dev += b // ddiv
+            cand = [i for i in by_bytes.get(b, ()) if i in unmatched]
+            if not cand:
+                unclaimed += b
+                continue
+            pick = next((i for i in cand
+                         if params[i]["divisor"] == ddiv), cand[0])
+            unmatched.discard(pick)
+            mdiv = max(params[pick]["divisor"], 1)
+            matched += b
+            per_dev += b // mdiv
+            div_bytes[mdiv] = div_bytes.get(mdiv, 0) + b
+            if mdiv < ddiv:
+                mismatches.append({
+                    "bytes": b,
+                    "declared_divisor": ddiv,
+                    "materialized_divisor": mdiv,
+                    "axes": [str(a) for a in (leaf.get("axes") or [])],
+                    "param": params[pick]["name"],
+                })
+        families[fam] = {
+            "declared_bytes": sum(int(l.get("bytes") or 0)
+                                  for l in leaves),
+            "matched_bytes": matched,
+            "unmatched_declared_bytes": unclaimed,
+            "per_device_bytes": per_dev,
+            "declared_per_device_bytes": declared_per_dev,
+            # bytes-weighted dominant materialized divisor (the DSS802
+            # cross-program consistency figure); None = nothing matched
+            "materialized_divisor": (
+                max(div_bytes, key=lambda d: (div_bytes[d], -d))
+                if div_bytes else None),
+            "mismatches": mismatches,
+        }
+
+    activation_bytes = sum(params[i]["local_bytes"] for i in unmatched)
+    param_fam = families.get("params")
+    return {
+        "entry_parameters": len(params),
+        "parameters": params,
+        "families": families,
+        "activation_bytes_per_device": int(activation_bytes),
+        "param_bytes_per_device": (
+            int(param_fam["per_device_bytes"])
+            if param_fam and param_fam["matched_bytes"] else None),
+        "param_bytes_global": (
+            int(param_fam["matched_bytes"])
+            if param_fam and param_fam["matched_bytes"] else None),
+        "param_shard_divisor": (
+            int(param_fam["materialized_divisor"])
+            if param_fam and param_fam["materialized_divisor"] else None),
+        "reshard_ops": count_reshard_ops(hlo_text),
+    }

@@ -102,7 +102,8 @@ def build_engine_artifact(engine, name, compiled):
         host_state_wire_bytes=_declared_host_wire(ctx, name),
         host_stream_schedule=_declared_host_schedule(ctx, name),
         collective_schedule=_declared_collective_schedule(ctx, name),
-        device_kind=ctx.get("device_kind"))
+        device_kind=ctx.get("device_kind"),
+        declared_sharding=ctx.get("declared_sharding"))
 
 
 def _overlap_aggregate(artifacts):
@@ -130,6 +131,28 @@ def _overlap_aggregate(artifacts):
             "serialized_host_transfers": ser_host}
 
 
+def _sharding_aggregate(artifacts):
+    """Per-program residency receipt (profiling/sharding, DSS8xx):
+    per-device parameter bytes with the shard divisor that produced
+    them; None when no artifact carried a declared spec the analyzer
+    could reconcile (no claim, never a silent 0)."""
+    out = {}
+    for artifact in artifacts:
+        if artifact.declared_sharding is None:
+            continue
+        summary = dsp.program_sharding(artifact)
+        if summary is None:
+            continue
+        out[artifact.name] = {
+            "param_bytes_per_device": summary["param_bytes_per_device"],
+            "param_bytes_global": summary["param_bytes_global"],
+            "param_shard_divisor": summary["param_shard_divisor"],
+            "activation_bytes_per_device":
+                summary["activation_bytes_per_device"],
+        }
+    return out or None
+
+
 def _report(diags, programs_checked, artifacts=()):
     failing = [d for d in diags
                if not d.suppressed and d.severity in FAILING_SEVERITIES]
@@ -147,6 +170,10 @@ def _report(diags, programs_checked, artifacts=()):
         # which of the priced wire seconds the compiled schedules
         # actually pay as latency
         "overlap": _overlap_aggregate(artifacts),
+        # static residency verdict (profiling/sharding, DSS8xx): the
+        # per-device parameter-bytes ÷shard receipt ROADMAP item 2's
+        # acceptance criterion names
+        "sharding": _sharding_aggregate(artifacts),
         "diagnostics": diags,
     }
 
@@ -169,6 +196,7 @@ def verify_engine_programs(engine):
         checked += 1
         artifacts.append(artifact)
         diags.extend(dsp.verify_program(artifact))
+    diags.extend(dsp.check_sharding_consistency(artifacts))
     if checked == 0:
         # every as_text() failed (backend specific): NO check ran —
         # returning a 0-violation report here would be the silent-clean
@@ -252,7 +280,8 @@ class ProgramDumper:
             host_state_wire_bytes=_declared_host_wire(ctx, name),
             host_stream_schedule=_declared_host_schedule(ctx, name),
             collective_schedule=_declared_collective_schedule(ctx, name),
-            device_kind=ctx.get("device_kind"))
+            device_kind=ctx.get("device_kind"),
+            declared_sharding=ctx.get("declared_sharding"))
         try:
             os.makedirs(self.programs_dir, exist_ok=True)
             hlo_path = os.path.join(self.programs_dir, f"{name}.hlo")

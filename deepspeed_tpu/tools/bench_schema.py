@@ -116,6 +116,15 @@ FIELDS = {
     "dsp_downgraded": (numbers.Integral,
                        "DSP602 downgraded verdicts (alias bytes "
                        "unverifiable: warm-cache/absent/partial)"),
+    # sharding residency receipt (round 17, profiling/sharding +
+    # DSS8xx): the compiled train step's MATERIALIZED per-device
+    # parameter bytes from its entry-layout sharding annotations — the
+    # bench half of ROADMAP item 2's parameter-memory ÷ dp criterion.
+    # Gated lower-is-better: re-replicated parameters show here before
+    # they OOM anything
+    "param_bytes_per_device": (numbers.Integral,
+                               "materialized per-device parameter "
+                               "bytes (entry-layout ÷shard receipt)"),
     # ZeRO-2 bucketed-collective A/B row (round 14, bench.py
     # _measure_zero2_overlap via the fresh-subprocess harness):
     # overlap_comm on (the headline) vs off (the serialized control) on
@@ -171,6 +180,23 @@ FIELDS = {
                                "DSP6xx errors over the serve programs "
                                "(gated at zero: the KV-cache donation "
                                "receipt)"),
+    # serving memory receipts (round 17): the HBM receipt every
+    # training row carries, via the same bench.memory_receipts() path
+    # (decode-program temp bytes; pinned-host registry usually absent)
+    "serving_peak_hbm_bytes": (numbers.Integral,
+                               "peak_bytes_in_use summed over local "
+                               "devices after the serve"),
+    "serving_predicted_temp_bytes": (numbers.Integral,
+                                     "serve_decode memory_analysis "
+                                     "temp bytes"),
+    "serving_host_buffer_bytes": (numbers.Integral,
+                                  "pinned-host registry bytes (serving "
+                                  "rows normally omit this)"),
+    # serving sharding receipt (round 17, DSS8xx): decode-program
+    # weights + paged KV residency per device
+    "serving_param_bytes_per_device": (numbers.Integral,
+                                       "materialized per-device weight "
+                                       "bytes of the decode program"),
 }
 
 # multichip leg fields: leg_<name>_<field>
@@ -191,6 +217,8 @@ _LEG_FIELDS = {
     # program-verification receipt (round 10): DSP6xx violations over
     # the leg engine's compiled programs
     "dsp_violations": numbers.Integral,
+    # sharding residency receipt (round 17, DSS8xx)
+    "param_bytes_per_device": numbers.Integral,
     # overlap receipts (round 11)
     "exposed_wire_seconds": numbers.Real,
     "overlap_fraction": numbers.Real,
@@ -251,6 +279,8 @@ _OFFLOAD_ROW_FIELDS = {
     "comm_wire_bytes_per_step": numbers.Integral,
     # program-verification receipt (round 10)
     "dsp_violations": numbers.Integral,
+    # sharding residency receipt (round 17, DSS8xx)
+    "param_bytes_per_device": numbers.Integral,
     # overlap receipts (round 11)
     "exposed_wire_seconds": numbers.Real,
     "overlap_fraction": numbers.Real,
@@ -316,6 +346,10 @@ THRESHOLDS = {
     # any new program-verifier violation is a gated regression (zero
     # tolerance: the receipt exists to pin this at 0)
     "dsp_violations": ("lower", 0.0),
+    # resident parameter bytes per device must only shrink (sharding
+    # landing) — growth past the dtype/padding band is re-replication
+    # (the DSS801/DSS803 bug class on the bench surface)
+    "param_bytes_per_device": ("lower", 0.10),
     # multichip: device-count or passing-leg shrinkage must show
     "n_devices": ("higher", 0.0),
     "legs_ok": ("higher", 0.0),
@@ -335,12 +369,18 @@ THRESHOLDS = {
     "serving_tokens_per_second_per_chip": ("higher", 0.25),
     "serving_programs_compiled": ("lower", 0.0),
     "serving_dsp_violations": ("lower", 0.0),
+    # serving memory + residency receipts (round 17): gated like the
+    # training rows' equivalents
+    "serving_peak_hbm_bytes": ("lower", 0.10),
+    "serving_predicted_temp_bytes": ("lower", 0.10),
+    "serving_param_bytes_per_device": ("lower", 0.10),
 }
 
 # thresholds for the pattern-based leg_<name>_<field> family
 _LEG_FIELD_THRESHOLDS = {
     "comm_wire_bytes": ("lower", 0.25),
     "dsp_violations": ("lower", 0.0),
+    "param_bytes_per_device": ("lower", 0.10),
     "exposed_wire_seconds": ("lower", 0.25),
     "overlap_fraction": ("higher", 0.10),
     # informational since round 16: the dryrun legs' predicted step
@@ -372,6 +412,7 @@ _OFFLOAD_FIELD_THRESHOLDS = {
     "host_buffer_bytes": ("lower", 0.10),
     "comm_wire_bytes_per_step": ("lower", 0.25),
     "dsp_violations": ("lower", 0.0),
+    "param_bytes_per_device": ("lower", 0.10),
     "exposed_wire_seconds": ("lower", 0.25),
     "overlap_fraction": ("higher", 0.10),
     "predicted_step_seconds": ("lower", 0.25),

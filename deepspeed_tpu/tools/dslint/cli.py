@@ -161,7 +161,7 @@ def baseline_key(d: Diagnostic) -> str:
     resize (the ratchet is the only suppression mechanism for program
     findings; they have no source line to pragma)."""
     m = _PROGRAM_DIAG_RE.match(d.message)
-    if m and d.rule_id.startswith(("DSP6", "DSO7")):
+    if m and d.rule_id.startswith(("DSP6", "DSO7", "DSS8")):
         return f"<programs>|{d.rule_id}|{m.group('program')}"
     return f"{d.path.replace(os.sep, '/')}|{d.rule_id}|{d.message}"
 
@@ -346,11 +346,35 @@ def main(argv=None) -> int:
                     help="print the rule catalog and exit")
     ap.add_argument("--show-suppressed", action="store_true",
                     help="also print pragma-suppressed diagnostics")
+    ap.add_argument("--all", action="store_true", dest="run_all",
+                    help="the composite CI gate: lint the shipped "
+                         "package source, apply the checked-in "
+                         "tools/dslint_baseline.json ratchet, and "
+                         "verify the checked-in fixture program "
+                         "sidecars under tools/dslint_fixtures/ — one "
+                         "invocation, so the three gates cannot drift "
+                         "apart")
     args = ap.parse_args(argv)
 
     if args.list_rules:
         print(rule_catalog())
         return 0
+    if args.run_all:
+        # repo layout anchor: cli.py lives at
+        # <repo>/deepspeed_tpu/tools/dslint/cli.py
+        pkg = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        repo = os.path.dirname(pkg)
+        if not args.paths:
+            args.paths = [pkg]
+        if not args.baseline:
+            args.baseline = os.path.join(repo, "tools",
+                                         "dslint_baseline.json")
+        fixtures = os.path.join(repo, "tools", "dslint_fixtures")
+        if os.path.isdir(fixtures):
+            args.programs = list(args.programs) + sorted(
+                os.path.join(fixtures, d) for d in os.listdir(fixtures)
+                if os.path.isdir(os.path.join(fixtures, d)))
     if not args.paths and not args.config and not args.programs:
         ap.print_usage(sys.stderr)
         return 2
@@ -395,6 +419,7 @@ def main(argv=None) -> int:
     if args.baseline:
         if args.update_baseline:
             metrics = programs.exposure_metrics(prog_artifacts)
+            metrics.update(programs.sharding_metrics(prog_artifacts))
             for run_dir, dir_artifacts in prog_by_dir:
                 metrics.update(programs.attribution_metrics(
                     dir_artifacts, run_dir=run_dir))
@@ -412,13 +437,16 @@ def main(argv=None) -> int:
                 return 2
             fail, baselined = apply_baseline(fail, baseline)
             # metric ratchets: recorded figures only tighten — growth
-            # (DSO704 exposed wire) or reconciliation drift (DSO705
-            # attribution) past tolerance is a NEW violation the
-            # violations baseline cannot absolve
+            # (DSO704 exposed wire, DSS803 per-device parameter bytes)
+            # or reconciliation drift (DSO705 attribution) past
+            # tolerance is a NEW violation the violations baseline
+            # cannot absolve
             ratchet = programs.check_exposure_ratchet(prog_artifacts,
                                                       base_metrics)
             ratchet.extend(programs.check_attribution_ratchet(
                 prog_by_dir, base_metrics))
+            ratchet.extend(programs.check_sharding_ratchet(
+                prog_artifacts, base_metrics))
             if select:
                 ratchet = [d for d in ratchet if d.rule_id in select]
             if ignore:

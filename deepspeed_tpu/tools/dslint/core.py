@@ -73,6 +73,7 @@ FAMILY_BUDGETS = {
     "DSE5": 7,   # optional-backend probes
     "DSP6": 0,   # program verifier: ratchet via --baseline or fix
     "DSO7": 0,   # overlap analyzer: ratchet via --baseline or fix
+    "DSS8": 0,   # sharding auditor: ratchet via --baseline or fix
 }
 
 

@@ -232,6 +232,75 @@ register_rule(Rule(
                  "(delete <run_dir>/programs and rerun)."))
 
 register_rule(Rule(
+    id="DSS801", name="declared-sharded-materialized-replicated",
+    severity="error",
+    summary="a tensor declared sharded over a mesh axis compiled with "
+            "a replicated (or coarser) layout — per-device memory "
+            "silently multiplies by the dropped axis product",
+    rationale="Parameter sharding (ZeRO stages, tensor parallelism) is "
+              "a capacity contract: the planner and the bench receipts "
+              "divide state bytes by the declared axis product.  GSPMD "
+              "can silently materialize a replicated layout instead (a "
+              "dropped out_sharding, a constraint lost through a "
+              "fusion/while body) and NOTHING fails — training is "
+              "numerically identical, loss is finite, and every device "
+              "pays ×dp resident bytes.  The silent dp-fold-of-memory "
+              "bug stage 3 will be built against; the same silence "
+              "class as the PR 8 flatten replica-sum bug.",
+    autofix_hint="Pin the layout with in_shardings/out_shardings (or "
+                 "lax.with_sharding_constraint inside the jit) and "
+                 "re-dump; the entry parameter named in the message "
+                 "shows the materialized annotation."))
+
+register_rule(Rule(
+    id="DSS802", name="unpriced-reshard", severity="warning",
+    summary="a state family materializes with DIFFERENT shard layouts "
+            "across programs of one run — the boundary pays an "
+            "unpriced reshard",
+    rationale="When the producer of a tensor family (e.g. cast_params) "
+              "compiles one layout and its consumer (train_step, "
+              "serve_decode) another, the runtime inserts all-to-all / "
+              "collective-permute / copy traffic at the program "
+              "boundary that no ledger priced — wire seconds and HBM "
+              "spikes invisible to every receipt.  One layout per "
+              "family per run, or an explicit reshard program that the "
+              "comm ledger prices.",
+    autofix_hint="Align the producer's out_shardings with the "
+                 "consumer's in_shardings (the declared_sharding "
+                 "sidecars name both layouts), or ratchet an "
+                 "intentional boundary via --baseline."))
+
+register_rule(Rule(
+    id="DSS803", name="param-bytes-ratchet", severity="warning",
+    summary="per-device parameter bytes grew past the "
+            "baseline-recorded figure — sharding is regressing",
+    rationale="DSS801 only fires when a DECLARED-sharded tensor "
+              "materializes replicated; a change that weakens the "
+              "declaration itself (or re-replicates state the baseline "
+              "era had sharded) passes it.  The baseline's recorded "
+              "param_bytes_per_device metric is the ratchet — the "
+              "DSO704/705 mechanism applied to resident parameter "
+              "memory, and the receipt half of ROADMAP item 2's "
+              "planner-verified ÷dp criterion.",
+    autofix_hint="Restore the sharded layout, or re-record with "
+                 "--update-baseline if the growth is intended and "
+                 "reviewed."))
+
+register_rule(Rule(
+    id="DSS804", name="sharding-analysis-unavailable",
+    severity="warning",
+    summary="the HLO sharding parser (profiling/sharding.py) could "
+            "not be imported — DSS801/DSS802/DSS803 did NOT run",
+    rationale="The sharding-residency checks borrow the profiling "
+              "package's parser so the layout math has one "
+              "implementation; when that import fails the checks "
+              "silently not running would read as 'verified clean' — "
+              "the DSP614 contract: UNVERIFIED, never silently clean.",
+    autofix_hint="Run the verifier in an environment where "
+                 "deepspeed_tpu.profiling imports (any env that can "
+                 "train), or fix the import error it reports."))
+
+register_rule(Rule(
     id="DSP613", name="comm-ledger-drift", severity="warning",
     summary="recorded CommLedger totals drift from the HLO re-parse "
             "beyond tolerance",
@@ -362,6 +431,12 @@ class ProgramArtifact:
     collective_schedule: Optional[dict] = None
     # device_kind string the roofline/wire tables resolve against
     device_kind: Optional[str] = None
+    # the engine-DECLARED sharding spec ({tag, mesh_axes, families:
+    # {name: {leaves: [{bytes, axes, divisor}], total_bytes}}}), built
+    # from the same mesh/PartitionSpec tuples the jits were given —
+    # what the DSS8xx sharding auditor reconciles the materialized HLO
+    # layouts against; None = nothing declared (no claim either way)
+    declared_sharding: Optional[dict] = None
 
     def __post_init__(self):
         if not self.path:
@@ -394,7 +469,35 @@ class ProgramArtifact:
             "host_stream_schedule": self.host_stream_schedule,
             "collective_schedule": self.collective_schedule,
             "device_kind": self.device_kind,
+            "declared_sharding": self.declared_sharding,
         }
+
+
+def _load_declared_sharding(side: dict) -> Optional[dict]:
+    """Type-validated ``declared_sharding`` from one sidecar dict.
+    Raises ``TypeError``/``ValueError`` (→ the CLI's malformed-sidecar
+    exit-2 contract) when the field is present but not the declared
+    shape — a tampered sidecar must fail loudly, not quietly disable
+    the DSS8xx reconciliation."""
+    declared = side.get("declared_sharding")
+    if declared is None:
+        return None
+    if not isinstance(declared, dict):
+        raise TypeError(
+            f"declared_sharding must be an object, got "
+            f"{type(declared).__name__}")
+    families = declared.get("families")
+    if families is not None and not isinstance(families, dict):
+        raise TypeError(
+            f"declared_sharding.families must be an object, got "
+            f"{type(families).__name__}")
+    for fam, spec in (families or {}).items():
+        if not isinstance(spec, dict) \
+                or not isinstance(spec.get("leaves", []), list):
+            raise TypeError(
+                f"declared_sharding.families[{fam!r}] must be an "
+                "object with a 'leaves' list")
+    return dict(declared)
 
 
 def load_run_artifacts(run_dir: str) -> List[ProgramArtifact]:
@@ -454,7 +557,8 @@ def load_run_artifacts(run_dir: str) -> List[ProgramArtifact]:
                     dict(side["collective_schedule"])
                     if isinstance(side.get("collective_schedule"), dict)
                     else None),
-                device_kind=side.get("device_kind")))
+                device_kind=side.get("device_kind"),
+                declared_sharding=_load_declared_sharding(side)))
         except (TypeError, ValueError) as e:
             # type-malformed sidecar (donate_argnums: 5, mesh_axes as a
             # list, ...): a usage-class load failure the CLI reports as
@@ -609,6 +713,198 @@ def check_collectives(artifact: ProgramArtifact) -> List[Diagnostic]:
                     "recorded comm-ledger totals drift from the HLO "
                     f"re-parse: {'; '.join(drifts)} (stale or tampered "
                     "artifact)"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# DSS8xx: sharding residency audit (profiling/sharding.py)
+# ---------------------------------------------------------------------------
+
+# DSS801 fires only on tensors at least this large: CI fixtures are
+# MiB-scale, and a sub-MiB fold cannot meaningfully move capacity
+SHARDING_MIN_TENSOR_BYTES = 1 << 20
+
+# relative growth of param_bytes_per_device beyond the recorded metric
+# that trips DSS803 (byte counts are exact per geometry; the tolerance
+# absorbs dtype/padding drift of a reviewed model resize, nothing more)
+PARAM_BYTES_RATCHET_TOL = 0.10
+
+
+def _load_sharding():
+    """The profiling package's sharding parser, borrowed lazily (one
+    layout-math implementation); None when unavailable — the DSS804
+    loud-failure path."""
+    try:
+        from ...profiling import sharding as sharding_prof
+    except Exception:
+        return None
+    return sharding_prof
+
+
+def program_sharding(artifact: ProgramArtifact):
+    """The sharding residency summary (profiling/sharding.py) of one
+    artifact — declared-vs-materialized reconciliation included when
+    the artifact carries a declared spec — memoized on the artifact;
+    None when the parser is unavailable or the text holds no
+    computation."""
+    if "_sharding_summary" not in artifact.__dict__:
+        summary = None
+        mod = _load_sharding()
+        if mod is not None and artifact.hlo:
+            try:
+                summary = mod.analyze_sharding(
+                    artifact.hlo, declared=artifact.declared_sharding)
+            except Exception:
+                summary = None
+        artifact.__dict__["_sharding_summary"] = summary
+    return artifact.__dict__["_sharding_summary"]
+
+
+def check_sharding(artifact: ProgramArtifact) -> List[Diagnostic]:
+    """DSS801/DSS804 over one program: every declared-sharded tensor
+    must materialize its divisor in the compiled layout."""
+    if not artifact.hlo or artifact.declared_sharding is None:
+        # nothing declared: no claim either way (pre-DSS8 sidecars
+        # stay clean; engines always declare from this round on)
+        return []
+    if _load_sharding() is None:
+        return [_pdiag(
+            artifact, "DSS804",
+            "sharding parser (deepspeed_tpu.profiling.sharding) "
+            "unimportable in this environment — DSS801/DSS802/DSS803 "
+            "were skipped, this program's parameter residency is "
+            "UNVERIFIED")]
+    summary = program_sharding(artifact)
+    if summary is None:
+        return []
+    out: List[Diagnostic] = []
+    for fam in sorted(summary["families"]):
+        for mm in summary["families"][fam]["mismatches"]:
+            if mm["bytes"] < SHARDING_MIN_TENSOR_BYTES:
+                continue
+            ddiv = mm["declared_divisor"]
+            mdiv = max(mm["materialized_divisor"], 1)
+            fold = ddiv // mdiv
+            axes = "/".join(mm["axes"]) or "?"
+            out.append(_pdiag(
+                artifact, "DSS801",
+                f"{fam} tensor ({mm['bytes']} bytes) declared sharded "
+                f"over axis '{axes}' (÷{ddiv}) but materialized "
+                f"{'replicated' if mdiv == 1 else f'÷{mdiv}'}: "
+                f"per-device resident bytes ×{fold} "
+                f"({mm['bytes'] // ddiv} declared -> "
+                f"{mm['bytes'] // mdiv} actual bytes/device) — the "
+                "silent dp-fold-of-memory shape (pin the layout with "
+                "out_shardings/with_sharding_constraint)"))
+    return out
+
+
+def check_sharding_consistency(artifacts) -> List[Diagnostic]:
+    """DSS802 across the programs of one run: a state family that
+    materializes with different shard divisors in two programs pays an
+    unpriced reshard at the boundary.  Reference layout per family =
+    the program carrying the most matched bytes (names break ties);
+    every disagreeing program gets one finding."""
+    placements = {}  # family -> [(artifact, divisor, matched_bytes)]
+    for artifact in artifacts:
+        if artifact.declared_sharding is None:
+            continue
+        summary = program_sharding(artifact)
+        if summary is None:
+            continue
+        for fam, info in summary["families"].items():
+            if info["materialized_divisor"] is None:
+                continue
+            placements.setdefault(fam, []).append(
+                (artifact, info["materialized_divisor"],
+                 info["matched_bytes"]))
+    out: List[Diagnostic] = []
+    for fam in sorted(placements):
+        entries = placements[fam]
+        if len({div for _, div, _ in entries}) <= 1:
+            continue
+        ref_artifact, ref_div, _ = max(
+            entries, key=lambda e: (e[2], e[0].name))
+        for artifact, div, _ in sorted(entries, key=lambda e: e[0].name):
+            if div == ref_div:
+                continue
+            resharded = _load_sharding()
+            n_reshard = (resharded.count_reshard_ops(artifact.hlo)
+                         if resharded is not None else 0)
+            out.append(_pdiag(
+                artifact, "DSS802",
+                f"family '{fam}' materializes ÷{div} here but ÷"
+                f"{ref_div} in [{ref_artifact.name}]: the program "
+                "boundary pays an unpriced reshard (producer/consumer "
+                f"layout mismatch; {n_reshard} all-to-all/"
+                "collective-permute op(s) in this module) — align the "
+                "out_shardings with the consumer or price an explicit "
+                "reshard program"))
+    return out
+
+
+def sharding_metric_key(tag: str, name: str) -> str:
+    """Baseline ``metrics`` key for one program's per-device parameter
+    bytes.  TAG-qualified (unlike the exposure keys): the canonical CI
+    fixtures (zero2-overlap dp4, offload dp1) share program names AND
+    model geometry, and both must ratchet independently."""
+    return f"<programs>|param_bytes_per_device|{tag}|{name}"
+
+
+def _sharding_tag(artifact):
+    tag = (artifact.declared_sharding or {}).get("tag")
+    return str(tag) if tag else None
+
+
+def sharding_metrics(artifacts) -> dict:
+    """``{metric key: param_bytes_per_device}`` for every artifact
+    whose params family matched the compiled layout — what
+    ``--update-baseline`` records so DSS803 can ratchet resident
+    parameter memory (the receipt half of ROADMAP item 2's ÷dp
+    criterion)."""
+    out = {}
+    for artifact in artifacts:
+        tag = _sharding_tag(artifact)
+        if tag is None:
+            continue
+        summary = program_sharding(artifact)
+        if summary is None or summary["param_bytes_per_device"] is None:
+            continue
+        out[sharding_metric_key(tag, artifact.name)] = float(
+            summary["param_bytes_per_device"])
+    return out
+
+
+def check_sharding_ratchet(artifacts, baseline_metrics) -> List[Diagnostic]:
+    """DSS803: programs whose re-analyzed per-device parameter bytes
+    exceed the baseline-recorded figure by more than the tolerance.
+    Programs without a recorded metric are not checked — the ratchet
+    only ever tightens what a reviewer recorded."""
+    out: List[Diagnostic] = []
+    if not baseline_metrics:
+        return out
+    for artifact in artifacts:
+        tag = _sharding_tag(artifact)
+        if tag is None:
+            continue
+        recorded = baseline_metrics.get(
+            sharding_metric_key(tag, artifact.name))
+        if recorded is None:
+            continue
+        summary = program_sharding(artifact)
+        if summary is None or summary["param_bytes_per_device"] is None:
+            continue
+        current = float(summary["param_bytes_per_device"])
+        ceiling = float(recorded) * (1.0 + PARAM_BYTES_RATCHET_TOL)
+        if current > ceiling:
+            out.append(_pdiag(
+                artifact, "DSS803",
+                f"param_bytes_per_device grew {float(recorded):.0f} -> "
+                f"{current:.0f} (+{PARAM_BYTES_RATCHET_TOL:.0%} "
+                "tolerance exceeded): resident parameter memory is "
+                "regressing (weakened sharding or re-replicated "
+                "state) — restore the layout or re-record with "
+                "--update-baseline"))
     return out
 
 
@@ -1016,7 +1312,7 @@ def check_overlap(artifact: ProgramArtifact) -> List[Diagnostic]:
 
 
 def verify_program(artifact: ProgramArtifact) -> List[Diagnostic]:
-    """All DSP6xx/DSO7xx HLO-side diagnostics for one program
+    """All DSP6xx/DSO7xx/DSS8xx HLO-side diagnostics for one program
     artifact."""
     if not artifact.hlo:
         # a sidecar whose HLO text is missing/empty would otherwise
@@ -1028,13 +1324,14 @@ def verify_program(artifact: ProgramArtifact) -> List[Diagnostic]:
             "empty — artifact unverifiable (stale or tampered dump; "
             "re-dump with profiling.program_dump enabled)")]
     return (check_donation(artifact) + check_collectives(artifact)
-            + check_overlap(artifact))
+            + check_overlap(artifact) + check_sharding(artifact))
 
 
 def verify_artifacts(artifacts) -> List[Diagnostic]:
     out: List[Diagnostic] = []
     for artifact in artifacts:
         out.extend(verify_program(artifact))
+    out.extend(check_sharding_consistency(artifacts))
     out.sort(key=lambda d: (d.path, d.rule_id, d.message))
     return out
 

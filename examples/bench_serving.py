@@ -13,7 +13,12 @@ quotes the fields every README serving headline must cite —
 - ``serving_tokens_per_second_per_chip`` (the throughput headline),
 - ``serving_programs_compiled`` (the bounded-retrace receipt:
   at most ``len(prefill_buckets) + 1``),
-- ``serving_dsp_violations`` (the KV-cache donation receipt, 0).
+- ``serving_dsp_violations`` (the KV-cache donation receipt, 0),
+- ``serving_peak_hbm_bytes`` / ``serving_predicted_temp_bytes`` (the
+  memory receipt every training row carries, via the same
+  ``bench.memory_receipts()`` path) and
+  ``serving_param_bytes_per_device`` (the DSS8xx decode-program
+  residency receipt).
 
 The LAST line printed is the JSON record (driver-artifact convention).
 
@@ -106,6 +111,16 @@ def main(argv):
     }
     if verify is not None:
         record["serving_dsp_violations"] = int(verify["errors"])
+        # DSS8xx residency receipt: the decode program's materialized
+        # per-device weight bytes
+        pb = ((verify.get("sharding") or {}).get("serve_decode")
+              or {}).get("param_bytes_per_device")
+        if pb is not None:
+            record["serving_param_bytes_per_device"] = int(pb)
+    # memory receipts ride the training bench's helper (fail-soft):
+    # watermark + the decode program's compile-time temp prediction
+    from bench import memory_receipts
+    memory_receipts(record, engine, prefix="serving")
     engine.close()
 
     for problem in validate_record(record):

@@ -937,7 +937,7 @@ def test_cli_validates_config_files(tmp_path):
 def test_every_rule_id_is_documented():
     for rule in RULES.values():
         assert rule.summary and rule.rationale, rule.id
-        assert rule.id[:3] in ("DSH", "DSR", "DSC", "DSE", "DSP", "DSO")
+        assert rule.id[:3] in ("DSH", "DSR", "DSC", "DSE", "DSP", "DSO", "DSS")
 
 
 # ---------------------------------------------------------------------------

@@ -82,7 +82,7 @@ def test_checked_in_baseline_is_empty_of_violations():
     from deepspeed_tpu.tools.dslint.cli import main
     from deepspeed_tpu.tools.dslint.programs import (
         comm_exposure_metric_key, exposure_metric_key,
-        predicted_step_metric_key)
+        predicted_step_metric_key, sharding_metric_key)
 
     baseline = os.path.join(os.path.dirname(PKG_DIR), "tools",
                             "dslint_baseline.json")
@@ -99,17 +99,29 @@ def test_checked_in_baseline_is_empty_of_violations():
     # pins (its OWN metric name: the two fixtures share the
     # "train_step" program name), all re-derived deterministically
     # from the dumped HLO
+    # round 17 added the DSS803 per-device parameter-bytes pins — TAG-
+    # qualified (the two CI fixtures share the "train_step" program
+    # name AND model geometry, so each needs its own ratchet key),
+    # recorded from the checked-in tools/dslint_fixtures/ sidecars by
+    # tools/regen_dslint_fixtures.py
     keys = {exposure_metric_key("train_step"),
             predicted_step_metric_key("train_step"),
             comm_exposure_metric_key("train_step"),
-            comm_exposure_metric_key("cast_params")}
+            comm_exposure_metric_key("cast_params"),
+            sharding_metric_key("zero2-offload|data1", "train_step"),
+            sharding_metric_key("zero2|data4", "train_step")}
     assert set(metrics) == keys, (
         "the baseline records exactly the offload-step exposed-wire + "
-        "attribution ratchet metrics and the zero-2 overlap fixture's "
-        f"collective-exposure metrics ({sorted(keys)}); anything else "
-        "needs review")
+        "attribution ratchet metrics, the zero-2 overlap fixture's "
+        "collective-exposure metrics, and the two fixtures' DSS803 "
+        f"param-bytes pins ({sorted(keys)}); anything else needs "
+        "review")
     for key in keys:
         assert metrics[key] > 0
+    # the two fixtures share SimpleModel(256, nlayers=8) with
+    # replicated params: both pins state the same full byte count
+    pb = metrics[sharding_metric_key("zero2|data4", "train_step")]
+    assert pb == 8 * (256 * 256 + 256) * 4
     assert main([PKG_DIR, "--baseline", baseline]) == 0
 
 
@@ -125,6 +137,7 @@ def test_family_budgets_cover_every_registered_family():
         f"{sorted(families - set(FAMILY_BUDGETS))}")
     assert FAMILY_BUDGETS["DSP6"] == 0
     assert FAMILY_BUDGETS["DSO7"] == 0
+    assert FAMILY_BUDGETS["DSS8"] == 0
 
 
 def test_list_rules_and_json_report_include_dso7_family(tmp_path):
@@ -141,10 +154,12 @@ def test_list_rules_and_json_report_include_dso7_family(tmp_path):
     with contextlib.redirect_stdout(buf):
         assert main(["--list-rules"]) == 0
     catalog = buf.getvalue()
-    for rule_id in ("DSO701", "DSO702", "DSO703"):
+    for rule_id in ("DSO701", "DSO702", "DSO703",
+                    "DSS801", "DSS802", "DSS803", "DSS804"):
         assert rule_id in catalog
     assert "suppression budgets" in catalog
     assert "DSO7xx=0" in catalog
+    assert "DSS8xx=0" in catalog
 
     out = tmp_path / "r.json"
     assert main([os.path.join(PKG_DIR, "tools", "dslint", "core.py"),
@@ -152,6 +167,19 @@ def test_list_rules_and_json_report_include_dso7_family(tmp_path):
     report = json.load(open(out, encoding="utf-8"))
     assert report["family_budgets"] == FAMILY_BUDGETS
     assert "DSO701" in report["rules"]
+    assert "DSS801" in report["rules"]
+
+
+def test_dslint_all_composite_gate():
+    """Satellite of the round-17 sharding auditor: ``dslint --all`` is
+    the ONE CI invocation combining the source self-lint, the
+    checked-in baseline ratchet (incl. the DSS803 param-bytes pins),
+    and program verification over the checked-in fixture sidecars
+    (tools/dslint_fixtures/) — wired as a tier-1 test so the three
+    gates cannot drift apart."""
+    from deepspeed_tpu.tools.dslint.cli import main
+
+    assert main(["--all"]) == 0
 
 
 def test_telemetry_package_is_hotpath_clean():

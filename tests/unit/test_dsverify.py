@@ -420,7 +420,7 @@ def test_verify_report_shape_and_downgrade_count():
     # rather than a silent fully-overlapped 0
     assert report == {"programs_checked": 1, "violations": 0,
                       "errors": 0, "downgraded": 1, "overlap": None,
-                      "diagnostics": diags}
+                      "sharding": None, "diagnostics": diags}
 
 
 # ------------------------------------------------ receipts + schema
@@ -727,3 +727,214 @@ def test_dsp_warnings_field_registered_and_ungated():
 
     assert validate_record({"dsp_warnings": 2}) == []
     assert threshold_for("dsp_warnings") == (None, None)
+
+
+# ------------------------------------------- DSS8xx sharding auditor
+def _declared(tag, mesh_axes, **families):
+    """An engine-shaped declared_sharding dict from
+    ``family=[(global_bytes, axes, divisor), ...]`` kwargs."""
+    from deepspeed_tpu.profiling import sharding as sharding_prof
+
+    return {"tag": tag, "mesh_axes": dict(mesh_axes),
+            "families": {fam: sharding_prof.build_declared_family(leaves)
+                         for fam, leaves in families.items()}}
+
+
+def _jit_param_program(mesh, x, spec):
+    from jax.sharding import NamedSharding
+
+    sh = NamedSharding(mesh, spec)
+    with mesh:
+        return jax.jit(lambda p: p * 2.0, in_shardings=sh,
+                       out_shardings=sh).lower(x).compile()
+
+
+def test_rebroken_replicated_params_trip_dss801(cpu_devices):
+    """THE round-17 regression fixture: parameters DECLARED ÷dp that
+    compile fully replicated on the dp mesh — numerically identical,
+    loss finite, every device silently paying ×dp resident bytes —
+    must fail statically, with the fold priced in the message."""
+    mesh = make_mesh({"data": 4}, devices=cpu_devices[:4])
+    x = jnp.zeros((512, 1024), jnp.float32)       # 2 MiB ≥ audit floor
+    nb = x.size * 4
+    decl = _declared("zero3|data4", {"data": 4},
+                     params=[(nb, ["data"], 4)])
+    compiled = _jit_param_program(mesh, x, P())   # the re-broken layout
+    art = dsp.ProgramArtifact(
+        name="train_step", hlo=compiled.as_text(),
+        mesh_axes={"data": 4}, declared_sharding=decl)
+    diags = dsp.verify_program(art)
+    assert "DSS801" in rule_ids(diags), rule_ids(diags)
+    bad = [d for d in diags if d.rule_id == "DSS801"][0]
+    assert bad.severity == "error" and failing([bad])
+    assert "×4" in bad.message and "replicated" in bad.message
+    assert f"{nb // 4} declared -> {nb} actual" in bad.message
+    # ... and the summary prices the fold: per-device == global
+    summary = dsp.program_sharding(art)
+    assert summary["param_bytes_per_device"] == nb
+    assert summary["param_shard_divisor"] == 1
+    # the FIXED layout (the same declaration actually materialized)
+    # verifies clean and halves^2 the receipt
+    compiled_ok = _jit_param_program(mesh, x, P("data"))
+    ok = dsp.ProgramArtifact(
+        name="train_step", hlo=compiled_ok.as_text(),
+        mesh_axes={"data": 4}, declared_sharding=decl)
+    assert dsp.verify_program(ok) == []
+    summary_ok = dsp.program_sharding(ok)
+    assert summary_ok["param_bytes_per_device"] == nb // 4
+    assert summary_ok["param_shard_divisor"] == 4
+
+
+def test_sub_mib_fold_stays_quiet(cpu_devices):
+    """DSS801 has a 1 MiB floor: a small declared-sharded tensor that
+    materializes replicated is noise, not a capacity regression."""
+    mesh = make_mesh({"data": 4}, devices=cpu_devices[:4])
+    x = jnp.zeros((64, 64), jnp.float32)          # 16 KiB
+    decl = _declared("zero3|data4", {"data": 4},
+                     params=[(x.size * 4, ["data"], 4)])
+    compiled = _jit_param_program(mesh, x, P())
+    art = dsp.ProgramArtifact(
+        name="train_step", hlo=compiled.as_text(),
+        mesh_axes={"data": 4}, declared_sharding=decl)
+    assert dsp.verify_program(art) == []
+    # the mismatch is still RECORDED (receipts see it) — only the
+    # diagnostic is floored
+    summary = dsp.program_sharding(art)
+    assert summary["families"]["params"]["mismatches"]
+
+
+def test_cross_program_layout_divergence_trips_dss802(cpu_devices):
+    """The same declared family materializing ÷4 in one program and
+    replicated in another pays an unpriced reshard at the boundary:
+    DSS802 on the divergent program, naming both layouts."""
+    mesh = make_mesh({"data": 4}, devices=cpu_devices[:4])
+    x = jnp.zeros((512, 1024), jnp.float32)
+    nb = x.size * 4
+    # declared replicated in BOTH sidecars so DSS801 stays out of the
+    # frame: DSS802 compares what MATERIALIZED, not what was declared
+    decl = _declared("zero2|data4", {"data": 4},
+                     params=[(nb, [], 1)])
+    art_sharded = dsp.ProgramArtifact(
+        name="z_step", hlo=_jit_param_program(mesh, x, P("data")).as_text(),
+        mesh_axes={"data": 4}, declared_sharding=decl)
+    art_replicated = dsp.ProgramArtifact(
+        name="a_step", hlo=_jit_param_program(mesh, x, P()).as_text(),
+        mesh_axes={"data": 4}, declared_sharding=decl)
+    diags = dsp.check_sharding_consistency([art_sharded, art_replicated])
+    assert rule_ids(diags) == ["DSS802"]
+    msg = diags[0].message
+    assert "family 'params'" in msg
+    assert "÷1" in msg and "÷4" in msg and "[z_step]" in msg
+    # same artifacts through the CLI-facing batch entry point
+    assert "DSS802" in rule_ids(
+        dsp.verify_artifacts([art_sharded, art_replicated]))
+    # agreeing layouts: silent
+    art_sharded2 = dsp.ProgramArtifact(
+        name="b_step", hlo=art_sharded.hlo,
+        mesh_axes={"data": 4}, declared_sharding=decl)
+    assert dsp.check_sharding_consistency(
+        [art_sharded, art_sharded2]) == []
+
+
+def test_param_bytes_ratchet_trips_dss803(cpu_devices):
+    """A re-replication that the declaration ALSO weakened (so DSS801
+    cannot fire) still trips the baseline ratchet: the recorded
+    per-device figure is the contract."""
+    mesh = make_mesh({"data": 4}, devices=cpu_devices[:4])
+    x = jnp.zeros((512, 1024), jnp.float32)
+    nb = x.size * 4
+    hlo_rep = _jit_param_program(mesh, x, P()).as_text()
+    # the declaration says replicated (weakened), matching the compile
+    decl = _declared("zero2|data4", {"data": 4}, params=[(nb, [], 1)])
+    art = dsp.ProgramArtifact(
+        name="train_step", hlo=hlo_rep,
+        mesh_axes={"data": 4}, declared_sharding=decl)
+    assert dsp.verify_program(art) == []          # DSS801 blind here
+    key = dsp.sharding_metric_key("zero2|data4", "train_step")
+    # baseline recorded the ÷4 era: ×4 growth far exceeds tolerance
+    diags = dsp.check_sharding_ratchet([art], {key: nb / 4})
+    assert rule_ids(diags) == ["DSS803"]
+    assert f"grew {nb // 4} -> {nb}" in diags[0].message
+    # within tolerance (same figure): silent; no recorded key: silent
+    assert dsp.check_sharding_ratchet([art], {key: float(nb)}) == []
+    assert dsp.check_sharding_ratchet([art], {}) == []
+    # ... and sharding_metrics records exactly this key
+    assert dsp.sharding_metrics([art]) == {key: float(nb)}
+
+
+def test_unavailable_sharding_parser_is_loud_dss804(monkeypatch):
+    """If profiling.sharding cannot import, a program WITH a declared
+    spec must report DSS804 ('UNVERIFIED'), not silently verify clean
+    — the DSP614 contract applied to residency."""
+    monkeypatch.setattr(dsp, "_load_sharding", lambda: None)
+    art = dsp.ProgramArtifact(
+        name="train_step", hlo="HloModule m, entry\n",
+        mesh_axes={"data": 4},
+        declared_sharding=_declared("zero2|data4", {"data": 4},
+                                    params=[(1 << 21, ["data"], 4)]))
+    diags = dsp.verify_program(art)
+    assert rule_ids(diags) == ["DSS804"]
+    assert "UNVERIFIED" in diags[0].message
+    # warning severity: the planner's error-count gate ignores it, but
+    # the CLI still fails fresh (only --baseline can absolve it) — the
+    # same contract as DSP614
+    assert diags[0].severity == "warning"
+    # no declaration -> nothing to verify, no noise
+    bare = dsp.ProgramArtifact(name="p", hlo="HloModule m, entry\n")
+    assert dsp.verify_program(bare) == []
+
+
+def test_declared_sharding_sidecar_roundtrip(cpu_devices, tmp_path):
+    """The engine's declared spec survives ProgramDumper → sidecar →
+    offline load byte-identically, and the offline report carries the
+    per-device residency receipt."""
+    engine = _program_engine(cpu_devices, tmp_path)
+    engine.train_batch(iter([random_batches(1, 16, HIDDEN, seed=7)[0]]))
+    engine.close()
+    progdir = tmp_path / "run" / "programs"
+    side = json.loads((progdir / "train_step.json").read_text())
+    decl = side["declared_sharding"]
+    assert decl["tag"] == "zero2|data4"
+    assert set(decl["families"]) >= {"params", "master", "optimizer"}
+    for fam in ("params", "master", "optimizer"):
+        assert decl["families"][fam]["total_bytes"] > 0
+        assert decl["families"][fam]["leaves"]
+    # offline load agrees byte-for-byte with the sidecar
+    arts = {a.name: a
+            for a in dsp.load_run_artifacts(str(tmp_path / "run"))}
+    assert arts["train_step"].declared_sharding == decl
+    # the offline report prices residency from the same artifacts
+    from deepspeed_tpu.profiling.verify import verify_run_dir
+    offline = verify_run_dir(tmp_path / "run")
+    assert offline["violations"] == 0
+    sh = offline["sharding"]["train_step"]
+    assert sh["param_bytes_per_device"] > 0
+    assert sh["param_shard_divisor"] >= 1
+    # ... and the CLI path stays clean over the same run dir
+    assert dslint_main(["--programs", str(tmp_path / "run")]) == 0
+
+
+def test_malformed_declared_sharding_sidecar_exits_2(tmp_path, capsys):
+    """A type-tampered declared_sharding must fail the CLI loudly
+    (exit 2), never quietly disable the DSS8xx reconciliation."""
+    progdir = tmp_path / "programs"
+    progdir.mkdir()
+    (progdir / "p.hlo").write_text("HloModule m, entry\n")
+    (progdir / "p.json").write_text(json.dumps(
+        {"artifact_schema_version": 1, "program": "p",
+         "declared_sharding": "zero2|data4"}))     # string, not object
+    assert dslint_main(["--programs", str(tmp_path)]) == 2
+    assert "malformed program sidecar" in capsys.readouterr().err
+    (progdir / "p.json").write_text(json.dumps(
+        {"artifact_schema_version": 1, "program": "p",
+         "declared_sharding": {"tag": "t", "families": 3}}))
+    assert dslint_main(["--programs", str(tmp_path)]) == 2
+    (progdir / "p.json").write_text(json.dumps(
+        {"artifact_schema_version": 1, "program": "p",
+         "declared_sharding": {"tag": "t",
+                               "families": {"params": {"leaves": 5}}}}))
+    assert dslint_main(["--programs", str(tmp_path)]) == 2
+    # absent field (a pre-DSS8 sidecar): loads and verifies clean
+    (progdir / "p.json").write_text(json.dumps(
+        {"artifact_schema_version": 1, "program": "p"}))
+    assert dslint_main(["--programs", str(tmp_path)]) == 0

@@ -77,7 +77,12 @@ def test_zero2_dp_tp_step_programs_verify_clean(cpu_devices, tmp_path):
         model=SimpleModel(HIDDEN, nlayers=2), config=cfg, mesh=mesh)
     engine.train_batch(iter([random_batches(1, 16, HIDDEN, seed=0)[0]]))
     assert engine.flat.master_provenance == "jit_copy"
-    _assert_clean(engine, run_dir=tmp_path / "run")
+    report = _assert_clean(engine, run_dir=tmp_path / "run")
+    # round 17: the sharding auditor ran (declared spec reconciled
+    # against the compiled layout) and priced the step's residency
+    sh = report["sharding"]["train_step"]
+    assert sh["param_bytes_per_device"] > 0
+    assert sh["param_shard_divisor"] >= 1
     engine.close()
 
 
@@ -310,3 +315,58 @@ def test_offload_serialized_control_trips_dso702_and_ratchet(
     assert dslint_main(["--programs", str(tmp_path / "run_off")]) == 1
     assert dslint_main(["--programs", str(tmp_path / "run_off"),
                         "--baseline", CHECKED_IN_BASELINE]) == 1
+
+
+def test_serving_decode_programs_verify_clean(cpu_devices, tmp_path):
+    """Round-17 serving leg of the self-verify suite: the paged-KV
+    decode/prefill programs carry a declared spec (``serve|data1`` —
+    replicated serve weights + KV cache) and verify clean on BOTH
+    surfaces, with the decode program's residency receipt priced (the
+    ``serving_param_bytes_per_device`` field bench_serving quotes)."""
+    import json
+
+    import jax
+
+    from deepspeed_tpu.inference import InferenceEngine
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHeadTPU
+    from deepspeed_tpu.tools.dslint import programs as dsp
+
+    model = GPT2LMHeadTPU(GPT2Config(
+        vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+        max_position_embeddings=64, embd_dropout=0.0, attn_dropout=0.0,
+        resid_dropout=0.0))
+    params = model.init(jax.random.PRNGKey(0))
+    cfg = {
+        "inference": {"kv_block_size": 8, "kv_blocks": 64,
+                      "max_batch_slots": 2, "max_seq_len": 64,
+                      "prefill_buckets": [16], "token_budget": 256,
+                      "max_new_tokens": 4},
+        "steps_per_print": 10 ** 9,
+        "telemetry": {"enabled": True, "run_dir": str(tmp_path / "run")},
+        "profiling": {"comm_ledger": True, "memory_ledger": True},
+    }
+    engine = InferenceEngine(model, params, config=cfg)
+    rng = np.random.default_rng(0)
+    for i in range(3):
+        engine.submit([int(t) for t in rng.integers(0, 256, size=8)],
+                      request_id=f"r{i}")
+    engine.run()
+    report = engine.verify_programs()
+    assert report is not None and report["violations"] == 0, [
+        d.format() for d in report["diagnostics"] if not d.suppressed]
+    sh = report["sharding"]["serve_decode"]
+    assert sh["param_bytes_per_device"] > 0
+    assert sh["param_shard_divisor"] == 1        # single-chip serve
+    engine.close()
+    # the sidecar carries the serve-tagged declaration; offline load
+    # agrees and the bare --programs CLI stays clean
+    side = json.loads((tmp_path / "run" / "programs" /
+                       "serve_decode.json").read_text())
+    decl = side["declared_sharding"]
+    assert decl["tag"] == "serve|data1"
+    assert set(decl["families"]) == {"params", "kv_cache"}
+    assert decl["families"]["kv_cache"]["total_bytes"] > 0
+    arts = {a.name: a
+            for a in dsp.load_run_artifacts(str(tmp_path / "run"))}
+    assert arts["serve_decode"].declared_sharding == decl
+    assert dslint_main(["--programs", str(tmp_path / "run")]) == 0

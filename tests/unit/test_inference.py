@@ -126,6 +126,20 @@ class TestScheduler:
             # prompt exceeds the largest prefill bucket
             sched.submit(Request("r", list(range(40)), 2))
 
+    def test_submit_rejects_budget_overflow_at_submit_time(self):
+        """A request whose worst case exceeds the TOKEN BUDGET (not
+        just max_seq_len) can never be admitted: FIFO admission would
+        park it at the queue head and starve everything behind it
+        forever.  Loud ValueError at submit, not a silent hang."""
+        sched, _ = self.make(token_budget=32)
+        with pytest.raises(ValueError, match="token_budget"):
+            sched.submit(Request("r", [1] * 16, 32))   # worst 48 > 32
+        assert sched.queue_depth == 0                  # nothing parked
+        # exactly at the budget: queues and admits normally
+        sched.submit(Request("ok", [1] * 16, 16))      # worst 32 == 32
+        ok = sched.try_admit()
+        assert ok is not None and ok.request_id == "ok"
+
     def test_fifo_admission_and_token_budget(self):
         sched, _ = self.make(token_budget=24)
         sched.submit(Request("a", [1] * 10, 8))   # worst case 18

@@ -1,0 +1,131 @@
+"""Regenerate ``tools/dslint_fixtures/`` and ``tools/dslint_baseline.json``.
+
+The checked-in fixture sidecars are the program artifacts the
+``dslint --all`` composite gate verifies on every CI run (and the
+baseline's ratchet metrics — DSO704 exposed wire, DSO705 attribution,
+DSS803 per-device parameter bytes — are recorded FROM them).  They are
+dumps of the exact engines ``tests/unit/test_dsverify_self.py``
+compiles fresh each run:
+
+- ``offload_injit``  — dp=1 streamed offload (``DS_OFFLOAD_FORCE_INJIT``,
+  uniform 1 MiB chunks, bf16 host state + error feedback), the
+  ``_offload_engine`` fixture;
+- ``zero2_overlap``  — dp=4 bucketed-exchange ZeRO-2
+  (reduce_bucket_size=140000 / allgather_bucket_size=280000), the
+  ``_zero2_overlap_engine`` fixture.
+
+Keeping the geometries identical matters: ``test_dsverify_self`` runs
+its FRESH compiles against the checked-in baseline expecting exit 0, so
+every recorded metric must reproduce from a fresh compile of the same
+model (SimpleModel(256, nlayers=8)) on this toolchain.
+
+Run from the repo root after any change that legitimately moves a
+recorded metric (then commit the diff):
+
+    python tools/regen_dslint_fixtures.py
+"""
+
+import os
+import shutil
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tools", "dslint_fixtures")
+BASELINE = os.path.join(REPO, "tools", "dslint_baseline.json")
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+# the offload fixture streams in-jit on CPU (the TPU-path test mode)
+os.environ["DS_OFFLOAD_FORCE_INJIT"] = "1"
+
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+
+def _build_engines(tmp):
+    import jax
+
+    import deepspeed_tpu as deepspeed
+    import deepspeed_tpu.runtime.zero.coordinator as coord
+    from deepspeed_tpu.parallel import make_mesh
+    from unit.simple_model import SimpleModel, base_config, random_batches
+
+    # small grouped host buffers, as in test_dsverify_self
+    coord.HOST_GROUP_BYTES = 2 << 20
+    devices = jax.devices()
+
+    def cfg(run_name, **overrides):
+        c = base_config(
+            steps_per_print=10 ** 9,
+            telemetry={"enabled": True,
+                       "run_dir": os.path.join(tmp, run_name)},
+            profiling={"comm_ledger": True, "memory_ledger": True})
+        c.update(overrides)
+        return c
+
+    runs = {}
+
+    # -- offload_injit: the _offload_engine fixture -------------------
+    c = cfg("offload_injit", zero_optimization={
+        "stage": 2, "cpu_offload": True, "offload_chunk_mb": 1,
+        "offload_uniform_chunks": True, "offload_overlap": "auto",
+        "offload_state_dtype": {"master": "bf16", "momentum": "bf16",
+                                "variance": "bf16",
+                                "error_feedback": True}})
+    mesh = make_mesh({"data": 1}, devices=devices[:1])
+    engine, *_ = deepspeed.initialize(
+        model=SimpleModel(256, nlayers=8), config=c, mesh=mesh)
+    engine.train_batch(iter([random_batches(
+        1, engine.train_micro_batch_size_per_gpu(), 256, seed=0)[0]]))
+    engine.close()
+    runs["offload_injit"] = os.path.join(tmp, "offload_injit")
+
+    # -- zero2_overlap: the _zero2_overlap_engine fixture -------------
+    c = cfg("zero2_overlap",
+            zero_optimization={"stage": 2, "overlap_comm": True,
+                               "reduce_bucket_size": 140000,
+                               "allgather_bucket_size": 280000},
+            gradient_clipping=1.0)
+    mesh = make_mesh({"data": 4}, devices=devices[:4])
+    engine, *_ = deepspeed.initialize(
+        model=SimpleModel(256, nlayers=8), config=c, mesh=mesh)
+    engine.train_batch(iter([random_batches(
+        1, engine.train_micro_batch_size_per_gpu() * 4, 256,
+        seed=0)[0]]))
+    engine.close()
+    runs["zero2_overlap"] = os.path.join(tmp, "zero2_overlap")
+    return runs
+
+
+def main():
+    from deepspeed_tpu.tools.dslint.cli import main as dslint_main
+
+    with tempfile.TemporaryDirectory() as tmp:
+        runs = _build_engines(tmp)
+        for name, run_dir in runs.items():
+            src = os.path.join(run_dir, "programs")
+            dst = os.path.join(FIXTURES, name, "programs")
+            if not os.path.isdir(src):
+                print(f"error: no programs dumped under {run_dir}",
+                      file=sys.stderr)
+                return 1
+            shutil.rmtree(os.path.join(FIXTURES, name),
+                          ignore_errors=True)
+            shutil.copytree(src, dst)
+            print(f"fixture {name}: {len(os.listdir(dst))} file(s)")
+    rc = dslint_main(["--baseline", BASELINE, "--update-baseline"]
+                     + [a for name in sorted(runs)
+                        for a in ("--programs",
+                                  os.path.join(FIXTURES, name))])
+    if rc != 0:
+        return rc
+    print(f"baseline rewritten: {BASELINE}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
