@@ -5,15 +5,20 @@ program dumper, DSP6xx verifier, attribution doctor, EVENT telemetry).
 
 from .config import DeepSpeedInferenceConfig
 from .engine import DECODE_PROGRAM, InferenceEngine, prefill_program_name
+from .frontend import ServingFrontend, ServingOverloadError
 from .kv_cache import (NULL_BLOCK, BlockAllocator, init_kv_cache,
                        kv_cache_bytes)
 from .model import build_decode, build_prefill, reference_generate
-from .scheduler import (ContinuousBatchScheduler, Request, REASON_EOS,
-                        REASON_LENGTH)
+from .resilience import (ServingHealth, arm_serving_preemption,
+                         serving_hang_quorum)
+from .scheduler import (ContinuousBatchScheduler, Request, REASON_DEADLINE,
+                        REASON_EOS, REASON_LENGTH)
 
 __all__ = ["DeepSpeedInferenceConfig", "DECODE_PROGRAM", "InferenceEngine",
-           "prefill_program_name", "NULL_BLOCK", "BlockAllocator",
+           "prefill_program_name", "ServingFrontend",
+           "ServingOverloadError", "NULL_BLOCK", "BlockAllocator",
            "init_kv_cache", "kv_cache_bytes", "build_decode",
-           "build_prefill", "reference_generate",
-           "ContinuousBatchScheduler", "Request", "REASON_EOS",
-           "REASON_LENGTH"]
+           "build_prefill", "reference_generate", "ServingHealth",
+           "arm_serving_preemption", "serving_hang_quorum",
+           "ContinuousBatchScheduler", "Request", "REASON_DEADLINE",
+           "REASON_EOS", "REASON_LENGTH"]

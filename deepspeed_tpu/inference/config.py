@@ -41,6 +41,18 @@ class DeepSpeedInferenceConfig:
         self.weights_dtype = str(get_scalar_param(
             inf, C.INFERENCE_WEIGHTS_DTYPE,
             C.INFERENCE_WEIGHTS_DTYPE_DEFAULT))
+        self.request_deadline_ms = int(get_scalar_param(
+            inf, C.INFERENCE_REQUEST_DEADLINE_MS,
+            C.INFERENCE_REQUEST_DEADLINE_MS_DEFAULT))
+        self.max_queue_depth = int(get_scalar_param(
+            inf, C.INFERENCE_MAX_QUEUE_DEPTH,
+            C.INFERENCE_MAX_QUEUE_DEPTH_DEFAULT))
+        self.degrade_queue_depth = int(get_scalar_param(
+            inf, C.INFERENCE_DEGRADE_QUEUE_DEPTH,
+            C.INFERENCE_DEGRADE_QUEUE_DEPTH_DEFAULT))
+        self.degraded_max_new_tokens = int(get_scalar_param(
+            inf, C.INFERENCE_DEGRADED_MAX_NEW_TOKENS,
+            C.INFERENCE_DEGRADED_MAX_NEW_TOKENS_DEFAULT))
         self._check()
 
     def _check(self):
@@ -67,6 +79,23 @@ class DeepSpeedInferenceConfig:
         assert self.weights_dtype in ("float32", "bfloat16"), (
             f"inference.weights_dtype must be 'float32' or 'bfloat16', "
             f"got {self.weights_dtype!r}")
+        assert self.request_deadline_ms >= 0, (
+            "inference.request_deadline_ms must be >= 0 (0 disables)")
+        assert self.max_queue_depth >= 0, (
+            "inference.max_queue_depth must be >= 0 (0 = unbounded)")
+        assert self.degrade_queue_depth >= 0, (
+            "inference.degrade_queue_depth must be >= 0 (0 disables)")
+        assert 0 < self.degraded_max_new_tokens <= self.max_new_tokens, (
+            f"inference.degraded_max_new_tokens "
+            f"({self.degraded_max_new_tokens}) must be in "
+            f"[1, max_new_tokens={self.max_new_tokens}] — degradation "
+            "shortens answers, it never lengthens them")
+        if self.max_queue_depth and self.degrade_queue_depth:
+            assert self.degrade_queue_depth <= self.max_queue_depth, (
+                f"inference.degrade_queue_depth "
+                f"({self.degrade_queue_depth}) must not exceed "
+                f"max_queue_depth ({self.max_queue_depth}) — degradation "
+                "is the pressure valve BEFORE shedding, not after")
 
     @property
     def max_blocks_per_seq(self):

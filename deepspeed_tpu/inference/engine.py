@@ -136,6 +136,10 @@ class InferenceEngine:
         self.generated_tokens = 0
         self._results = {}
         self._next_request_id = 0
+        self._health = None          # ServingHealth, via attach_health
+        self._pending_fingerprint = None
+        self._draining = False
+        self._closed = False
         if self.telemetry.enabled:
             self.telemetry.emit(TEL.EVENT_RUN_START, world_size=1,
                                 mode="serving", **{
@@ -179,21 +183,55 @@ class InferenceEngine:
     # ------------------------------------------------------------------
     # request front-end
     # ------------------------------------------------------------------
-    def submit(self, prompt, max_new_tokens=None, request_id=None):
+    def submit(self, prompt, max_new_tokens=None, request_id=None,
+               deadline_ms=None):
         """Queue one generation request; returns its id.  Rejects (by
         raising) prompts longer than the largest prefill bucket and
         requests whose worst case exceeds ``max_seq_len`` — at
-        SUBMISSION, never mid-serve."""
+        SUBMISSION, never mid-serve.  ``deadline_ms`` overrides the
+        configured ``inference.request_deadline_ms`` for this request
+        (0 = no deadline)."""
+        if self._draining:
+            raise RuntimeError(
+                "InferenceEngine is draining (close()/SIGTERM): "
+                "admission is stopped; route this request elsewhere")
         if request_id is None:
             request_id = f"req-{self._next_request_id}"
             self._next_request_id += 1
+        ms = (deadline_ms if deadline_ms is not None
+              else self.inference_config.request_deadline_ms)
         request = Request(
             request_id, prompt,
             max_new_tokens if max_new_tokens is not None
-            else self.inference_config.max_new_tokens)
+            else self.inference_config.max_new_tokens,
+            deadline_at=(time.monotonic() + ms / 1000.0 if ms else None))
         self.scheduler.submit(request)
         self._results[request_id] = request
         return request_id
+
+    def resubmit(self, request):
+        """Admit a router-requeued :class:`Request` (already through
+        ``reset_for_requeue``): same validation as :meth:`submit`, but
+        the request object — and with it the id, the original prompt,
+        and the requeue count — survives the replica hop."""
+        if self._draining:
+            raise RuntimeError(
+                "InferenceEngine is draining (close()/SIGTERM): "
+                "admission is stopped; route this request elsewhere")
+        self.scheduler.submit(request)
+        self._results[request.request_id] = request
+        return request.request_id
+
+    def request(self, request_id):
+        """The live :class:`Request` behind an id (None if unknown) —
+        the front-end's handle for harvest/requeue decisions."""
+        return self._results.get(request_id)
+
+    def forget(self, request_id):
+        """Drop a request from this engine's result map (the front-end
+        moved it to another replica; leaving it here would double-count
+        it in this engine's receipts)."""
+        self._results.pop(request_id, None)
 
     # ------------------------------------------------------------------
     # the serve loop
@@ -233,10 +271,24 @@ class InferenceEngine:
             queue_depth=self.scheduler.queue_depth)
         self.telemetry.counter("serving/finished").inc()
 
+    def _emit_deadline(self, request):
+        if not self.telemetry.enabled:
+            return
+        self.telemetry.emit(
+            TEL.EVENT_SERVING, step=self.decode_iterations,
+            kind="deadline", request=request.request_id,
+            generated_tokens=len(request.generated),
+            queue_depth=self.scheduler.queue_depth)
+        self.telemetry.counter("serving/deadline_expired").inc()
+
     def _decode_once(self):
         """One continuous-batch decode iteration over the active slots.
         The single ``device_get`` here is the serve loop's OWN next-token
-        fetch — the baseline the zero-added-syncs test measures against."""
+        fetch — the baseline the zero-added-syncs test measures against.
+        With a health plane attached, the cadence iterations fold the
+        re-computed weight-fingerprint scalar INTO that same fetch (one
+        batched ``device_get``), so the full resilience plane holds the
+        count at baseline."""
         icfg = self.inference_config
         sched = self.scheduler
         t_prep = time.monotonic()
@@ -254,12 +306,27 @@ class InferenceEngine:
             ctx_lens[request.slot] = request.context_len - 1
             tokens[request.slot] = request.generated[-1]
             before.append(request)
+        fp_dev = None
+        if self._health is not None:
+            # liveness tick for ENTERING this iteration (throttled O(1)
+            # publish; a wedged decode never refreshes it again)
+            self._health.beat(self.decode_iterations + 1)
+            if (self.decode_iterations + 1) % self.steps_per_print == 0:
+                fp_dev = self._health.fingerprint_device()
         t0 = time.monotonic()
         self._driver_latencies.record(t0 - t_prep)
         next_dev, self._k_cache, self._v_cache = self._decode(
             self.params, self._k_cache, self._v_cache, tables, ctx_lens,
             tokens)
-        next_tokens = jax.device_get(next_dev)
+        # ONE host sync per decode iteration, cadence or not: the weight
+        # fingerprint (when due) rides the same batched fetch as the
+        # sampled tokens, so arming the resilience plane adds zero
+        # device_get calls (the zero-added-syncs test counts them)
+        fetched = jax.device_get((next_dev,) if fp_dev is None
+                                 else (next_dev, fp_dev))
+        next_tokens = fetched[0]
+        if fp_dev is not None:
+            self._pending_fingerprint = int(fetched[1])
         now = time.monotonic()
         self._step_latencies.record(now - t0)
         self.decode_iterations += 1
@@ -308,25 +375,59 @@ class InferenceEngine:
                 self.telemetry.emit(TEL.EVENT_ATTRIBUTION,
                                     step=self.decode_iterations, **receipt)
 
+    def _sample_integrity(self):
+        """Print-cadence health sample: hand the fingerprint scalar the
+        batched decode fetch already transferred to the health plane —
+        publish, fleet read, majority vote (dslint DSH205 pins the
+        publish/read APIs to this cadence statically).  Raises
+        :class:`~deepspeed_tpu.resilience.constants.FleetIntegrityError`
+        (respawnable exit 87) when the vote convicts a replica."""
+        if self._health is None or self._pending_fingerprint is None:
+            return
+        fingerprint, self._pending_fingerprint = \
+            self._pending_fingerprint, None
+        self._health.note_weight_fingerprint(fingerprint)
+
     def step(self):
-        """One engine iteration: recycle finished slots, admit from the
-        queue (each admission prefills immediately), then advance every
-        active slot one token.  Returns the requests finished DURING
-        this iteration."""
+        """One engine iteration: expire deadlines, recycle finished
+        slots, admit from the queue (each admission prefills
+        immediately), then advance every active slot one token.
+        Returns the requests finished DURING this iteration."""
         sched = self.scheduler
-        finished = sched.sweep_finished(self.inference_config.eos_token_id)
+        finished = sched.sweep_deadlines()
         for request in finished:
+            self._emit_deadline(request)
+        for request in sched.sweep_finished(
+                self.inference_config.eos_token_id):
             self._emit_finish(request)
-        while True:
+            finished.append(request)
+        while not self._draining:
             request = sched.try_admit()
             if request is None:
                 break
-            self._run_prefill(request)
+            try:
+                self._run_prefill(request)
+            except BaseException:
+                # a prefill that raises after admission must not strand
+                # the slot + block grant it was just handed (the
+                # blocks-conserved invariant): release everything and
+                # surface the fault
+                sched.abort(request)
+                raise
+        # a prefill can already satisfy a request (max_new_tokens=1, or
+        # the prefill token IS eos): sweep before decoding, else the
+        # slot advances one token past its contract — and an eos landed
+        # at prefill would be buried under the extra token and missed
+        for request in sched.sweep_finished(
+                self.inference_config.eos_token_id):
+            self._emit_finish(request)
+            finished.append(request)
         if sched.active_count:
             self._decode_once()
         if (self.decode_iterations
                 and self.decode_iterations % self.steps_per_print == 0):
             self._sample_telemetry()
+            self._sample_integrity()
         return finished
 
     def run(self):
@@ -455,6 +556,69 @@ class InferenceEngine:
 
         return verify_engine_programs(self)
 
-    def close(self):
+    # ------------------------------------------------------------------
+    # resilience plane (inference/resilience.py)
+    # ------------------------------------------------------------------
+    def attach_health(self, health):
+        """Arm the serving health plane (heartbeats per decode
+        iteration + weight-fingerprint consensus on the print cadence)
+        and start its peer monitor.  Zero added per-token host syncs:
+        the fingerprint rides the decode loop's existing next-token
+        fetch."""
+        self._health = health
+        health.start()
+        return health
+
+    def drain(self, deadline_secs=None):
+        """Stop admission and finish the in-flight decodes up to a
+        bounded deadline (``DS_TERM_DRAIN_DEADLINE_SECS`` contract;
+        ``<= 0`` drains unbounded).  Queued-but-unadmitted requests
+        stay queued — a router requeues them onto surviving replicas;
+        this engine only owes the sequences already holding KV state.
+        Returns the requests that finished during the drain."""
+        from .resilience import drain_deadline_secs
+
+        self._draining = True
+        if deadline_secs is None:
+            deadline_secs = drain_deadline_secs()
+        deadline = (time.monotonic() + float(deadline_secs)
+                    if deadline_secs and float(deadline_secs) > 0
+                    else None)
+        if self.telemetry.enabled:
+            self.telemetry.emit(
+                TEL.EVENT_SERVING, step=self.decode_iterations,
+                kind="drain", active=self.scheduler.active_count,
+                queued=self.scheduler.queue_depth,
+                deadline_secs=(float(deadline_secs)
+                               if deadline is not None else None))
+        drained = []
+        while self.scheduler.active_count:
+            if deadline is not None and time.monotonic() >= deadline:
+                logger.warning(
+                    "serving drain hit the %.1fs deadline with %d "
+                    "request(s) still decoding; abandoning them "
+                    "(the router re-serves anything undelivered)",
+                    float(deadline_secs), self.scheduler.active_count)
+                break
+            drained.extend(self.step())
+        for request in self.scheduler.sweep_finished(
+                self.inference_config.eos_token_id):
+            self._emit_finish(request)
+            drained.append(request)
+        return drained
+
+    def close(self, reason="serve_done"):
+        """Shut the engine down respawnably: stop admission, drain the
+        in-flight decodes up to the bounded deadline, stop the health
+        plane, flush + close telemetry.  Idempotent (the SIGTERM
+        handler and a normal exit path may both call it)."""
+        if self._closed:
+            return
+        self._closed = True
+        if self.scheduler.active_count:
+            self.drain()
+        self._draining = True
+        if self._health is not None:
+            self._health.stop()
         # TelemetryManager.close emits the EVENT_RUN_END itself
-        self.telemetry.close(reason="serve_done")
+        self.telemetry.close(reason=reason)

@@ -33,6 +33,7 @@ FINISHED = "finished"
 # finish reasons
 REASON_EOS = "eos"
 REASON_LENGTH = "max_new_tokens"
+REASON_DEADLINE = "deadline"
 
 
 class Request:
@@ -41,14 +42,18 @@ class Request:
     Timing fields are host wall-clock (``time.monotonic``): ``submitted``
     at entry, ``first_token_at`` when prefill emits (TTFT), ``step_times``
     one per generated token (the per-token latency record the serving
-    bench quotes p50/p99 from)."""
+    bench quotes p50/p99 from).  ``deadline_at`` is an absolute
+    monotonic expiry (None = no deadline): the scheduler's deadline
+    sweep finishes an expired request with ``reason="deadline"`` and
+    the partial tokens it generated so far."""
 
     __slots__ = ("request_id", "prompt", "max_new_tokens", "state",
                  "generated", "blocks", "slot", "bucket", "submitted",
                  "first_token_at", "finished_at", "finish_reason",
-                 "step_times")
+                 "step_times", "deadline_at", "requeues")
 
-    def __init__(self, request_id, prompt, max_new_tokens):
+    def __init__(self, request_id, prompt, max_new_tokens,
+                 deadline_at=None):
         assert len(prompt) > 0, "empty prompt"
         self.request_id = request_id
         self.prompt = [int(t) for t in prompt]
@@ -63,6 +68,33 @@ class Request:
         self.finished_at = None
         self.finish_reason = None
         self.step_times = []
+        self.deadline_at = deadline_at
+        self.requeues = 0
+
+    def reset_for_requeue(self):
+        """Return the request to a pristine QUEUED state for re-serving
+        on another replica after its original replica died.  The KV
+        cache died with the replica, so everything derived from serving
+        — generated tokens, block grant, slot/bucket assignment, timing
+        — is discarded; prefill recomputes it all, and greedy decode
+        determinism makes the re-served tokens bit-identical.  The
+        block list is just CLEARED, never released: the grant belonged
+        to the dead replica's allocator (a live allocator must never be
+        handed another pool's block ids — the leak class the
+        blocks-conserved invariant test pins)."""
+        assert self.state != FINISHED, (
+            f"request {self.request_id!r} already finished; a completed "
+            "result is never re-served (exactly-once)")
+        self.state = QUEUED
+        self.generated = []
+        self.blocks = []
+        self.slot = None
+        self.bucket = None
+        self.first_token_at = None
+        self.finished_at = None
+        self.finish_reason = None
+        self.step_times = []
+        self.requeues += 1
 
     @property
     def context_len(self):
@@ -127,6 +159,11 @@ class ContinuousBatchScheduler:
     # -- admission ------------------------------------------------------
     def submit(self, request):
         icfg = self.icfg
+        assert not request.blocks and request.slot is None, (
+            f"request {request.request_id!r} submitted while still "
+            "holding a block grant/slot — a requeued request must go "
+            "through reset_for_requeue() first (a stale grant would be "
+            "silently overwritten at admission and leak from its pool)")
         if request.worst_case_tokens() > icfg.max_seq_len:
             raise ValueError(
                 f"request {request.request_id!r}: prompt "
@@ -171,13 +208,29 @@ class ContinuousBatchScheduler:
                                                              bucket))
         if blocks is None:
             return None
-        self.waiting.popleft()
-        request.state = ACTIVE
-        request.slot = free_slots[0]
-        request.bucket = bucket
-        request.blocks = blocks
-        self.slots[request.slot] = request
-        self.admitted_total += 1
+        try:
+            self.waiting.popleft()
+            request.state = ACTIVE
+            request.slot = free_slots[0]
+            request.bucket = bucket
+            request.blocks = blocks
+            self.slots[request.slot] = request
+            self.admitted_total += 1
+        except BaseException:
+            # every early exit past the allocator grant MUST return the
+            # blocks to the pool — a raise here would otherwise strand
+            # the grant forever (the allocator has no owner to reclaim
+            # from; the blocks-conserved invariant test pins this)
+            self.allocator.release(blocks)
+            if request.slot is not None \
+                    and self.slots[request.slot] is request:
+                self.slots[request.slot] = None
+            request.blocks = []
+            request.slot = None
+            request.bucket = None
+            if request.state == ACTIVE:
+                request.state = QUEUED
+            raise
         return request
 
     def block_table_row(self, request):
@@ -200,6 +253,38 @@ class ContinuousBatchScheduler:
         request.finished_at = time.monotonic()
         self.finished_total += 1
 
+    def _finish_queued(self, request, reason):
+        """Finish a request that never got a slot (expired while
+        waiting): no blocks or slot to release, just the lifecycle
+        bookkeeping."""
+        request.state = FINISHED
+        request.finish_reason = reason
+        request.finished_at = time.monotonic()
+        self.finished_total += 1
+
+    def abort(self, request):
+        """Forcibly release whatever the request holds — slot, block
+        grant, queue position — WITHOUT finishing it (state returns to
+        QUEUED, generated tokens are dropped by the caller's
+        ``reset_for_requeue``).  The failure-recovery primitive: a
+        prefill that raised after admission, or a replica front-end
+        reclaiming a dead engine's in-flight work, must leave the
+        allocator conserved (free == initial on idle) or every fault
+        permanently shrinks the KV pool."""
+        if request.state == ACTIVE:
+            assert self.slots[request.slot] is request
+            self.slots[request.slot] = None
+            self.allocator.release(request.blocks)
+        elif request.state == QUEUED:
+            try:
+                self.waiting.remove(request)
+            except ValueError:
+                pass
+        request.blocks = []
+        request.slot = None
+        request.bucket = None
+        request.state = QUEUED
+
     def sweep_finished(self, eos_token_id):
         """Mark every slot that hit its cap or emitted EOS; returns the
         finished requests."""
@@ -214,4 +299,27 @@ class ContinuousBatchScheduler:
             elif len(request.generated) >= request.max_new_tokens:
                 self.finish(request, REASON_LENGTH)
                 done.append(request)
+        return done
+
+    def sweep_deadlines(self, now=None):
+        """Finish every request — active OR still queued — whose
+        wall-clock deadline has passed, with ``reason="deadline"`` and
+        whatever tokens it generated so far.  Active slots and their
+        block grants recycle mid-batch exactly like an EOS finish, so
+        the queue head behind a stuck-slow batch gets the freed
+        capacity the very next admission pass."""
+        now = time.monotonic() if now is None else now
+        done = []
+        for request in list(self.slots):
+            if request is None or request.deadline_at is None:
+                continue
+            if now >= request.deadline_at:
+                self.finish(request, REASON_DEADLINE)
+                done.append(request)
+        for request in [r for r in self.waiting
+                        if r.deadline_at is not None
+                        and now >= r.deadline_at]:
+            self.waiting.remove(request)
+            self._finish_queued(request, REASON_DEADLINE)
+            done.append(request)
         return done

@@ -169,6 +169,75 @@ class ChaosMonkey:
         self.log.append((f"{field}[{idx}]", "bitflip"))
         return idx, bit
 
+    def bitflip_params(self, engine):
+        """Serving-side SDC: flip ONE seeded bit of one element of one
+        seeded leaf of ``engine.params`` (the inference engine's weight
+        pytree).  Greedy decode is deterministic, so from this moment
+        the corrupted replica's tokens silently diverge from its
+        siblings' — no crash, no NaN — and only the serving plane's
+        cross-replica weight-fingerprint consensus can name it.
+        Returns ``(leaf_index, flat_index, bit)`` for the post-mortem."""
+        import jax  # lazy: chaos planning stays importable without jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(engine.params)
+        leaf_i = int(self._rng.integers(0, len(leaves)))
+        buf = leaves[leaf_i]
+        host = np.array(jax.device_get(buf))   # owned, writable copy
+        flat = host.reshape(-1).view(
+            np.dtype(f"u{host.dtype.itemsize}"))
+        idx = int(self._rng.integers(0, flat.size))
+        bit = int(self._rng.integers(0, flat.dtype.itemsize * 8))
+        flat[idx] ^= flat.dtype.type(1 << bit)
+        sharding = getattr(buf, "sharding", None)
+        leaves[leaf_i] = (jax.device_put(host, sharding)
+                          if sharding is not None
+                          else jax.device_put(host))
+        engine.params = jax.tree_util.tree_unflatten(treedef, leaves)
+        self.log.append((f"params[{leaf_i}][{idx}]", "bitflip"))
+        return leaf_i, idx, bit
+
+    def wrap_engine_step(self, engine, kill_steps=(), kill_signal=None,
+                         hang_steps=(), hang_event=None, hang_secs=None,
+                         bitflip_steps=(), rank=0, target_rank=None):
+        """Serving twin of :meth:`wrap_iter`: monkeypatch
+        ``engine.step`` so faults fire at the given STEP-CALL indices
+        (0-based count of front-end iterations on this replica).  The
+        fault menu mirrors the serving chaos e2e's three legs — kill
+        (host loss mid-serve: SIGKILL, no handler, KV cache gone),
+        hang (one decode iteration wedges; the peers' freshness-quorum
+        heartbeat must convict THIS replica, not time out N times),
+        and bitflip (:meth:`bitflip_params` — silent weight corruption
+        only the fingerprint vote can see).  Rank-targeting works as in
+        :meth:`wrap_iter`: same seeded schedule fleet-wide, only the
+        ``target_rank`` process injects.  Returns the wrapped engine."""
+        kill_steps = frozenset(kill_steps)
+        hang_steps = frozenset(hang_steps)
+        bitflip_steps = frozenset(bitflip_steps)
+        if kill_signal is None:
+            kill_signal = signal.SIGKILL
+        targeted = target_rank is None or int(rank) == int(target_rank)
+        inner_step = engine.step
+        counter = {"i": 0}
+
+        def chaotic_step():
+            i = counter["i"]
+            counter["i"] += 1
+            if i in kill_steps and targeted:
+                self.log.append((i, "kill"))
+                os.kill(os.getpid(), kill_signal)
+            if i in hang_steps and targeted:
+                self.log.append((i, "hang"))
+                if hang_event is not None:
+                    hang_event.wait()
+                elif hang_secs is not None:
+                    time.sleep(hang_secs)
+            if i in bitflip_steps and targeted:
+                self.bitflip_params(engine)
+            return inner_step()
+
+        engine.step = chaotic_step
+        return engine
+
     # --------------------------------------------- checkpoint-level faults
     def corrupt_checkpoint(self, ckpt_dir,
                            filename=ckpt_const.OPTIM_STATES_NPZ, nbytes=1):

@@ -595,7 +595,8 @@ class FleetHeartbeat:
 
     def __init__(self, run_dir, rank, fleet_size, peer_timeout_secs,
                  poll_interval=None, min_publish_secs=0.2, exit_fn=None,
-                 on_fire=None, action="evict"):
+                 on_fire=None, action="evict", quorum_fn=None,
+                 verdict_kind=KIND_HANG):
         assert peer_timeout_secs > 0, "peer timeout must be > 0"
         assert action in INTEGRITY_ACTIONS, (
             f"integrity action {action!r} not one of {INTEGRITY_ACTIONS}")
@@ -624,6 +625,16 @@ class FleetHeartbeat:
         self._thread = None
         self.fired = False
         self.last_verdict = None
+        # the verdict function over the fleet's heartbeat map.  Default:
+        # the training quorum (step-position + staleness).  A serving
+        # fleet decodes independent request streams whose iteration
+        # counters are incomparable, so it substitutes a freshness-
+        # majority quorum (inference/resilience.serving_hang_quorum)
+        # with the same (fleet, self_rank, fleet_size, timeout)
+        # signature and verdict-dict shape.
+        self._quorum_fn = quorum_fn if quorum_fn is not None \
+            else hang_quorum
+        self._verdict_kind = verdict_kind
 
     # ------------------------------------------------------------------
     def start(self):
@@ -703,8 +714,8 @@ class FleetHeartbeat:
                 self._last_published_step = step
             fleet = read_fleet_heartbeats(self.run_dir,
                                           world_size=self.fleet_size)
-            verdict = hang_quorum(fleet, self.rank, self.fleet_size,
-                                  self.peer_timeout_secs)
+            verdict = self._quorum_fn(fleet, self.rank, self.fleet_size,
+                                      self.peer_timeout_secs)
             if verdict is None:
                 continue
             self.fired = True
@@ -733,8 +744,8 @@ class FleetHeartbeat:
                         logger.error("heartbeat on_fire hook failed: %s",
                                      e)
                 continue
-            write_verdict(self.run_dir, KIND_HANG, verdict["suspect"],
-                          detail, rank=self.rank,
+            write_verdict(self.run_dir, self._verdict_kind,
+                          verdict["suspect"], detail, rank=self.rank,
                           step=verdict["head_step"])
             logger.error(
                 "fleet heartbeat: hang quorum — %s; exiting %d "

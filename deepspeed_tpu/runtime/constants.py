@@ -554,6 +554,27 @@ INFERENCE_EOS_TOKEN_ID_DEFAULT = -1
 # checkpoint dtype (the CPU-parity setting)
 INFERENCE_WEIGHTS_DTYPE = "weights_dtype"
 INFERENCE_WEIGHTS_DTYPE_DEFAULT = "float32"
+# per-request wall-clock deadline in milliseconds: a request still
+# queued or decoding when it expires is finished with
+# reason="deadline" and its result carries the partial tokens; its
+# slot/blocks recycle mid-batch.  0 disables (no deadline).
+INFERENCE_REQUEST_DEADLINE_MS = "request_deadline_ms"
+INFERENCE_REQUEST_DEADLINE_MS_DEFAULT = 0
+# front-end admission bound: a submit() arriving while this many
+# requests are already queued (across the replica fleet) is SHED with
+# a typed overload error instead of queueing unboundedly.  0 disables
+# (unbounded queue — the single-engine default).
+INFERENCE_MAX_QUEUE_DEPTH = "max_queue_depth"
+INFERENCE_MAX_QUEUE_DEPTH_DEFAULT = 0
+# graceful degradation threshold: at or past this queue depth the
+# front-end caps each new request's max_new_tokens at
+# degraded_max_new_tokens, trading answer length for admission rate
+# before shedding starts.  0 disables.
+INFERENCE_DEGRADE_QUEUE_DEPTH = "degrade_queue_depth"
+INFERENCE_DEGRADE_QUEUE_DEPTH_DEFAULT = 0
+# the degraded generation cap applied past degrade_queue_depth
+INFERENCE_DEGRADED_MAX_NEW_TOKENS = "degraded_max_new_tokens"
+INFERENCE_DEGRADED_MAX_NEW_TOKENS_DEFAULT = 4
 
 #############################################
 # Config validation (dslint schema; new — reference config.py:432 only

@@ -87,12 +87,18 @@ EVENT_ELASTIC = "elastic"
 # says what voted ("fingerprint" majority vote vs "hang_quorum"
 # staleness); ``suspects`` names the ranks a non-ok verdict indicts
 EVENT_INTEGRITY = "integrity"
-# serving subsystem (inference/engine): ``kind`` selects the payload
-# shape — "admit" (a request entered the continuous batch: prompt
-# tokens, prefill bucket, block grant, slot), "finish" (a slot was
-# recycled mid-batch: finish reason, generated tokens), "queue" (the
-# steps_per_print-cadence occupancy snapshot: queue depth, active
-# slots, free KV blocks, reserved token budget)
+# serving subsystem (inference/engine + frontend + resilience): ``kind``
+# selects the payload shape — "admit" (a request entered the continuous
+# batch: prompt tokens, prefill bucket, block grant, slot), "finish" (a
+# slot was recycled mid-batch: finish reason, generated tokens), "queue"
+# (the steps_per_print-cadence occupancy snapshot: queue depth, active
+# slots, free KV blocks, reserved token budget).  The resilience plane
+# adds: "deadline" (a request's wall-clock deadline expired; partial
+# tokens returned), "shed" (admission refused at max_queue_depth),
+# "degrade" (generation cap dropped under queue pressure), "requeue" (a
+# dead replica's in-flight request reset and re-dispatched), "evict" (a
+# replica convicted by hang quorum or weight-fingerprint consensus),
+# "drain" (SIGTERM/close bounded drain of the in-flight batch)
 EVENT_SERVING = "serving"
 
 # type -> required data keys.  The report CLI and the golden-schema test

@@ -279,6 +279,55 @@ def integrity_summary(records):
     return lines
 
 
+# the EVENT_SERVING kinds that belong to the resilience plane (routing
+# verdicts), as opposed to the decode plane's admit/finish/queue flow
+_SERVING_RESILIENCE_KINDS = ("deadline", "shed", "degrade", "requeue",
+                             "evict", "drain")
+
+
+def serving_resilience_summary(records):
+    """The serving-resilience story in one block: how many requests were
+    shed / degraded / requeued / deadline-expired, plus every replica
+    eviction and drain with its detail line.  Returned empty when the
+    run emitted none of the resilience kinds — plain serving runs and
+    training runs skip the section entirely."""
+    serving = [r for r in align_records(records)
+               if r.get("type") == ev.EVENT_SERVING
+               and r.get("data", {}).get("kind")
+               in _SERVING_RESILIENCE_KINDS]
+    if not serving:
+        return []
+    counts = {}
+    for rec in serving:
+        kind = rec["data"]["kind"]
+        counts[kind] = counts.get(kind, 0) + 1
+    lines = ["  " + " ".join(f"{k}={counts.get(k, 0)}"
+                             for k in _SERVING_RESILIENCE_KINDS)]
+    for rec in serving:
+        d = rec.get("data", {})
+        kind = d.get("kind")
+        if kind == "requeue":
+            detail = (f"requeue: request {d.get('request')} off dead "
+                      f"replica {d.get('replica')} (attempt "
+                      f"{d.get('requeues')}, backoff "
+                      f"{d.get('backoff_secs', 0.0):.2f}s)")
+        elif kind == "shed":
+            detail = (f"shed: queue depth {d.get('queue_depth')} at "
+                      f"max_queue_depth {d.get('max_queue_depth')}")
+        elif kind == "evict":
+            detail = (f"evict: replica {d.get('suspect')} convicted "
+                      f"({d.get('reason', d.get('detail', '?'))})")
+        elif kind == "drain":
+            detail = (f"drain: {d.get('active')} active + "
+                      f"{d.get('queued')} queued, deadline "
+                      f"{d.get('deadline_secs')}s")
+        else:
+            continue  # deadline/degrade are counted, not itemized
+        rel = rec.get("_rel", rec.get("ts", 0.0))
+        lines.append(f"  t=+{rel:9.3f}s rank={rec.get('rank')} {detail}")
+    return lines
+
+
 def comm_program_table(records):
     """Per-program collective table from ``comm``/``program`` events
     (latest event wins per (stream, program))."""
@@ -489,6 +538,11 @@ def generate_report(run_dir, strict=False, comm=False, doctor=False,
         out.append("")
         out.append("fleet integrity (fingerprint consensus + hang quorum):")
         out.extend(integrity_lines)
+    serving_lines = serving_resilience_summary(records)
+    if serving_lines:
+        out.append("")
+        out.append("serving resilience (shed / requeue / evict / drain):")
+        out.extend(serving_lines)
     out.append("")
     out.append("step metrics:")
     out.extend(summarize_step_metrics(records))
@@ -570,6 +624,13 @@ def report_json(run_dir, strict=False, doctor=False,
             if rec.get("type") == ev.EVENT_INTEGRITY
             and rec.get("data", {}).get("verdict") not in (None, "ok",
                                                            "pending")],
+        "serving_resilience": [
+            {"rank": rec.get("rank"), "step": rec.get("step"),
+             **rec.get("data", {})}
+            for rec in align_records(records)
+            if rec.get("type") == ev.EVENT_SERVING
+            and rec.get("data", {}).get("kind")
+            in _SERVING_RESILIENCE_KINDS],
         "events": records,
     }
     if doctor:

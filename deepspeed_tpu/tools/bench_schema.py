@@ -197,6 +197,20 @@ FIELDS = {
     "serving_param_bytes_per_device": (numbers.Integral,
                                        "materialized per-device weight "
                                        "bytes of the decode program"),
+    # serving resilience receipts (round 18, inference/frontend via
+    # examples/bench_serving.py): the self-healing plane's ledger —
+    # requeues after replica death, sheds at the admission bound,
+    # expired deadlines, and the worst-case re-serve latency
+    "serving_requeued_requests": (numbers.Integral,
+                                  "requests re-served after a replica "
+                                  "death (exactly-once requeue)"),
+    "serving_shed_requests": (numbers.Integral,
+                              "submits refused at max_queue_depth"),
+    "serving_deadline_expired": (numbers.Integral,
+                                 "requests finished by deadline expiry"),
+    "serving_recovery_latency_seconds": (numbers.Real,
+                                         "worst replica-death -> last "
+                                         "requeued-result latency"),
 }
 
 # multichip leg fields: leg_<name>_<field>
@@ -252,6 +266,13 @@ _LEG_FIELDS = {
     "per_token_p50_seconds": numbers.Real,
     "tokens_per_second_per_chip": numbers.Real,
     "programs_compiled": numbers.Integral,
+    # serving_chaos leg (round 18): the in-process self-healing receipt
+    # — requests re-served exactly-once after the seeded eviction, the
+    # consensus verdicts that fired, and the completed-set size
+    "requeued_requests": numbers.Integral,
+    "integrity_violations": numbers.Integral,
+    "completed_requests": numbers.Integral,
+    "recovery_latency_seconds": numbers.Real,
     "error": str,
     "note": str,
 }
@@ -374,6 +395,10 @@ THRESHOLDS = {
     "serving_peak_hbm_bytes": ("lower", 0.10),
     "serving_predicted_temp_bytes": ("lower", 0.10),
     "serving_param_bytes_per_device": ("lower", 0.10),
+    # serving resilience receipts (round 18): counters are
+    # informational (they scale with the bench's injected faults, not
+    # with code quality); the exactly-once property itself is gated in
+    # the serving_chaos leg via parity_mismatches
 }
 
 # thresholds for the pattern-based leg_<name>_<field> family
@@ -397,6 +422,9 @@ _LEG_FIELD_THRESHOLDS = {
     # the virtual-CPU dryrun mesh
     "parity_mismatches": ("lower", 0.0),
     "requests": ("higher", 0.0),
+    # serving_chaos leg (round 18): an undetected seeded fault is a
+    # regression (the in-leg assert already pins the exact counts)
+    "integrity_violations": ("lower", 0.0),
     # onebit compressed-path receipts (round 14): more wire (or a
     # grown ratio) = the compression is leaking dense collectives
     "compressed_wire_bytes": ("lower", 0.25),

@@ -376,7 +376,14 @@ def _sync_properties(index: ModuleIndex, cls_name: str):
 # at O(1) throttled host work): print-cadence-only by contract
 _SKEW_EXPORT_CALLS = {"latency_snapshot", "publish_rank_latency",
                       "read_fleet_latencies", "publish_rank_fingerprint",
-                      "read_fleet_fingerprints", "note_fingerprint"}
+                      "read_fleet_fingerprints", "note_fingerprint",
+                      # serving twin (inference/resilience.py): the
+                      # weight-fingerprint publish/read/vote surface —
+                      # file I/O per call, print-cadence-only by the
+                      # same contract
+                      "publish_weight_fingerprint",
+                      "read_fleet_weight_fingerprints",
+                      "note_weight_fingerprint"}
 
 
 def _is_skew_export(node: ast.Call) -> bool:

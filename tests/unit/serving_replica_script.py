@@ -1,0 +1,252 @@
+"""Serving-fleet child script for the serving chaos e2e tests.
+
+Driven by ``deepspeed_tpu.launcher.launch`` with the elastic supervisor
+armed.  Every process is one serving replica: a full copy of the tiny
+GPT-2 on one virtual CPU device behind an :class:`InferenceEngine` with
+the resilience plane armed (``ServingHealth`` heartbeats + weight-
+fingerprint consensus into the launcher's shared ``DS_TELEMETRY_DIR``,
+``arm_serving_preemption`` for the SIGTERM drain).
+
+The fleet serves ONE shared, seeded request set with an exactly-once
+ledger protocol:
+
+- every life appends finished results to its own ``results-<pid>.jsonl``
+  (O_APPEND, one flushed JSON line per request) in the shared out dir;
+- at life start a replica unions every ledger into the done-set, sorts
+  the remaining request ids, and serves the slice ``remaining[rank ::
+  world]`` — disjoint within a life, re-planned each life, so a resized
+  fleet picks up exactly the dead replicas' unfinished work;
+- a replica whose slice is drained PARKS: it keeps beating (a clean
+  early finisher must never read as hung to the quorum) and keeps
+  voting the fingerprint consensus at a throttled cadence, exiting 0
+  only once the union covers every request;
+- a replica convicted of SDC by the consensus deletes its OWN current
+  life's ledger before exiting 87: every token it served since the flip
+  is suspect, and deleting the ledger re-queues them onto healthy
+  replicas (re-served greedily => bit-identical to the reference).
+
+Chaos (first life per slot only, seeded, one target rank), selected by
+``DS_SERVE_CHAOS_KIND``:
+
+- ``kill``  — the target SIGKILLs itself mid-decode at engine iteration
+  ``DS_SERVE_CHAOS_STEP``: the supervisor sees the signal death and
+  resizes; survivors drain under SIGTERM and the next life re-serves
+  the dead replica's remainder.
+- ``hang``  — the target wedges before that iteration (beats stop); the
+  PARKED/serving majority's freshness quorum convicts it, exits 87
+  with a verdict, and the supervisor aims the resize at its slot.
+- ``bitflip`` — one seeded bit of the target's weights flips; the next
+  fingerprint cadence names it, the fleet exits 87, the target deletes
+  its suspect ledger, and the resized fleet re-serves its requests.
+
+argv: <out_dir>   (telemetry/run dir rides DS_TELEMETRY_DIR)
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax  # noqa: E402,F401 — fail fast before engine construction
+
+from deepspeed_tpu.inference import (InferenceEngine,  # noqa: E402
+                                     ServingHealth,
+                                     arm_serving_preemption)
+from deepspeed_tpu.inference.resilience import (  # noqa: E402
+    read_fleet_weight_fingerprints)
+from deepspeed_tpu.resilience.chaos import ChaosMonkey  # noqa: E402
+from deepspeed_tpu.resilience.constants import (  # noqa: E402
+    FleetIntegrityError, TrainingDivergedError)
+from deepspeed_tpu.resilience import integrity as integ  # noqa: E402
+
+from test_inference import (seeded_prompts, serve_config,  # noqa: E402
+                            tiny_model)
+
+STEPS_PER_PRINT = 2          # fingerprint-vote cadence (decode iters)
+
+
+def _env_int(name, default=0):
+    return int(os.environ.get(name, "") or default)
+
+
+def _env_float(name, default=0.0):
+    return float(os.environ.get(name, "") or default)
+
+
+def request_set():
+    """The fleet-wide request set: (rid -> prompt), rid-sorted ids.
+    Seed/count/cap come from the env so the TEST builds the identical
+    set for its uninterrupted reference."""
+    n = _env_int("DS_SERVE_REQUESTS", 9)
+    seed = _env_int("DS_SERVE_SEED", 71)
+    prompts = seeded_prompts(n, seed=seed)
+    return {f"req-{i:03d}": p for i, p in enumerate(prompts)}
+
+
+def read_done(out_dir):
+    """Union of every life's ledger: rid -> record.  Torn trailing
+    lines (a writer died mid-append) parse as garbage and are skipped —
+    an unparsable record is NOT done and gets re-served."""
+    done = {}
+    for name in sorted(os.listdir(out_dir)):
+        if not name.startswith("results-"):
+            continue
+        with open(os.path.join(out_dir, name)) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                    done[rec["rid"]] = rec
+                except (ValueError, KeyError):
+                    continue
+    return done
+
+
+def main():
+    out_dir = sys.argv[1]
+    os.makedirs(out_dir, exist_ok=True)
+    rank = _env_int("DS_PROCESS_ID", 0)
+    world = _env_int("DS_NUM_PROCESSES", 1)
+    slot = _env_int("DS_LOCAL_RANK", 0)
+    tel_dir = os.environ["DS_TELEMETRY_DIR"]
+    max_new = _env_int("DS_SERVE_MAX_NEW", 4)
+
+    # first-life-per-slot marker: chaos is a one-shot fault injection,
+    # respawned lives on the same slot must serve clean
+    marker = os.path.join(out_dir, f"chaos-armed-slot{slot}")
+    fresh = not os.path.exists(marker)
+    with open(marker, "a"):
+        pass
+
+    config = serve_config(max_new_tokens=max_new)
+    config["steps_per_print"] = STEPS_PER_PRINT
+    config["telemetry"] = {"enabled": True, "run_dir": tel_dir}
+    model = tiny_model()
+    engine = InferenceEngine(model, model.init(jax.random.PRNGKey(0)),
+                             config=config)
+
+    # warm up EVERY prefill bucket + the decode program BEFORE arming
+    # chaos or health: a lazy bucket compile mid-serving stalls the
+    # main thread for seconds — longer than a tight peer timeout — and
+    # the freshness quorum would convict a healthy compiling replica
+    # instead of the wedged one.  Before the first beat this rank is
+    # unpublished and CANNOT be convicted, so compiling here is safe.
+    warm = [f"warmup-{os.getpid()}-{i}" for i in range(3)]
+    for rid, plen in zip(warm, (4, 12, 24)):     # buckets 8 / 16 / 32
+        engine.submit([1] * plen, max_new_tokens=1, request_id=rid)
+    engine.run()
+    for rid in warm:
+        engine.forget(rid)
+
+    kind = os.environ.get("DS_SERVE_CHAOS_KIND", "")
+    target = _env_int("DS_SERVE_CHAOS_TARGET", -1)
+    step = _env_int("DS_SERVE_CHAOS_STEP", 3)
+    if fresh and kind:
+        monkey = ChaosMonkey(seed=_env_int("DS_SERVE_CHAOS_SEED", 19))
+        monkey.wrap_engine_step(
+            engine,
+            kill_steps=[step] if kind == "kill" else (),
+            hang_steps=[step] if kind == "hang" else (),
+            hang_secs=600.0,
+            bitflip_steps=[step] if kind == "bitflip" else (),
+            rank=rank, target_rank=target)
+
+    health = ServingHealth(
+        engine, tel_dir, rank, world,
+        peer_timeout_secs=_env_float("DS_SERVE_PEER_TIMEOUT", 30.0))
+    engine.attach_health(health)
+
+    # startup fingerprint barrier: publish THIS replica's (healthy)
+    # fingerprint and wait until the whole fleet has published.  All
+    # values are equal here, so the vote is OK/PENDING — but a later
+    # post-flip vote is then guaranteed a full voter set: with only 2
+    # of 3 voters on disk, a corrupt-vs-healthy tie would read as
+    # NO_MAJORITY and POISON the fleet instead of evicting the suspect
+    health.sample()
+    barrier_deadline = time.time() + 60
+    while (len(read_fleet_weight_fingerprints(tel_dir, world)) < world
+           and time.time() < barrier_deadline):
+        time.sleep(0.05)
+
+    ledger_path = os.path.join(out_dir, f"results-{os.getpid()}.jsonl")
+    written = set()
+
+    def flush_finished(f):
+        """Append every finished-but-unwritten result: one flushed line
+        per request, so a death at any instant loses at most one torn
+        (=> skipped, => re-served) record."""
+        for rid in list(mine):
+            if rid in written:
+                continue
+            req = engine.request(rid)
+            if req is None or req.state != "finished":
+                continue
+            rec = req.result()
+            f.write(json.dumps({
+                "rid": rid, "tokens": rec["tokens"],
+                "finish_reason": rec["finish_reason"],
+                "rank": rank, "life": os.getpid()}) + "\n")
+            f.flush()
+            written.add(rid)
+
+    all_requests = request_set()
+    done = read_done(out_dir)
+    remaining = sorted(r for r in all_requests if r not in done)
+    mine = remaining[rank::world]
+
+    def drain_exit(code):
+        # SIGTERM drain (resize/preemption): arm_serving_preemption
+        # already ran engine.close() — persist whatever the drain
+        # finished, then die respawnable
+        try:
+            with open(ledger_path, "a") as f:
+                flush_finished(f)
+        finally:
+            os._exit(code)
+
+    arm_serving_preemption(engine, exit_fn=drain_exit)
+
+    try:
+        with open(ledger_path, "a") as f:
+            for rid in mine:
+                engine.submit(all_requests[rid], max_new_tokens=max_new,
+                              request_id=rid)
+            while not engine.scheduler.idle():
+                engine.step()
+                flush_finished(f)
+            flush_finished(f)
+            # PARK: slice drained, fleet still serving.  Keep beating
+            # (a clean finisher must stay "fresh" to the hang quorum)
+            # and keep voting the consensus at a throttled cadence; a
+            # flip landing after our last decode is still convicted.
+            it = engine.decode_iterations
+            while set(read_done(out_dir)) < set(all_requests):
+                it += 1
+                health.beat(it)
+                if it % 20 == 0:
+                    health.sample()
+                time.sleep(0.05)
+    except (FleetIntegrityError, TrainingDivergedError) as e:
+        suspect = getattr(e, "suspect", None)
+        if (getattr(e, "kind", None) == integ.KIND_SDC
+                and suspect is not None and int(suspect) == rank):
+            # every token this life served since the flip is suspect:
+            # withdraw the whole life's ledger so healthy replicas
+            # re-serve it (greedy decode => bit-identical re-serve)
+            try:
+                os.remove(ledger_path)
+            except OSError:
+                pass
+        sys.exit(e.exit_code)
+
+    engine.close()
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

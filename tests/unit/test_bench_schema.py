@@ -100,6 +100,42 @@ def test_round15_integrity_leg_fields_validate_and_gate():
     assert validate_record({"leg_integrity_evicted_rank": "two"}) != []
 
 
+def test_round18_serving_resilience_fields_validate_and_gate():
+    """The self-healing serving receipts: the serving_chaos leg's
+    exactly-once requeue counts, plus the top-level requeue/shed/
+    recovery fields ``bench_serving`` quotes.  An undetected seeded
+    fault (``leg_*_integrity_violations``) is a gated regression; the
+    raw counters stay informational — they scale with how much chaos
+    the bench injects, not with code quality."""
+    from deepspeed_tpu.tools.bench_schema import threshold_for
+
+    record = {
+        "metric": "dryrun_multichip",
+        "leg_serving_chaos_status": "ok",
+        "leg_serving_chaos_evicted_rank": 1,
+        "leg_serving_chaos_requeued_requests": 3,
+        "leg_serving_chaos_completed_requests": 9,
+        "leg_serving_chaos_parity_mismatches": 0,
+        "leg_serving_chaos_integrity_violations": 0,
+        "leg_serving_chaos_recovery_latency_seconds": 0.011,
+        "serving_requeued_requests": 3,
+        "serving_shed_requests": 2,
+        "serving_deadline_expired": 0,
+        "serving_recovery_latency_seconds": 0.007,
+    }
+    assert validate_record(record) == []
+    assert threshold_for(
+        "leg_serving_chaos_integrity_violations") == ("lower", 0.0)
+    assert threshold_for(
+        "leg_serving_chaos_parity_mismatches") == ("lower", 0.0)
+    # counters are informational: never gated numerically
+    assert threshold_for("serving_requeued_requests") == (None, None)
+    assert threshold_for(
+        "leg_serving_chaos_requeued_requests") == (None, None)
+    assert validate_record(
+        {"serving_recovery_latency_seconds": "slow"}) != []
+
+
 def test_unknown_and_mistyped_fields_are_flagged():
     probs = validate_record({
         "offload_gpt2_large_host_state_bytes_per_step": "lots",

@@ -514,7 +514,7 @@ def test_bench_diff_self_check_covers_multichip_history(capsys):
     through the regression gate's --self-check (report-only, exit 0)."""
     from deepspeed_tpu.tools import bench_diff
 
-    artifacts = sorted(glob.glob(os.path.join(REPO, "MULTICHIP_r0*.json")))
+    artifacts = sorted(glob.glob(os.path.join(REPO, "MULTICHIP_r*.json")))
     assert len(artifacts) >= 2
     assert bench_diff.main(["--self-check", *artifacts]) == 0
     out = capsys.readouterr().out

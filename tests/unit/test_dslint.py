@@ -449,6 +449,40 @@ class TrainEngine:
     assert ids == ["DSH205"]
 
 
+def test_dsh205_serving_fingerprint_unguarded_is_flagged(tmp_path):
+    # PR 18: the serving plane's weight-fingerprint twin
+    # (inference/resilience.py) carries the same cadence-only contract
+    # — publish/read/vote per decode iteration is a host round-trip
+    # multiplier on the token hot path
+    ids = lint_source(tmp_path, """
+from inference.resilience import (publish_weight_fingerprint,
+                                  read_fleet_weight_fingerprints)
+
+class InferenceEngine:
+    def step(self):
+        publish_weight_fingerprint(self._run_dir, 0, self._fp)
+        fleet = read_fleet_weight_fingerprints(self._run_dir, 4)
+""")
+    assert ids and set(ids) == {"DSH205"}
+
+
+def test_dsh205_serving_fingerprint_guarded_is_clean(tmp_path):
+    # the engine's real shape: note_weight_fingerprint reachable only
+    # through the steps_per_print cadence guard; the per-iteration
+    # health beat stays unflagged (heartbeats are per-step by design)
+    ids = lint_source(tmp_path, """
+class InferenceEngine:
+    def _sample_integrity(self):
+        self._health.note_weight_fingerprint(self._pending)
+
+    def step(self):
+        self._health.beat(self.decode_iterations)
+        if self.decode_iterations % self.steps_per_print() == 0:
+            self._sample_integrity()
+""")
+    assert ids == []
+
+
 def test_non_engine_class_is_not_driver_scope(tmp_path):
     # benchmarks/profilers sync deliberately; only Engine/Scaler classes
     # carry step-cadence semantics

@@ -448,7 +448,7 @@ def test_multichip_r07_artifact_carries_dsp_receipt():
 
     newest = sorted(glob.glob(os.path.join(
         os.path.dirname(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__)))), "MULTICHIP_r0*.json")))[-1]
+            os.path.abspath(__file__)))), "MULTICHIP_r*.json")))[-1]
     rec = load_bench_record(newest)
     if "dsp_violations" not in rec:
         pytest.skip("driver artifact predates the dsp receipt")
