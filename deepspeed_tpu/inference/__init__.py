@@ -9,6 +9,9 @@ from .frontend import ServingFrontend, ServingOverloadError
 from .kv_cache import (NULL_BLOCK, BlockAllocator, init_kv_cache,
                        kv_cache_bytes)
 from .model import build_decode, build_prefill, reference_generate
+from .observability import (SERVING_PHASE_KEYS,
+                            SERVING_TRACE_SCHEMA_VERSION,
+                            ServingObservability, mint_trace_id)
 from .resilience import (ServingHealth, arm_serving_preemption,
                          serving_hang_quorum)
 from .scheduler import (ContinuousBatchScheduler, Request, REASON_DEADLINE,
@@ -21,4 +24,6 @@ __all__ = ["DeepSpeedInferenceConfig", "DECODE_PROGRAM", "InferenceEngine",
            "build_prefill", "reference_generate", "ServingHealth",
            "arm_serving_preemption", "serving_hang_quorum",
            "ContinuousBatchScheduler", "Request", "REASON_DEADLINE",
-           "REASON_EOS", "REASON_LENGTH"]
+           "REASON_EOS", "REASON_LENGTH", "SERVING_PHASE_KEYS",
+           "SERVING_TRACE_SCHEMA_VERSION", "ServingObservability",
+           "mint_trace_id"]

@@ -53,6 +53,12 @@ class DeepSpeedInferenceConfig:
         self.degraded_max_new_tokens = int(get_scalar_param(
             inf, C.INFERENCE_DEGRADED_MAX_NEW_TOKENS,
             C.INFERENCE_DEGRADED_MAX_NEW_TOKENS_DEFAULT))
+        slo = inf.get(C.INFERENCE_SLO, {}) or {}
+        self.slo_ttft_ms = float(get_scalar_param(
+            slo, C.INFERENCE_SLO_TTFT_MS, C.INFERENCE_SLO_TTFT_MS_DEFAULT))
+        self.slo_per_token_ms = float(get_scalar_param(
+            slo, C.INFERENCE_SLO_PER_TOKEN_MS,
+            C.INFERENCE_SLO_PER_TOKEN_MS_DEFAULT))
         self._check()
 
     def _check(self):
@@ -90,6 +96,10 @@ class DeepSpeedInferenceConfig:
             f"({self.degraded_max_new_tokens}) must be in "
             f"[1, max_new_tokens={self.max_new_tokens}] — degradation "
             "shortens answers, it never lengthens them")
+        assert self.slo_ttft_ms >= 0, (
+            "inference.slo.ttft_ms must be >= 0 (0 disables)")
+        assert self.slo_per_token_ms >= 0, (
+            "inference.slo.per_token_ms must be >= 0 (0 disables)")
         if self.max_queue_depth and self.degrade_queue_depth:
             assert self.degrade_queue_depth <= self.max_queue_depth, (
                 f"inference.degrade_queue_depth "

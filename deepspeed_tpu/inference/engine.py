@@ -42,6 +42,7 @@ from ..utils.logging import logger
 from .config import DeepSpeedInferenceConfig
 from .kv_cache import BlockAllocator, init_kv_cache
 from .model import build_decode, build_prefill
+from .observability import ServingObservability, mint_trace_id
 from .scheduler import ContinuousBatchScheduler, Request
 
 # one string shared with the step pricer (profiling/comm.py), so the
@@ -133,6 +134,11 @@ class InferenceEngine:
         self._step_latencies = StepLatencyRing()
         self._driver_latencies = StepLatencyRing()
         self.decode_iterations = 0
+        # the serving observability plane: lifecycle tracing, occupancy
+        # windows, SLO/goodput accounting.  Always constructed — every
+        # hook is host arithmetic that no-ops emission when telemetry
+        # is off, and the bench receipt needs the accumulators either way
+        self.observability = ServingObservability(self)
         self.generated_tokens = 0
         self._results = {}
         self._next_request_id = 0
@@ -184,13 +190,15 @@ class InferenceEngine:
     # request front-end
     # ------------------------------------------------------------------
     def submit(self, prompt, max_new_tokens=None, request_id=None,
-               deadline_ms=None):
+               deadline_ms=None, trace_id=None):
         """Queue one generation request; returns its id.  Rejects (by
         raising) prompts longer than the largest prefill bucket and
         requests whose worst case exceeds ``max_seq_len`` — at
         SUBMISSION, never mid-serve.  ``deadline_ms`` overrides the
         configured ``inference.request_deadline_ms`` for this request
-        (0 = no deadline)."""
+        (0 = no deadline).  ``trace_id`` joins this request into an
+        existing lifecycle trace (a routing front-end mints one before
+        the shed decision); None mints a fresh one here."""
         if self._draining:
             raise RuntimeError(
                 "InferenceEngine is draining (close()/SIGTERM): "
@@ -198,15 +206,25 @@ class InferenceEngine:
         if request_id is None:
             request_id = f"req-{self._next_request_id}"
             self._next_request_id += 1
+        minted_here = trace_id is None
+        if minted_here:
+            trace_id = mint_trace_id()
         ms = (deadline_ms if deadline_ms is not None
               else self.inference_config.request_deadline_ms)
         request = Request(
             request_id, prompt,
             max_new_tokens if max_new_tokens is not None
             else self.inference_config.max_new_tokens,
-            deadline_at=(time.monotonic() + ms / 1000.0 if ms else None))
+            deadline_at=(time.monotonic() + ms / 1000.0 if ms else None),
+            trace_id=trace_id)
         self.scheduler.submit(request)
         self._results[request_id] = request
+        if minted_here:
+            # a front-end that minted the trace already emitted the
+            # submit record (before its shed decision); bare-engine
+            # submits start the trace here
+            self.observability.note_submit(request,
+                                           self.scheduler.queue_depth)
         return request_id
 
     def resubmit(self, request):
@@ -237,8 +255,8 @@ class InferenceEngine:
     # the serve loop
     # ------------------------------------------------------------------
     def _run_prefill(self, request):
-        icfg = self.inference_config
         sched = self.scheduler
+        t_pre = time.monotonic()
         ids = np.zeros((1, request.bucket), np.int32)
         ids[0, :len(request.prompt)] = request.prompt
         table = np.asarray(sched.block_table_row(request), np.int32)
@@ -252,34 +270,15 @@ class InferenceEngine:
         request.step_times.append(now - request.submitted)
         request.generated.append(token)
         self.generated_tokens += 1
-        if self.telemetry.enabled:
-            self.telemetry.emit(
-                TEL.EVENT_SERVING, step=self.decode_iterations,
-                kind="admit", request=request.request_id,
-                prompt_tokens=len(request.prompt), bucket=request.bucket,
-                blocks=len(request.blocks), slot=request.slot,
-                queue_depth=sched.queue_depth)
-            self.telemetry.counter("serving/admitted").inc()
+        # admit + first_token phase records, admission-wait histogram,
+        # TTFT SLO leg, bucket padding-waste accumulators
+        self.observability.note_prefill(request, now, now - t_pre)
 
     def _emit_finish(self, request):
-        if not self.telemetry.enabled:
-            return
-        self.telemetry.emit(
-            TEL.EVENT_SERVING, step=self.decode_iterations, kind="finish",
-            request=request.request_id, reason=request.finish_reason,
-            generated_tokens=len(request.generated),
-            queue_depth=self.scheduler.queue_depth)
-        self.telemetry.counter("serving/finished").inc()
+        self.observability.note_finish(request)
 
     def _emit_deadline(self, request):
-        if not self.telemetry.enabled:
-            return
-        self.telemetry.emit(
-            TEL.EVENT_SERVING, step=self.decode_iterations,
-            kind="deadline", request=request.request_id,
-            generated_tokens=len(request.generated),
-            queue_depth=self.scheduler.queue_depth)
-        self.telemetry.counter("serving/deadline_expired").inc()
+        self.observability.note_deadline(request)
 
     def _decode_once(self):
         """One continuous-batch decode iteration over the active slots.
@@ -334,6 +333,10 @@ class InferenceEngine:
             request.generated.append(int(next_tokens[request.slot]))
             request.step_times.append(now - t0)
             self.generated_tokens += 1
+        # O(active) host arithmetic on the scalars this loop already
+        # holds (occupancy window sums, P² per-token observations, the
+        # per-token SLO leg) — no device syncs
+        self.observability.note_decode(before, now - t0)
 
     def _sample_telemetry(self):
         """Print-cadence sampling: queue/occupancy gauges, one
@@ -355,6 +358,10 @@ class InferenceEngine:
             queue_depth=sched.queue_depth, active=sched.active_count,
             free_blocks=self.allocator.free_blocks,
             reserved_tokens=sched.reserved_tokens())
+        # close the observability decode window: decode_window + slo
+        # phase records, occupancy/goodput gauges (DSH205: this call is
+        # only legal here, inside the steps_per_print cadence)
+        self.observability.export_serving_window()
         # the same comm/latency snapshot the training engine publishes:
         # it is the measured side the offline doctor reconciles against
         snap = self._step_latencies.latency_snapshot()
@@ -466,7 +473,7 @@ class InferenceEngine:
             start = min(r.submitted for r in finished)
             end = max(r.finished_at for r in finished)
             wall = max(end - start, 1e-9)
-        return {
+        receipt = {
             "requests": len(finished),
             "generated_tokens": self.generated_tokens,
             "decode_iterations": self.decode_iterations,
@@ -477,6 +484,14 @@ class InferenceEngine:
                 self.generated_tokens / wall if wall else None),
             "programs_compiled": len(self.memory_ledger.entries()),
         }
+        # occupancy/SLO/goodput receipt (observability plane); goodput
+        # is re-based onto the same wall clock as the throughput
+        # headline so the two are directly comparable
+        obs = self.observability.receipt()
+        receipt.update(obs)
+        receipt["goodput_tokens_per_second"] = (
+            obs["goodput_tokens"] / wall if wall else None)
+        return receipt
 
     def comm_receipt(self):
         """Collective receipt for ONE decode iteration (count/payload/

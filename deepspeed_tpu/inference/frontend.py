@@ -35,6 +35,7 @@ from collections import deque
 
 from ..telemetry import events as TEL
 from ..utils.logging import logger
+from .observability import SERVING_TRACE_SCHEMA_VERSION, mint_trace_id
 from .scheduler import FINISHED, REASON_DEADLINE
 
 
@@ -63,6 +64,10 @@ class ServingFrontend:
         self._telemetry = (telemetry if telemetry is not None
                            else self.replicas[0].telemetry)
         self.requeue_backoff_secs = float(requeue_backoff_secs)
+        # fleet-gauge export cadence: the replicas' steps_per_print, so
+        # front-end gauges land at the same rhythm as engine samples
+        self.steps_per_print = self.replicas[0].steps_per_print
+        self._steps = 0
         self._owner = {}        # rid -> replica index (unfinished only)
         self._completed = {}    # rid -> result dict (delivered once)
         self._backlog = deque()  # (ready_at, request) awaiting re-dispatch
@@ -88,7 +93,9 @@ class ServingFrontend:
 
     def _emit(self, kind, **data):
         if self._telemetry is not None and self._telemetry.enabled:
-            self._telemetry.emit(TEL.EVENT_SERVING, kind=kind, **data)
+            self._telemetry.emit(TEL.EVENT_SERVING, kind=kind,
+                                 schema=SERVING_TRACE_SCHEMA_VERSION,
+                                 t_mono=time.monotonic(), **data)
 
     def _pick_replica(self):
         live = self.live_replicas()
@@ -103,12 +110,22 @@ class ServingFrontend:
                deadline_ms=None):
         """Admit one request to the fleet; returns its id.  Sheds with
         :class:`ServingOverloadError` at ``max_queue_depth``; degrades
-        the generation cap past ``degrade_queue_depth``."""
+        the generation cap past ``degrade_queue_depth``.  The lifecycle
+        trace id is minted HERE, before the shed decision, so a refused
+        request still leaves a (trace, shed) record — a load-shed storm
+        is attributable per request, not just a counter."""
+        if request_id is None:
+            request_id = f"req-{self._next_request_id}"
+            self._next_request_id += 1
+        trace_id = mint_trace_id()
         depth = self.queue_depth()
+        self._emit("submit", trace=trace_id, request=request_id,
+                   queue_depth=depth)
         if self.icfg.max_queue_depth \
                 and depth >= self.icfg.max_queue_depth:
             self.shed_total += 1
-            self._emit("shed", queue_depth=depth,
+            self._emit("shed", trace=trace_id, request=request_id,
+                       queue_depth=depth,
                        max_queue_depth=self.icfg.max_queue_depth)
             raise ServingOverloadError(
                 f"fleet queue depth {depth} at inference.max_queue_depth "
@@ -122,14 +139,13 @@ class ServingFrontend:
                 and cap > self.icfg.degraded_max_new_tokens:
             cap = self.icfg.degraded_max_new_tokens
             self.degraded_total += 1
-            self._emit("degrade", queue_depth=depth, capped_to=cap)
-        if request_id is None:
-            request_id = f"req-{self._next_request_id}"
-            self._next_request_id += 1
+            self._emit("degrade", trace=trace_id, request=request_id,
+                       queue_depth=depth, capped_to=cap)
         idx = self._pick_replica()
         self.replicas[idx].submit(prompt, max_new_tokens=cap,
                                   request_id=request_id,
-                                  deadline_ms=deadline_ms)
+                                  deadline_ms=deadline_ms,
+                                  trace_id=trace_id)
         self._owner[request_id] = idx
         return request_id
 
@@ -165,8 +181,8 @@ class ServingFrontend:
             self._backlog.append((now + delay, request))
             del self._owner[rid]
             moved.append(rid)
-            self._emit("requeue", request=rid, replica=idx,
-                       requeues=request.requeues,
+            self._emit("requeue", trace=request.trace_id, request=rid,
+                       replica=idx, requeues=request.requeues,
                        backoff_secs=delay)
         self.requeued_total += len(moved)
         if moved:
@@ -208,6 +224,18 @@ class ServingFrontend:
                 if not rec[1] and rec[2] is None:
                     rec[2] = time.monotonic() - rec[0]
 
+    def export_serving_gauges(self):
+        """Standing fleet gauges a scrape can alert on (shed/degrade
+        were events only): queue depth including the requeue backlog,
+        and the live-replica count.  DSH205-registered — callable only
+        under a ``steps_per_print`` guard."""
+        if self._telemetry is None or not self._telemetry.enabled:
+            return
+        self._telemetry.gauge("serving/queue_depth").set(
+            float(self.queue_depth()))
+        self._telemetry.gauge("serving/live_replicas").set(
+            float(len(self.live_replicas())))
+
     def step(self):
         """One front-end iteration: re-dispatch expired backlog, step
         every live replica (an engine that RAISES is declared dead and
@@ -223,6 +251,9 @@ class ServingFrontend:
                 self.mark_dead(idx)
                 continue
             self._harvest(idx)
+        self._steps += 1
+        if self._steps % self.steps_per_print == 0:
+            self.export_serving_gauges()
 
     def run(self, max_steps=100000):
         """Drain the fleet: iterate until every submitted request has a

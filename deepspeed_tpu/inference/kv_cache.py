@@ -42,10 +42,22 @@ class BlockAllocator:
         self.num_blocks = int(num_blocks)
         # LIFO free list, block 0 excluded (the null block)
         self._free = list(range(self.num_blocks - 1, 0, -1))
+        # pool-occupancy high-water mark (allocatable blocks in use at
+        # once, across the run) — the capacity-planning receipt
+        self.used_peak = 0
+
+    @property
+    def capacity(self):
+        """Allocatable blocks (the null block is not allocatable)."""
+        return self.num_blocks - 1
 
     @property
     def free_blocks(self):
         return len(self._free)
+
+    @property
+    def used_blocks(self):
+        return self.capacity - len(self._free)
 
     def allocate(self, n):
         """``n`` block ids, or None when the pool cannot cover them (the
@@ -53,6 +65,8 @@ class BlockAllocator:
         if n > len(self._free):
             return None
         taken = [self._free.pop() for _ in range(n)]
+        if self.used_blocks > self.used_peak:
+            self.used_peak = self.used_blocks
         return taken
 
     def release(self, blocks):

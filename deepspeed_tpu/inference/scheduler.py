@@ -50,10 +50,11 @@ class Request:
     __slots__ = ("request_id", "prompt", "max_new_tokens", "state",
                  "generated", "blocks", "slot", "bucket", "submitted",
                  "first_token_at", "finished_at", "finish_reason",
-                 "step_times", "deadline_at", "requeues")
+                 "step_times", "deadline_at", "requeues", "trace_id",
+                 "admitted_at", "_cached_summary")
 
     def __init__(self, request_id, prompt, max_new_tokens,
-                 deadline_at=None):
+                 deadline_at=None, trace_id=None):
         assert len(prompt) > 0, "empty prompt"
         self.request_id = request_id
         self.prompt = [int(t) for t in prompt]
@@ -70,6 +71,12 @@ class Request:
         self.step_times = []
         self.deadline_at = deadline_at
         self.requeues = 0
+        # the lifecycle trace id: minted once at submit and PRESERVED
+        # across reset_for_requeue, so a replica-death re-serve joins
+        # into one trace in the event stream
+        self.trace_id = trace_id
+        self.admitted_at = None
+        self._cached_summary = None
 
     def reset_for_requeue(self):
         """Return the request to a pristine QUEUED state for re-serving
@@ -95,6 +102,8 @@ class Request:
         self.finish_reason = None
         self.step_times = []
         self.requeues += 1
+        self.admitted_at = None
+        self._cached_summary = None
 
     @property
     def context_len(self):
@@ -104,6 +113,13 @@ class Request:
         return len(self.prompt) + self.max_new_tokens
 
     def result(self):
+        """The request's latency summary.  Computed once and cached when
+        the request is FINISHED (``step_times`` only grows while ACTIVE,
+        so the cache can never go stale; ``reset_for_requeue``
+        invalidates it) — report-cadence sampling of a large in-flight
+        set used to re-sort ``step_times`` on every call."""
+        if self._cached_summary is not None:
+            return self._cached_summary
         lat = sorted(self.step_times)
 
         def pct(p):
@@ -111,17 +127,25 @@ class Request:
                 return None
             return lat[min(len(lat) - 1, int(p * len(lat)))]
 
-        return {
+        summary = {
             "request_id": self.request_id,
+            "trace_id": self.trace_id,
             "tokens": list(self.generated),
             "finish_reason": self.finish_reason,
+            "requeues": self.requeues,
             "ttft_seconds": (self.first_token_at - self.submitted
                              if self.first_token_at is not None else None),
+            "admission_wait_seconds": (
+                self.admitted_at - self.submitted
+                if self.admitted_at is not None else None),
             "latency_seconds": (self.finished_at - self.submitted
                                 if self.finished_at is not None else None),
             "per_token_p50_seconds": pct(0.50),
             "per_token_p99_seconds": pct(0.99),
         }
+        if self.state == FINISHED:
+            self._cached_summary = summary
+        return summary
 
 
 class ContinuousBatchScheduler:
@@ -214,6 +238,7 @@ class ContinuousBatchScheduler:
             request.slot = free_slots[0]
             request.bucket = bucket
             request.blocks = blocks
+            request.admitted_at = time.monotonic()
             self.slots[request.slot] = request
             self.admitted_total += 1
         except BaseException:
@@ -284,6 +309,7 @@ class ContinuousBatchScheduler:
         request.slot = None
         request.bucket = None
         request.state = QUEUED
+        request.admitted_at = None
 
     def sweep_finished(self, eos_token_id):
         """Mark every slot that hit its cap or emitted EOS; returns the

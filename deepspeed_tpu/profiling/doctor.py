@@ -20,6 +20,14 @@ phase the slowest rank's extra time sits in.  Exit 0 on a verdict, 2
 when the run dir holds no usable artifacts (usage error, same
 convention as ``dslint --programs``).
 
+**Serving mode** (automatic when the run dir's event stream carries
+serving lifecycle traces): the doctor joins the schema-versioned
+EVENT_SERVING phase records with the decode program's attribution
+budget to decompose the TAIL request's end-to-end latency into
+queue-wait / prefill / decode-compute / exposed-wire / driver /
+unexplained — and names the dominant phase.  A p99 tail stops being a
+number and becomes a place to look.
+
 Also reachable as ``telemetry report --doctor`` (one section of the
 run report).  All host work on static artifacts — runnable anywhere
 the run dir is mounted.
@@ -109,6 +117,117 @@ def doctor_run_dir(run_dir, grad_accumulation_steps=1,
         "budget": budget,
         "ranks": ranks,
         "straggler": attribution.straggler_explanation(ranks),
+        "serving": serving_tail_decomposition(run_dir, budget),
+    }
+
+
+# ---------------------------------------------------------------------------
+# serving mode: request-trace join + tail decomposition
+# ---------------------------------------------------------------------------
+
+# the serving tail decomposition's phase names, in render order
+SERVING_TAIL_PHASES = ("queue_wait", "prefill", "decode_compute",
+                       "exposed_wire", "driver", "unexplained")
+
+
+def serving_traces(records):
+    """trace id -> joined lifecycle view from the schema-versioned
+    EVENT_SERVING phase records.  A requeued request (replica death)
+    contributes ONE entry — the records share the trace id minted at
+    submit — with the LAST life's admit/first_token (the life that
+    actually delivered) and the requeue count."""
+    from ..telemetry import events as ev
+
+    traces = {}
+    for rec in records:
+        if rec.get("type") != ev.EVENT_SERVING:
+            continue
+        data = rec.get("data", {})
+        trace = data.get("trace")
+        if not trace:
+            continue
+        t = traces.setdefault(trace, {"trace": trace, "kinds": [],
+                                      "requeues": 0})
+        kind = data.get("kind")
+        t["kinds"].append(kind)
+        if kind == "requeue":
+            t["requeues"] += 1
+        elif kind in ("finish", "deadline", "shed"):
+            t["terminal"] = kind
+            t[kind] = data
+        elif kind in ("submit", "admit", "first_token"):
+            t[kind] = data    # last life wins on requeue
+        if "request" in data:
+            t["request"] = data["request"]
+    return traces
+
+
+def serving_tail_decomposition(run_dir, budget=None):
+    """Decompose the tail (highest-latency finished) request's latency
+    into queue-wait / prefill / decode-compute / exposed-wire / driver
+    / unexplained and name the dominant phase; None when the run dir
+    carries no finished serving traces.
+
+    queue-wait and prefill are measured per request (the admit/
+    first_token phase records); the decode span (finish minus first
+    token, measured) is split by scaling the decode program's
+    attribution budget — compute, exposed wire, driver per iteration —
+    by the request's decode iteration count; whatever the budget cannot
+    cover is **unexplained**."""
+    from ..telemetry import events as ev
+
+    try:
+        records = ev.read_events(str(run_dir))
+    except OSError:
+        return None
+    traces = serving_traces(records)
+    finished = [t for t in traces.values()
+                if t.get("terminal") == "finish"
+                and t.get("finish", {}).get("latency_seconds") is not None]
+    if not finished:
+        return None
+    tail = max(finished,
+               key=lambda t: t["finish"]["latency_seconds"])
+    latency = float(tail["finish"]["latency_seconds"])
+    queue_wait = float((tail.get("admit") or {}).get("wait_seconds") or 0.0)
+    prefill = float(
+        (tail.get("first_token") or {}).get("prefill_seconds") or 0.0)
+    # measured decode span: finish minus first token (same mono clock)
+    decode_span = 0.0
+    if tail.get("first_token") and tail["finish"].get("t_mono") is not None \
+            and tail["first_token"].get("t_mono") is not None:
+        decode_span = max(0.0, float(tail["finish"]["t_mono"])
+                          - float(tail["first_token"]["t_mono"]))
+    iters = max(0, int(tail["finish"].get("generated_tokens") or 1) - 1)
+    bphases = (budget or {}).get("phases") or {}
+    decode_compute = min(
+        decode_span,
+        float(bphases.get(attribution.PHASE_COMPUTE) or 0.0) * iters)
+    exposed_wire = \
+        float(bphases.get(attribution.PHASE_COLLECTIVE) or 0.0) * iters
+    driver = float(bphases.get(attribution.PHASE_DRIVER) or 0.0) * iters
+    phases = {
+        "queue_wait": queue_wait,
+        "prefill": prefill,
+        "decode_compute": decode_compute,
+        "exposed_wire": exposed_wire,
+        "driver": driver,
+    }
+    phases["unexplained"] = max(
+        0.0, latency - sum(phases.values()))
+    dominant = max(SERVING_TAIL_PHASES, key=lambda p: phases[p])
+    return {
+        "trace": tail["trace"],
+        "request": tail.get("request"),
+        "requeues": tail["requeues"],
+        "finish_reason": tail["finish"].get("reason"),
+        "generated_tokens": tail["finish"].get("generated_tokens"),
+        "latency_seconds": latency,
+        "decode_span_seconds": decode_span,
+        "phases": phases,
+        "dominant_phase": dominant,
+        "traces_seen": len(traces),
+        "finished_traces": len(finished),
     }
 
 
@@ -166,6 +285,31 @@ def format_verdict(verdict):
             f"extra time attributed to "
             f"{straggler['attributed_phase']} "
             f"({straggler['attributed_seconds'] * 1e3:+.3f} ms vs fleet)")
+    lines.extend(format_serving_tail(verdict.get("serving")))
+    return lines
+
+
+def format_serving_tail(tail):
+    """Human-readable serving tail-request decomposition (shared with
+    ``telemetry report --serving``); [] when the verdict has none."""
+    if not tail:
+        return []
+    req = tail.get("request") or "?"
+    lines = [
+        f"  serving tail request: trace {tail['trace']} (request {req}, "
+        f"{tail['requeues']} requeue(s), "
+        f"reason={tail.get('finish_reason')}, "
+        f"{tail.get('generated_tokens')} tokens; "
+        f"{tail['finished_traces']}/{tail['traces_seen']} traces "
+        f"finished)",
+        "    latency "
+        + f"{tail['latency_seconds'] * 1e3:.3f} ms = "
+        + " + ".join(
+            f"{p.replace('_', '-')} {tail['phases'][p] * 1e3:.3f}"
+            for p in SERVING_TAIL_PHASES)
+        + " ms",
+        f"    dominant phase: {tail['dominant_phase'].replace('_', '-')}",
+    ]
     return lines
 
 

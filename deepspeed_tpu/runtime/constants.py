@@ -575,6 +575,15 @@ INFERENCE_DEGRADE_QUEUE_DEPTH_DEFAULT = 0
 # the degraded generation cap applied past degrade_queue_depth
 INFERENCE_DEGRADED_MAX_NEW_TOKENS = "degraded_max_new_tokens"
 INFERENCE_DEGRADED_MAX_NEW_TOKENS_DEFAULT = 4
+# "slo": {"ttft_ms": ..., "per_token_ms": ...} — the serving SLO
+# targets the observability plane accounts goodput against (tokens from
+# requests meeting the target vs raw throughput).  0 disables a leg;
+# the SLO never changes scheduling, it only changes what gets counted.
+INFERENCE_SLO = "slo"
+INFERENCE_SLO_TTFT_MS = "ttft_ms"
+INFERENCE_SLO_TTFT_MS_DEFAULT = 0
+INFERENCE_SLO_PER_TOKEN_MS = "per_token_ms"
+INFERENCE_SLO_PER_TOKEN_MS_DEFAULT = 0
 
 #############################################
 # Config validation (dslint schema; new — reference config.py:432 only

@@ -98,7 +98,15 @@ EVENT_INTEGRITY = "integrity"
 # "degrade" (generation cap dropped under queue pressure), "requeue" (a
 # dead replica's in-flight request reset and re-dispatched), "evict" (a
 # replica convicted by hang quorum or weight-fingerprint consensus),
-# "drain" (SIGTERM/close bounded drain of the in-flight batch)
+# "drain" (SIGTERM/close bounded drain of the in-flight batch).  The
+# observability plane (inference/observability) adds the
+# schema-versioned lifecycle records — "submit" (trace minted, before
+# the shed decision), "first_token" (TTFT + prefill seconds),
+# "decode_window" (the cadence occupancy/budget window with its active
+# trace ids) and "slo" (per-window goodput vs raw throughput) — and
+# threads ``trace``/``schema``/``t_mono`` through the older kinds;
+# inference.observability.SERVING_PHASE_KEYS is the per-kind required
+# payload table the golden-schema test pins
 EVENT_SERVING = "serving"
 
 # type -> required data keys.  The report CLI and the golden-schema test

@@ -129,6 +129,12 @@ class TelemetryManager:
         return (self.registry.histogram(name) if self.enabled
                 else _NULL_METRIC)
 
+    def quantiles(self, name):
+        """P² streaming-percentile instrument (O(1) per observation) —
+        for high-rate streams like the serving per-token latencies."""
+        return (self.registry.quantiles(name) if self.enabled
+                else _NULL_METRIC)
+
     # ------------------------------------------------------------ spans
     def span(self, name, **args):
         if self.tracer is None:

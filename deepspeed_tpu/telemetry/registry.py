@@ -24,8 +24,8 @@ import os
 import random
 import threading
 
-__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
-           "get_registry"]
+__all__ = ["Counter", "Gauge", "Histogram", "P2Quantile",
+           "StreamingQuantiles", "MetricsRegistry", "get_registry"]
 
 
 class Counter:
@@ -140,7 +140,185 @@ class Histogram:
         return out
 
 
-_KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+class P2Quantile:
+    """One streaming quantile via the P² (P-square) algorithm: five
+    markers adjusted per observation with the parabolic prediction
+    formula — O(1) time and O(1) memory per observation, no reservoir,
+    no sort.  The estimator of choice for HIGH-RATE streams (the
+    serving per-token latency stream observes once per generated
+    token); the algorithm-R reservoir :class:`Histogram` stays the
+    right tool for low-rate metrics where an exact small-sample
+    percentile matters more than constant cost.
+
+    Jain & Chlamtac, "The P² algorithm for dynamic calculation of
+    quantiles and histograms without storing observations", CACM 1985.
+    """
+
+    __slots__ = ("p", "count", "_heights", "_positions", "_desired",
+                 "_increments")
+
+    def __init__(self, p):
+        assert 0.0 < p < 1.0, f"quantile must be in (0, 1), got {p}"
+        self.p = float(p)
+        self.count = 0
+        self._heights = []            # marker heights (sorted)
+        self._positions = [1.0, 2.0, 3.0, 4.0, 5.0]
+        self._desired = [1.0, 1.0 + 2.0 * p, 1.0 + 4.0 * p,
+                         3.0 + 2.0 * p, 5.0]
+        self._increments = [0.0, p / 2.0, p, (1.0 + p) / 2.0, 1.0]
+
+    def observe(self, value):
+        value = float(value)
+        self.count += 1
+        q, n = self._heights, self._positions
+        if len(q) < 5:
+            # warm-up: collect the first five observations sorted
+            q.append(value)
+            q.sort()
+            return
+        # find the cell k with q[k] <= value < q[k+1], clamping extremes
+        if value < q[0]:
+            q[0] = value
+            k = 0
+        elif value >= q[4]:
+            q[4] = value
+            k = 3
+        else:
+            k = 0
+            while k < 3 and value >= q[k + 1]:
+                k += 1
+        for i in range(k + 1, 5):
+            n[i] += 1.0
+        for i in range(5):
+            self._desired[i] += self._increments[i]
+        # adjust the three interior markers toward their desired
+        # positions (parabolic P² step, linear fallback)
+        for i in (1, 2, 3):
+            d = self._desired[i] - n[i]
+            if (d >= 1.0 and n[i + 1] - n[i] > 1.0) \
+                    or (d <= -1.0 and n[i - 1] - n[i] < -1.0):
+                d = 1.0 if d >= 1.0 else -1.0
+                candidate = q[i] + d / (n[i + 1] - n[i - 1]) * (
+                    (n[i] - n[i - 1] + d) * (q[i + 1] - q[i])
+                    / (n[i + 1] - n[i])
+                    + (n[i + 1] - n[i] - d) * (q[i] - q[i - 1])
+                    / (n[i] - n[i - 1]))
+                if not (q[i - 1] < candidate < q[i + 1]):
+                    # parabolic prediction left the bracket: linear step
+                    candidate = q[i] + d * (q[i + int(d)] - q[i]) \
+                        / (n[i + int(d)] - n[i])
+                q[i] = candidate
+                n[i] += d
+
+    @property
+    def value(self):
+        """The current quantile estimate (exact until 5 observations)."""
+        q = self._heights
+        if not q:
+            return 0.0
+        if self.count < 5:
+            idx = min(len(q) - 1, int(round(self.p * (len(q) - 1))))
+            return q[idx]
+        return q[2]
+
+    def markers(self):
+        """(count, [(cumulative_fraction, height), ...]) — the
+        estimator's state as weighted CDF support points, the merge
+        interchange format."""
+        q = self._heights
+        if not q:
+            return 0, []
+        if self.count < 5:
+            n = len(q)
+            return self.count, [((i + 0.5) / n, h)
+                                for i, h in enumerate(q)]
+        total = self._positions[4]
+        return self.count, [(self._positions[i] / total, q[i])
+                            for i in range(5)]
+
+    @staticmethod
+    def merged_estimate(p, estimators):
+        """Approximate p-quantile of the CONCATENATED streams behind
+        ``estimators`` (cross-window merge): each window contributes
+        its markers as count-weighted CDF support points; the merged
+        quantile interpolates the pooled, weight-sorted points.  The
+        windows stay O(1) each — no window ever re-sees another's
+        observations."""
+        points = []       # (height, weight)
+        total = 0
+        for est in estimators:
+            count, marks = est.markers()
+            if not count:
+                continue
+            total += count
+            prev = 0.0
+            for frac, height in marks:
+                points.append((height, max(frac - prev, 1e-12) * count))
+                prev = frac
+        if not points:
+            return 0.0
+        points.sort()
+        target = p * total
+        acc = 0.0
+        for height, weight in points:
+            acc += weight
+            if acc >= target:
+                return height
+        return points[-1][0]
+
+
+class StreamingQuantiles:
+    """Histogram-shaped instrument over :class:`P2Quantile` estimators:
+    count/sum/min/max stream exactly, each tracked percentile is an
+    O(1)-per-observation P² estimate.  Snapshots share the histogram
+    snapshot shape (count/sum/min/max/mean/p50/p90/p99), so the report
+    CLI and the Prometheus exporter render both kinds identically."""
+
+    kind = "quantiles"
+
+    TRACKED = (50, 90, 99)
+
+    def __init__(self, name):
+        self.name = name
+        self._lock = threading.RLock()
+        self._estimators = {p: P2Quantile(p / 100.0)
+                            for p in self.TRACKED}
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    def observe(self, value):
+        value = float(value)
+        with self._lock:
+            self.count += 1
+            self.sum += value
+            if value < self.min:
+                self.min = value
+            if value > self.max:
+                self.max = value
+            for est in self._estimators.values():
+                est.observe(value)
+
+    def percentile(self, p):
+        with self._lock:
+            est = self._estimators.get(int(p))
+            return est.value if est is not None else 0.0
+
+    def snapshot(self):
+        with self._lock:
+            out = {"kind": self.kind, "count": self.count,
+                   "sum": self.sum,
+                   "min": self.min if self.count else 0.0,
+                   "max": self.max if self.count else 0.0,
+                   "mean": self.sum / self.count if self.count else 0.0}
+            for p in self.TRACKED:
+                out[f"p{p}"] = self._estimators[p].value
+        return out
+
+
+_KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram,
+          "quantiles": StreamingQuantiles}
 
 
 class MetricsRegistry:
@@ -182,6 +360,12 @@ class MetricsRegistry:
 
     def histogram(self, name, reservoir_size=256):
         return self._get(name, "histogram", reservoir_size=reservoir_size)
+
+    def quantiles(self, name):
+        """O(1)-per-observation P² percentile instrument — the accessor
+        for HIGH-RATE streams (per-token latency); use
+        :meth:`histogram` for low-rate metrics."""
+        return self._get(name, "quantiles")
 
     def names(self):
         with self._lock:

@@ -175,7 +175,10 @@ def format_metrics(metrics_by_stream):
             m = snap[name]
             if not isinstance(m, dict) or "kind" not in m:
                 lines.append(f"    {name}: {m}")
-            elif m["kind"] == "histogram":
+            elif m["kind"] in ("histogram", "quantiles"):
+                # same snapshot shape: the reservoir histogram and the
+                # P² streaming-quantile instrument both quote
+                # count/mean/p50/p99/max
                 lines.append(
                     f"    {name}: count={m['count']} "
                     f"mean={_fmt_value(m['mean'])} "
@@ -326,6 +329,101 @@ def serving_resilience_summary(records):
         rel = rec.get("_rel", rec.get("ts", 0.0))
         lines.append(f"  t=+{rel:9.3f}s rank={rec.get('rank')} {detail}")
     return lines
+
+
+def format_serving_section(records, run_dir=None):
+    """The serving observability section (``report --serving``): the
+    per-trace request timeline, the cadence occupancy windows, SLO
+    attainment, shed/degrade/requeue accounting, and the doctor's tail
+    decomposition.  Built from the schema-versioned EVENT_SERVING
+    lifecycle records the observability plane emits."""
+    from ..profiling.doctor import (format_serving_tail, serving_traces,
+                                    serving_tail_decomposition)
+
+    out = ["serving (request traces / occupancy / SLO):"]
+    aligned = align_records(records)
+    traces = serving_traces(records)
+    if not traces:
+        out.append("  (no serving lifecycle traces — run with telemetry "
+                   "events enabled)")
+        return out
+    # -- request timeline ------------------------------------------------
+    terminal_counts = {}
+    for t in traces.values():
+        term = t.get("terminal") or "in_flight"
+        terminal_counts[term] = terminal_counts.get(term, 0) + 1
+    out.append(f"  {len(traces)} trace(s): " + " ".join(
+        f"{k}={terminal_counts[k]}" for k in sorted(terminal_counts)))
+    shown = 0
+    for trace in sorted(
+            traces,
+            key=lambda tr: (traces[tr].get("submit") or {}).get(
+                "t_mono", 0.0)):
+        t = traces[trace]
+        if shown >= 20:
+            out.append(f"  ... {len(traces) - shown} more trace(s)")
+            break
+        shown += 1
+        term = t.get("terminal") or "in_flight"
+        fin = t.get("finish") or {}
+        parts = [f"  {trace} req={t.get('request', '?')}"]
+        if t.get("admit", {}).get("wait_seconds") is not None:
+            parts.append(f"wait={t['admit']['wait_seconds'] * 1e3:.1f}ms")
+        if t.get("first_token", {}).get("ttft_seconds") is not None:
+            parts.append(
+                f"ttft={t['first_token']['ttft_seconds'] * 1e3:.1f}ms")
+        if t["requeues"]:
+            parts.append(f"requeues={t['requeues']}")
+        parts.append(f"-> {term}")
+        if fin.get("latency_seconds") is not None:
+            parts.append(f"({fin['latency_seconds'] * 1e3:.1f}ms, "
+                         f"{fin.get('generated_tokens')} tok, "
+                         f"{fin.get('reason')})")
+        out.append(" ".join(parts))
+    # -- occupancy windows -----------------------------------------------
+    windows = [r for r in aligned if r.get("type") == ev.EVENT_SERVING
+               and r.get("data", {}).get("kind") == "decode_window"]
+    if windows:
+        out.append("  occupancy windows (steps_per_print cadence):")
+        out.append(f"    {'t':>10} {'iters':>5} {'tokens':>6} "
+                   f"{'occupancy':>9} {'budget':>7} {'kv used':>7} "
+                   f"{'kv peak':>7}")
+        for rec in windows:
+            d = rec["data"]
+            rel = rec.get("_rel", rec.get("ts", 0.0))
+            out.append(
+                f"    +{rel:8.3f}s {d.get('iterations', 0):>5} "
+                f"{d.get('tokens', 0):>6} "
+                f"{d.get('batch_occupancy', 0.0):>8.1%} "
+                f"{d.get('token_budget_utilization', 0.0):>6.1%} "
+                f"{d.get('kv_used_blocks', 0):>7} "
+                f"{d.get('kv_used_peak', 0):>7}")
+    # -- SLO attainment ---------------------------------------------------
+    slo = [r for r in aligned if r.get("type") == ev.EVENT_SERVING
+           and r.get("data", {}).get("kind") == "slo"]
+    if slo:
+        total = sum(int(r["data"].get("window_tokens") or 0) for r in slo)
+        good = sum(int(r["data"].get("goodput_tokens") or 0) for r in slo)
+        out.append(
+            f"  SLO: {good}/{total} token(s) within target "
+            f"({good / total if total else 1.0:.1%} attainment) across "
+            f"{len(slo)} window(s)")
+    # -- shed/degrade/requeue accounting ----------------------------------
+    counts = {}
+    for rec in records:
+        if rec.get("type") != ev.EVENT_SERVING:
+            continue
+        kind = rec.get("data", {}).get("kind")
+        if kind in ("shed", "degrade", "requeue", "deadline"):
+            counts[kind] = counts.get(kind, 0) + 1
+    if counts:
+        out.append("  pressure: " + " ".join(
+            f"{k}={counts[k]}" for k in sorted(counts)))
+    # -- doctor tail decomposition ----------------------------------------
+    if run_dir is not None:
+        tail = serving_tail_decomposition(run_dir)
+        out.extend(format_serving_tail(tail))
+    return out
 
 
 def comm_program_table(records):
@@ -515,7 +613,7 @@ def format_doctor_section(verdict):
 
 
 def generate_report(run_dir, strict=False, comm=False, doctor=False,
-                    grad_accumulation_steps=1):
+                    grad_accumulation_steps=1, serving=False):
     """Full text report for ``run_dir``; returns (text, events)."""
     records = ev.read_events(run_dir, strict=strict)
     problems = []
@@ -543,6 +641,9 @@ def generate_report(run_dir, strict=False, comm=False, doctor=False,
         out.append("")
         out.append("serving resilience (shed / requeue / evict / drain):")
         out.extend(serving_lines)
+    if serving:
+        out.append("")
+        out.extend(format_serving_section(records, run_dir=run_dir))
     out.append("")
     out.append("step metrics:")
     out.extend(summarize_step_metrics(records))
@@ -674,6 +775,12 @@ def main(argv=None):
                           "section: reconciled per-rank phase budget + "
                           "straggler explanation (needs the run's "
                           "programs/ sidecars)")
+    rep.add_argument("--serving", action="store_true",
+                     help="include the serving observability section: "
+                          "request-trace timeline, occupancy windows, "
+                          "SLO attainment, shed/degrade/requeue "
+                          "accounting, and the tail-request latency "
+                          "decomposition")
     rep.add_argument("--grad-accum", type=int, default=1,
                      help="micro-batch multiplicity for the doctor's "
                           "step-wise program weighting (fused step "
@@ -725,7 +832,8 @@ def main(argv=None):
         return 1 if diff_regressed else 0
     text, records = generate_report(args.run_dir, strict=args.strict,
                                     comm=args.comm, doctor=args.doctor,
-                                    grad_accumulation_steps=args.grad_accum)
+                                    grad_accumulation_steps=args.grad_accum,
+                                    serving=args.serving)
     sys.stdout.write(text)
     # a regressed --diff gates the combined form too (CI relies on it)
     return 1 if (diff_regressed or not records) else 0

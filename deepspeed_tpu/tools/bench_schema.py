@@ -211,6 +211,25 @@ FIELDS = {
     "serving_recovery_latency_seconds": (numbers.Real,
                                          "worst replica-death -> last "
                                          "requeued-result latency"),
+    # serving observability receipts (round 19,
+    # inference/observability via engine.serving_receipt()): goodput
+    # vs raw throughput, SLO attainment, and the efficiency gauges the
+    # continuous-batching claim rests on
+    "serving_goodput_tokens_per_second_per_chip": (
+        numbers.Real, "tokens/s/chip counting only SLO-conformant "
+        "tokens (raw throughput minus tail misses)"),
+    "serving_slo_attainment": (numbers.Real,
+                               "fraction of generated tokens within "
+                               "the inference.slo targets"),
+    "serving_batch_occupancy_mean": (numbers.Real,
+                                     "mean active/max_batch_size over "
+                                     "decode iterations"),
+    "serving_kv_block_occupancy_peak": (numbers.Real,
+                                        "allocator used-block high "
+                                        "water / capacity"),
+    "serving_padding_waste_fraction": (numbers.Real,
+                                       "padded-prefill tokens wasted "
+                                       "by bucket rounding"),
 }
 
 # multichip leg fields: leg_<name>_<field>
@@ -273,6 +292,14 @@ _LEG_FIELDS = {
     "integrity_violations": numbers.Integral,
     "completed_requests": numbers.Integral,
     "recovery_latency_seconds": numbers.Real,
+    # serving observability receipts (round 19): the serving leg's
+    # goodput/SLO/occupancy record, mirroring the top-level
+    # serving_* observability family
+    "goodput_tokens_per_second_per_chip": numbers.Real,
+    "slo_attainment": numbers.Real,
+    "batch_occupancy_mean": numbers.Real,
+    "kv_block_occupancy_peak": numbers.Real,
+    "padding_waste_fraction": numbers.Real,
     "error": str,
     "note": str,
 }
@@ -399,6 +426,12 @@ THRESHOLDS = {
     # informational (they scale with the bench's injected faults, not
     # with code quality); the exactly-once property itself is gated in
     # the serving_chaos leg via parity_mismatches
+    # serving observability (round 19): goodput is the gated headline
+    # (same tol as raw serving throughput — a goodput drop is either a
+    # throughput or a tail-latency regression); attainment and the
+    # occupancy/waste gauges are informational (they move with bench
+    # load shape, not code quality)
+    "serving_goodput_tokens_per_second_per_chip": ("higher", 0.25),
 }
 
 # thresholds for the pattern-based leg_<name>_<field> family
@@ -429,6 +462,10 @@ _LEG_FIELD_THRESHOLDS = {
     # grown ratio) = the compression is leaking dense collectives
     "compressed_wire_bytes": ("lower", 0.25),
     "compressed_wire_ratio": ("lower", 0.25),
+    # serving observability (round 19): goodput gated like the
+    # top-level field; occupancy/attainment/waste informational on the
+    # virtual-CPU dryrun mesh
+    "goodput_tokens_per_second_per_chip": ("higher", 0.25),
 }
 
 # thresholds for the pattern-based offload_<row>_<field> family

@@ -256,7 +256,7 @@ def _check_hot_function(pf: ParsedFile, index: ModuleIndex, fn) -> List:
 
 # -- step-cadence driver checks --------------------------------------------
 
-DRIVER_CLASS_MARKERS = ("Engine", "Scaler")
+DRIVER_CLASS_MARKERS = ("Engine", "Scaler", "Frontend")
 DRIVER_METHODS = {
     "train_batch", "step", "forward", "backward", "eval_batch", "__call__",
     "_train_batch_stepwise", "_eval_one", "train_step",
@@ -383,7 +383,13 @@ _SKEW_EXPORT_CALLS = {"latency_snapshot", "publish_rank_latency",
                       # same contract
                       "publish_weight_fingerprint",
                       "read_fleet_weight_fingerprints",
-                      "note_weight_fingerprint"}
+                      "note_weight_fingerprint",
+                      # serving observability (inference/observability):
+                      # the window close + fleet-gauge exporters — event
+                      # emission and window resets, print-cadence-only
+                      # by the same contract
+                      "export_serving_window",
+                      "export_serving_gauges"}
 
 
 def _is_skew_export(node: ast.Call) -> bool:

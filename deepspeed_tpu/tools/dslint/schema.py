@@ -134,6 +134,7 @@ _SECTION_PREFIXES = (
 _NESTED_SECTION_PREFIXES = (
     ("ZERO_OFFLOAD_STATE_DTYPE_",
      ("zero_optimization", "offload_state_dtype")),
+    ("INFERENCE_SLO_", ("inference", "slo")),
 )
 
 # prefixed names that are nonetheless TOP-LEVEL json keys

@@ -483,9 +483,49 @@ class InferenceEngine:
     assert ids == []
 
 
+def test_dsh205_serving_window_export_unguarded_is_flagged(tmp_path):
+    # PR 19: the serving observability plane's window-close exporter
+    # (occupancy/goodput/SLO gauges) carries the cadence-only contract
+    # — per decode iteration it multiplies gauge writes onto the token
+    # hot path.  The front-end fleet-gauge exporter is the same class
+    # of call, and ServingFrontend is a driver root (Frontend marker).
+    ids = lint_source(tmp_path, """
+class InferenceEngine:
+    def step(self):
+        self.observability.export_serving_window()
+
+class ServingFrontend:
+    def step(self):
+        self.export_serving_gauges()
+""")
+    assert ids == ["DSH205"]
+
+
+def test_dsh205_serving_window_export_guarded_is_clean(tmp_path):
+    # the shipped shape: the window close lives in the engine's
+    # _sample_telemetry (reached only through the cadence guard), and
+    # the front-end guards its gauge export lexically
+    ids = lint_source(tmp_path, """
+class InferenceEngine:
+    def _sample_telemetry(self):
+        self.observability.export_serving_window()
+
+    def step(self):
+        if self.decode_iterations % self.steps_per_print() == 0:
+            self._sample_telemetry()
+
+class ServingFrontend:
+    def step(self):
+        self._steps += 1
+        if self._steps % self.steps_per_print == 0:
+            self.export_serving_gauges()
+""")
+    assert ids == []
+
+
 def test_non_engine_class_is_not_driver_scope(tmp_path):
-    # benchmarks/profilers sync deliberately; only Engine/Scaler classes
-    # carry step-cadence semantics
+    # benchmarks/profilers sync deliberately; only Engine/Scaler/
+    # Frontend classes carry step-cadence semantics
     ids = lint_source(tmp_path, """
 import jax
 

@@ -104,16 +104,22 @@ def test_checked_in_baseline_is_empty_of_violations():
     # name AND model geometry, so each needs its own ratchet key),
     # recorded from the checked-in tools/dslint_fixtures/ sidecars by
     # tools/regen_dslint_fixtures.py
+    # round 19 added the serving sidecar (tiny-GPT-2 inference engine):
+    # its two serve programs pin their serve|data1 residency the same
+    # way — no exposure/attribution keys (no host stream, no
+    # overlapped collective schedule on the serve programs)
     keys = {exposure_metric_key("train_step"),
             predicted_step_metric_key("train_step"),
             comm_exposure_metric_key("train_step"),
             comm_exposure_metric_key("cast_params"),
             sharding_metric_key("zero2-offload|data1", "train_step"),
-            sharding_metric_key("zero2|data4", "train_step")}
+            sharding_metric_key("zero2|data4", "train_step"),
+            sharding_metric_key("serve|data1", "serve_decode"),
+            sharding_metric_key("serve|data1", "serve_prefill_16")}
     assert set(metrics) == keys, (
         "the baseline records exactly the offload-step exposed-wire + "
         "attribution ratchet metrics, the zero-2 overlap fixture's "
-        "collective-exposure metrics, and the two fixtures' DSS803 "
+        "collective-exposure metrics, and the fixtures' DSS803 "
         f"param-bytes pins ({sorted(keys)}); anything else needs "
         "review")
     for key in keys:

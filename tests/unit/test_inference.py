@@ -310,7 +310,11 @@ class TestInferenceEngine:
         base_cfg = serve_config()
         base_cfg["steps_per_print"] = 1
         base = count_gets(base_cfg)
-        tel_cfg = serve_config()
+        # the FULL observability plane armed: lifecycle tracing +
+        # occupancy/goodput windows ride automatically with telemetry,
+        # and the slo block arms the per-token conformance legs — all
+        # of it host arithmetic over values the loop already fetched
+        tel_cfg = serve_config(slo={"ttft_ms": 100, "per_token_ms": 50})
         tel_cfg["steps_per_print"] = 1
         tel_cfg["telemetry"] = {"enabled": True,
                                 "run_dir": str(tmp_path / "t")}

@@ -1,0 +1,386 @@
+"""Serving observability plane (inference/observability.py): the P²
+streaming-quantile estimator, the schema-versioned request-lifecycle
+traces (joined across a requeue), the occupancy/goodput/SLO receipts,
+and the doctor's tail-request phase decomposition.
+
+The zero-added-syncs side of the contract is pinned dynamically by
+``test_inference.py::test_zero_added_host_syncs`` (device_get counting
+with the full plane + SLO armed) and statically by the DSH205 cases in
+``test_dslint.py``.
+"""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference import (InferenceEngine, ServingFrontend,
+                                     SERVING_PHASE_KEYS,
+                                     SERVING_TRACE_SCHEMA_VERSION)
+from deepspeed_tpu.telemetry import events as TEL
+from deepspeed_tpu.telemetry.registry import (MetricsRegistry, P2Quantile,
+                                              StreamingQuantiles)
+
+from .test_inference import (seeded_prompts, serve_config, tiny_model,
+                             model_and_params)  # noqa: F401 — fixture
+
+
+# ---------------------------------------------------------------------------
+# P² streaming quantiles: convergence + merge safety
+# ---------------------------------------------------------------------------
+
+class TestP2Quantile:
+    @pytest.mark.parametrize("p,tol", [(0.5, 0.05), (0.9, 0.05),
+                                       (0.99, 0.10)])
+    def test_converges_on_heavy_tail(self, p, tol):
+        # lognormal: the shape of a latency stream (long right tail) —
+        # the estimator must track the sorted ground truth within a
+        # few percent relative error at 20k observations
+        rng = np.random.default_rng(7)
+        samples = rng.lognormal(mean=-7.0, sigma=1.0, size=20000)
+        est = P2Quantile(p)
+        for s in samples:
+            est.observe(float(s))
+        truth = float(np.quantile(samples, p))
+        assert est.count == len(samples)
+        assert est.value == pytest.approx(truth, rel=tol)
+
+    def test_exact_until_five_observations(self):
+        est = P2Quantile(0.5)
+        for v in (3.0, 1.0, 2.0):
+            est.observe(v)
+        assert est.value == 2.0  # exact small-sample median
+
+    def test_merge_across_windows_matches_concatenated_stream(self):
+        # three per-window estimators over disjoint slices must merge
+        # to (approximately) the quantile of the concatenated stream —
+        # the property that makes window-scoped estimators safe to
+        # aggregate without any window re-seeing another's samples
+        rng = np.random.default_rng(11)
+        samples = rng.lognormal(mean=-6.0, sigma=0.8, size=9000)
+        windows = [P2Quantile(0.9) for _ in range(3)]
+        for i, s in enumerate(samples):
+            windows[i % 3].observe(float(s))
+        merged = P2Quantile.merged_estimate(0.9, windows)
+        truth = float(np.quantile(samples, 0.9))
+        assert merged == pytest.approx(truth, rel=0.10)
+
+    def test_merge_weights_unequal_windows(self):
+        # a tiny window must not drag the merged estimate: weights are
+        # count-proportional.  9900 samples near 1.0, 100 near 100.0 —
+        # the merged p50 stays near 1.0
+        big, small = P2Quantile(0.5), P2Quantile(0.5)
+        rng = np.random.default_rng(3)
+        for _ in range(9900):
+            big.observe(1.0 + rng.normal() * 0.01)
+        for _ in range(100):
+            small.observe(100.0 + rng.normal())
+        merged = P2Quantile.merged_estimate(0.5, [big, small])
+        assert merged == pytest.approx(1.0, abs=0.1)
+
+    def test_empty_estimators_merge_to_zero(self):
+        assert P2Quantile.merged_estimate(0.5, [P2Quantile(0.5)]) == 0.0
+
+
+class TestStreamingQuantilesInstrument:
+    def test_snapshot_shape_matches_histogram_family(self):
+        reg = MetricsRegistry()
+        q = reg.quantiles("serving/per_token_seconds")
+        assert isinstance(q, StreamingQuantiles)
+        for v in (0.001, 0.002, 0.004):
+            q.observe(v)
+        snap = q.snapshot()
+        assert snap["kind"] == "quantiles"
+        assert snap["count"] == 3
+        assert snap["sum"] == pytest.approx(0.007)
+        assert snap["min"] == 0.001 and snap["max"] == 0.004
+        for key in ("mean", "p50", "p90", "p99"):
+            assert key in snap
+        # registered: a second fetch is the same instrument
+        assert reg.quantiles("serving/per_token_seconds") is q
+
+
+# ---------------------------------------------------------------------------
+# golden schema: lifecycle phase records, trace joined across a requeue
+# ---------------------------------------------------------------------------
+
+def _serving_events(run_dir):
+    """EVENT_SERVING payloads in stream order (the lifecycle fields —
+    kind/trace/schema/t_mono — ride the record's ``data`` dict)."""
+    return [dict(r.get("data") or {})
+            for r in TEL.read_events(str(run_dir))
+            if r.get("type") == TEL.EVENT_SERVING]
+
+
+class TestLifecycleTraceSchema:
+    @pytest.fixture()
+    def requeue_run(self, model_and_params, tmp_path):  # noqa: F811
+        """2-replica front-end serve with one replica death mid-decode:
+        the canonical joined-trace fixture."""
+        model, params = model_and_params
+        config = serve_config(slo={"ttft_ms": 2000, "per_token_ms": 500})
+        config["steps_per_print"] = 2
+        config["telemetry"] = {"enabled": True, "run_dir": str(tmp_path)}
+        replicas = [InferenceEngine(model, params, config=config)
+                    for _ in range(2)]
+        frontend = ServingFrontend(replicas)
+        for i, p in enumerate(seeded_prompts(4, seed=21)):
+            frontend.submit(p, max_new_tokens=4, request_id=f"r{i}")
+        for _ in range(2):
+            frontend.step()
+        frontend.mark_dead(0)
+        results = frontend.run()
+        for engine in replicas:
+            engine.close()
+        return results, _serving_events(tmp_path)
+
+    def test_every_phase_record_validates_against_the_table(
+            self, requeue_run):
+        results, events = requeue_run
+        lifecycle = [r for r in events
+                     if r.get("kind") in SERVING_PHASE_KEYS]
+        assert lifecycle, "no lifecycle phase records emitted"
+        for rec in lifecycle:
+            required = SERVING_PHASE_KEYS[rec["kind"]]
+            missing = [k for k in required if k not in rec]
+            assert not missing, (
+                f"{rec['kind']} record missing {missing}: {rec}")
+            assert rec["schema"] == SERVING_TRACE_SCHEMA_VERSION
+            assert rec["t_mono"] > 0
+
+    def test_requeued_request_is_one_joined_trace(self, requeue_run):
+        results, events = requeue_run
+        assert len(results) == 4
+        by_trace = {}
+        for rec in events:
+            if "trace" in rec:
+                by_trace.setdefault(rec["trace"], []).append(rec)
+        requeued = [kinds for kinds in
+                    ([r["kind"] for r in recs]
+                     for recs in by_trace.values())
+                    if "requeue" in kinds]
+        assert requeued, "no requeued trace in the fixture run"
+        for kinds in requeued:
+            # one submit, then TWO lives (admit/first_token before and
+            # after the requeue), one terminal finish — all one trace
+            assert kinds.count("submit") == 1
+            assert kinds.count("admit") == 2
+            assert kinds.count("first_token") == 2
+            assert kinds[-1] == "finish"
+            assert kinds.index("requeue") > kinds.index("admit")
+        # untouched traces keep the single-life shape
+        for kinds in ([r["kind"] for r in recs]
+                      for recs in by_trace.values()):
+            if "requeue" in kinds:
+                continue
+            assert kinds.count("admit") == kinds.count("first_token") == 1
+
+    def test_trace_ids_land_in_results(self, requeue_run):
+        results, events = requeue_run
+        traces = {rec["trace"] for rec in events if "trace" in rec}
+        for rid, result in results.items():
+            assert result["trace_id"] in traces
+            assert result["admission_wait_seconds"] >= 0
+
+    def test_monotonic_ordering_within_each_trace(self, requeue_run):
+        _, events = requeue_run
+        by_trace = {}
+        for rec in events:
+            if "trace" in rec:
+                by_trace.setdefault(rec["trace"], []).append(rec)
+        for recs in by_trace.values():
+            stamps = [r["t_mono"] for r in recs]
+            assert stamps == sorted(stamps)
+
+    def test_decode_window_and_slo_records_at_cadence(self, requeue_run):
+        _, events = requeue_run
+        windows = [r for r in events if r.get("kind") == "decode_window"]
+        slos = [r for r in events if r.get("kind") == "slo"]
+        assert windows and slos
+        for w in windows:
+            assert 0 < w["batch_occupancy"] <= 1.0
+            assert 0 <= w["token_budget_utilization"] <= 1.0
+            assert w["kv_used_peak"] >= w["kv_used_blocks"] >= 0
+        for s in slos:
+            assert 0 <= s["slo_attainment"] <= 1.0
+            assert s["goodput_tokens"] <= s["window_tokens"]
+
+
+# ---------------------------------------------------------------------------
+# occupancy / goodput receipt
+# ---------------------------------------------------------------------------
+
+class TestServingReceipt:
+    def test_receipt_fields_sane_without_slo(self, model_and_params,
+                                             tmp_path):
+        model, params = model_and_params
+        config = serve_config()
+        config["telemetry"] = {"enabled": True, "run_dir": str(tmp_path)}
+        engine = InferenceEngine(model, params, config=config)
+        for i, p in enumerate(seeded_prompts(4, seed=33)):
+            engine.submit(p, max_new_tokens=4, request_id=f"r{i}")
+        engine.run()
+        receipt = engine.serving_receipt()
+        engine.close()
+        assert 0 < receipt["batch_occupancy_mean"] <= 1.0
+        assert 0 < receipt["token_budget_utilization"] <= 1.0
+        assert 0 < receipt["kv_block_occupancy_peak"] <= 1.0
+        assert 0 <= receipt["padding_waste_fraction"] < 1.0
+        # no SLO block: every token is good, goodput == raw throughput
+        assert not receipt["slo_enabled"]
+        assert receipt["slo_attainment"] == 1.0
+        assert receipt["goodput_tokens"] == receipt["generated_tokens"]
+        assert receipt["goodput_tokens_per_second"] == pytest.approx(
+            receipt["tokens_per_second_per_chip"], rel=0.2)
+
+    def test_impossible_slo_zeroes_goodput(self, model_and_params,
+                                           tmp_path):
+        # sub-microsecond targets: nothing conforms, attainment ~ 0,
+        # goodput collapses while raw throughput stays positive
+        model, params = model_and_params
+        config = serve_config(slo={"ttft_ms": 0.0001,
+                                   "per_token_ms": 0.0001})
+        config["telemetry"] = {"enabled": True, "run_dir": str(tmp_path)}
+        engine = InferenceEngine(model, params, config=config)
+        for i, p in enumerate(seeded_prompts(3, seed=34)):
+            engine.submit(p, max_new_tokens=4, request_id=f"r{i}")
+        engine.run()
+        receipt = engine.serving_receipt()
+        engine.close()
+        assert receipt["slo_enabled"]
+        assert receipt["slo_attainment"] == 0.0
+        assert receipt["goodput_tokens"] == 0
+        assert receipt["tokens_per_second_per_chip"] > 0
+
+    def test_kv_allocator_peak_tracks_high_water(self):
+        from deepspeed_tpu.inference import BlockAllocator
+
+        alloc = BlockAllocator(16)
+        first = alloc.allocate(6)
+        assert alloc.used_peak == 6
+        alloc.release(first)
+        assert alloc.used_blocks == 0
+        assert alloc.used_peak == 6      # high water survives release
+        alloc.allocate(4)
+        assert alloc.used_peak == 6      # lower second wave: unchanged
+        assert alloc.capacity == 15      # null block excluded
+
+
+# ---------------------------------------------------------------------------
+# front-end fleet gauges (satellite: queue_depth / live_replicas)
+# ---------------------------------------------------------------------------
+
+class TestFrontendGauges:
+    def test_gauges_exported_at_print_cadence(self, model_and_params,
+                                              tmp_path):
+        model, params = model_and_params
+        config = serve_config()
+        config["steps_per_print"] = 2
+        config["telemetry"] = {"enabled": True, "run_dir": str(tmp_path)}
+        replicas = [InferenceEngine(model, params, config=config)
+                    for _ in range(2)]
+        frontend = ServingFrontend(replicas)
+        for i, p in enumerate(seeded_prompts(3, seed=40)):
+            frontend.submit(p, max_new_tokens=4, request_id=f"r{i}")
+        frontend.step()
+        registry = replicas[0].telemetry.registry
+        frontend.step()  # second step crosses the cadence: export fires
+        assert registry.gauge("serving/live_replicas").value == 2.0
+        frontend.mark_dead(0)
+        results = frontend.run()
+        assert len(results) == 3
+        assert registry.gauge("serving/live_replicas").value == 1.0
+        assert registry.gauge("serving/queue_depth").value == 0.0
+        for engine in replicas:
+            engine.close()
+
+
+# ---------------------------------------------------------------------------
+# Request.result caching (satellite: latency_summary computed once)
+# ---------------------------------------------------------------------------
+
+class TestResultCaching:
+    def test_finished_result_computed_once_and_stable(
+            self, model_and_params, tmp_path):
+        model, params = model_and_params
+        engine = InferenceEngine(model, params, config=serve_config())
+        engine.submit(seeded_prompts(1, seed=50)[0], max_new_tokens=4,
+                      request_id="r0")
+        results = engine.run()
+        request = engine.request("r0")
+        first = request.result()
+        assert first is request.result()    # cached dict, not recomputed
+        assert first == results["r0"]
+        engine.close()
+
+
+# ---------------------------------------------------------------------------
+# doctor: tail-request phase decomposition
+# ---------------------------------------------------------------------------
+
+class TestDoctorServingTail:
+    def test_queue_starved_tail_dominated_by_queue_wait(
+            self, model_and_params, tmp_path):
+        """One decode slot, four requests: the last-admitted request's
+        latency is (deterministically) dominated by queue wait, and the
+        doctor names it."""
+        from deepspeed_tpu.profiling.doctor import (
+            SERVING_TAIL_PHASES, doctor_run_dir, format_serving_tail,
+            serving_tail_decomposition)
+
+        model, params = model_and_params
+        config = serve_config(max_batch_slots=1, token_budget=64,
+                              slo={"ttft_ms": 1, "per_token_ms": 1})
+        config["telemetry"] = {"enabled": True, "run_dir": str(tmp_path)}
+        config["profiling"] = {"comm_ledger": True}
+        engine = InferenceEngine(model, params, config=config)
+        for i, p in enumerate(seeded_prompts(4, seed=60)):
+            engine.submit(p, max_new_tokens=8, request_id=f"r{i}")
+        engine.run()
+        engine.close()
+
+        tail = serving_tail_decomposition(str(tmp_path))
+        assert tail is not None
+        assert tail["finished_traces"] == 4
+        assert set(tail["phases"]) == set(SERVING_TAIL_PHASES)
+        assert tail["dominant_phase"] == "queue_wait"
+        # the decomposition covers the measured latency: no negative
+        # phases, unexplained is the bounded remainder
+        assert all(v >= 0 for v in tail["phases"].values())
+        assert sum(tail["phases"].values()) == pytest.approx(
+            tail["latency_seconds"], rel=0.01)
+        # the rendered verdict names the phase
+        lines = format_serving_tail(tail)
+        assert any("dominant phase: queue-wait" in ln for ln in lines)
+        # and the full doctor verdict carries the serving section
+        verdict = doctor_run_dir(str(tmp_path))
+        assert verdict["serving"]["dominant_phase"] == "queue_wait"
+
+    def test_no_serving_events_yields_none(self, tmp_path):
+        from deepspeed_tpu.profiling.doctor import (
+            serving_tail_decomposition)
+
+        assert serving_tail_decomposition(str(tmp_path)) is None
+
+
+# ---------------------------------------------------------------------------
+# telemetry report --serving
+# ---------------------------------------------------------------------------
+
+class TestServingReport:
+    def test_report_renders_serving_section(self, model_and_params,
+                                            tmp_path, capsys):
+        from deepspeed_tpu.telemetry.report import main as report_main
+
+        model, params = model_and_params
+        config = serve_config(slo={"ttft_ms": 2000, "per_token_ms": 500})
+        config["steps_per_print"] = 2
+        config["telemetry"] = {"enabled": True, "run_dir": str(tmp_path)}
+        engine = InferenceEngine(model, params, config=config)
+        for i, p in enumerate(seeded_prompts(3, seed=70)):
+            engine.submit(p, max_new_tokens=4, request_id=f"r{i}")
+        engine.run()
+        engine.close()
+        assert report_main(["report", str(tmp_path), "--serving"]) == 0
+        out = capsys.readouterr().out
+        assert "serving (request traces / occupancy / SLO):" in out
+        assert "occupancy" in out
+        assert "SLO:" in out

@@ -12,7 +12,12 @@ compiles fresh each run:
   ``_offload_engine`` fixture;
 - ``zero2_overlap``  — dp=4 bucketed-exchange ZeRO-2
   (reduce_bucket_size=140000 / allgather_bucket_size=280000), the
-  ``_zero2_overlap_engine`` fixture.
+  ``_zero2_overlap_engine`` fixture;
+- ``serving``        — the single-replica continuous-batching
+  inference engine (tiny GPT-2, one prefill bucket + the donated
+  decode program, ``inference.slo`` armed), so ``dslint --all``
+  verifies a serving sidecar — KV-donation aliasing (DSP601) and the
+  ``serve|data1`` DSS803 residency pins — on every CI run.
 
 Keeping the geometries identical matters: ``test_dsverify_self`` runs
 its FRESH compiles against the checked-in baseline expecting exit 0, so
@@ -98,6 +103,35 @@ def _build_engines(tmp):
         seed=0)[0]]))
     engine.close()
     runs["zero2_overlap"] = os.path.join(tmp, "zero2_overlap")
+
+    # -- serving: the inference-engine sidecar (round 19) -------------
+    from deepspeed_tpu.inference import InferenceEngine
+    from deepspeed_tpu.models import GPT2Config, GPT2LMHeadTPU
+
+    sconfig = {
+        "inference": {"kv_block_size": 8, "kv_blocks": 32,
+                      "max_batch_slots": 2, "max_seq_len": 32,
+                      "prefill_buckets": [16], "token_budget": 64,
+                      "max_new_tokens": 4,
+                      "slo": {"ttft_ms": 5000, "per_token_ms": 1000}},
+        "steps_per_print": 10 ** 9,
+        "telemetry": {"enabled": True,
+                      "run_dir": os.path.join(tmp, "serving")},
+        "profiling": {"comm_ledger": True},
+    }
+    smodel = GPT2LMHeadTPU(GPT2Config(
+        vocab_size=64, hidden_size=32, num_layers=2, num_heads=2,
+        max_position_embeddings=32, embd_dropout=0.0, attn_dropout=0.0,
+        resid_dropout=0.0))
+    sparams = smodel.init(jax.random.PRNGKey(0))
+    serving = InferenceEngine(smodel, sparams, config=sconfig)
+    # deterministic prompts: both serve programs (one prefill bucket +
+    # the donated decode) compile and dump with the serve|data1 context
+    for i, n in enumerate((5, 9, 13)):
+        serving.submit(list(range(1, n + 1)), request_id=f"req-{i}")
+    serving.run()
+    serving.close()
+    runs["serving"] = os.path.join(tmp, "serving")
     return runs
 
 
