@@ -256,6 +256,16 @@ def main(argv=None):
                              "config's train_batch_size / "
                              "gradient_accumulation_steps at dp=1)")
     parser.add_argument("--seq", type=int, default=1024)
+    parser.add_argument("--zero-stage", type=int, default=-1,
+                        dest="zero_stage",
+                        help="override the config's zero_optimization."
+                             "stage — plan the SAME model/geometry under "
+                             "a different stage (the stage-2 vs stage-3 "
+                             "capacity question)")
+    parser.add_argument("--dp", type=int, default=1,
+                        help="data-parallel width to plan at (mesh over "
+                             "the first N local devices; under stage 3 "
+                             "the persistent parameter state shards ÷N)")
     parser.add_argument("--capacity-gb", type=float, default=0.0,
                         help="per-device HBM capacity override (GiB); "
                              "default: memory_stats()['bytes_limit']")
@@ -278,10 +288,29 @@ def main(argv=None):
               file=sys.stderr)
         return 2
 
+    if args.zero_stage >= 0:
+        zero = dict(config.get("zero_optimization") or {})
+        zero["stage"] = args.zero_stage
+        config["zero_optimization"] = zero
+
     if not args.batch:
         tbs = int(config.get("train_batch_size", 4) or 4)
         acc = int(config.get("gradient_accumulation_steps", 1) or 1)
         args.batch = max(1, tbs // acc)
+
+    mesh = None
+    if args.dp > 1:
+        import jax
+
+        from ..parallel import make_mesh
+
+        avail = len(jax.devices())
+        if args.dp > avail:
+            print(f"error: --dp {args.dp} exceeds the {avail} local "
+                  "device(s)", file=sys.stderr)
+            return 2
+        mesh = make_mesh({"data": args.dp},
+                         devices=jax.devices()[:args.dp])
 
     capacity = device_capacity_bytes(args.capacity_gb or None)
     try:
@@ -290,7 +319,7 @@ def main(argv=None):
         print(f"error: {e}", file=sys.stderr)
         return 2
     try:
-        result = plan(config, model, _sample_batch(args),
+        result = plan(config, model, _sample_batch(args), mesh=mesh,
                       capacity_bytes=capacity, headroom=args.headroom)
     except Exception as e:
         # the exit-code contract reserves 1 for NO-FIT: a crashed plan
@@ -306,11 +335,14 @@ def main(argv=None):
         kw["hidden_size"], kw["num_layers"],
         max_position_embeddings=args.seq) / 1e9, 3)
     result["batch"], result["seq"] = args.batch, args.seq
+    result["zero_stage"] = int((config.get("zero_optimization") or {})
+                               .get("stage", 0) or 0)
+    result["dp"] = args.dp
 
     if args.bisect_layers:
         try:
             layers, params = bisect_max_layers(
-                args, config, None, capacity, *args.bisect_layers,
+                args, config, mesh, capacity, *args.bisect_layers,
                 log=(lambda *a: None) if args.as_json else print)
         except Exception as e:
             print(f"error: bisect failed: {e!r:.500}", file=sys.stderr)
@@ -348,7 +380,8 @@ def _fmt_bytes(n):
 
 def _print_report(r):
     print(f"capacity plan: {r.get('model')} ({r.get('params_b')}B params) "
-          f"batch={r.get('batch')} seq={r.get('seq')}")
+          f"batch={r.get('batch')} seq={r.get('seq')} "
+          f"zero-stage={r.get('zero_stage', '?')} dp={r.get('dp', 1)}")
     print(f"  predicted peak HBM ... {_fmt_bytes(r['predicted_peak_hbm_bytes'])}")
     print(f"    arguments .......... {_fmt_bytes(r['argument_bytes'])}")
     print(f"    outputs ............ {_fmt_bytes(r['output_bytes'])}")

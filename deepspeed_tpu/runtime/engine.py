@@ -282,9 +282,16 @@ class DeepSpeedEngine:
         # CSR-under-ZeRO).
         self._sparse_grad_paths = ()
         if self._config.sparse_gradients_enabled:
-            assert self._config.zero_optimization_stage == 0, (
-                "sparse_gradients are not supported with ZeRO (the flat "
-                "parameter space is sharded; reference has the same limit)")
+            if self._config.zero_optimization_stage != 0:
+                raise ValueError(
+                    f"sparse_gradients: true requires ZeRO stage 0, got "
+                    f"stage={self._config.zero_optimization_stage} — the "
+                    f"row-sparse (indices, values) exchange cannot ride a "
+                    f"sharded flat parameter space (stages 1/2 shard the "
+                    f"optimizer/gradient buffers, stage 3 additionally "
+                    f"shards the parameters themselves; the reference has "
+                    f"the same CSR-under-ZeRO limit).  Disable "
+                    f"sparse_gradients or set zero_optimization.stage: 0.")
             if hasattr(model, "sparse_gradient_paths"):
                 self._sparse_grad_paths = tuple(model.sparse_gradient_paths())
             log_dist(
@@ -326,8 +333,23 @@ class DeepSpeedEngine:
         # params on every backend, independent of the training-stream impl
         init_rng = jax.random.PRNGKey(rng_seed)
         offload_cfg = bool(self._config.zero_config.cpu_offload)
+        # plan mode (aot_plan=True): the capacity planner's engine.  The
+        # whole parameter/optimizer state stays ABSTRACT — ShapeDtype
+        # Structs with the real shardings — so "what fits now?" is
+        # answered from avals before anything model-sized materializes
+        # (at 1.8B params the concrete init alone costs minutes of host
+        # RNG + ~22 GB of allocation the plan never reads).  Offload
+        # plans keep the concrete path: their pinned-host buffers ARE
+        # the quantity under measurement.
+        self._aot_plan = bool(aot_plan)
+        plan_abstract = (self._aot_plan and model_parameters is None
+                         and not offload_cfg)
         if model_parameters is not None:
             params0 = model_parameters
+        elif plan_abstract:
+            assert hasattr(model, "init"), (
+                "model has no .init(rng); pass model_parameters explicitly")
+            params0 = jax.eval_shape(model.init, init_rng)
         else:
             assert hasattr(model, "init"), (
                 "model has no .init(rng); pass model_parameters explicitly")
@@ -348,7 +370,7 @@ class DeepSpeedEngine:
             # host leaves: the flatten consumes them leaf-wise on host;
             # putting them on device here would re-impose the init ceiling
             params0 = jax.tree_util.tree_map(np.asarray, params0)
-        else:
+        elif not plan_abstract:
             params0 = jax.tree_util.tree_map(jnp.asarray, params0)
         self._param_template = jax.tree_util.tree_map(
             lambda x: jax.ShapeDtypeStruct(x.shape, self.compute_dtype), params0)
@@ -422,8 +444,11 @@ class DeepSpeedEngine:
             bucket_plan=bucket_plan)
         self.segments = self.flat.segments
         if self._comm_overlap:
+            what = ("JIT parameter gathers + bucketed gradient exchange"
+                    if self.zero_stage >= 3 else
+                    "bucketed gradient exchange")
             log_dist(
-                f"ZeRO-2 overlap_comm: bucketed gradient exchange — "
+                f"ZeRO-{self.zero_stage} overlap_comm: {what} — "
                 f"{bucket_plan.n_buckets} reduce bucket(s) "
                 f"(reduce_bucket_size={zc.reduce_bucket_size}), "
                 f"{len(bucket_plan.ag_groups)} all-gather group(s) "
@@ -432,7 +457,15 @@ class DeepSpeedEngine:
                 f"{self.dp_world_size}", ranks=[0])
 
         # master weights (flat fp32, sharded per stage)
-        master0 = self.flat.flatten_to_master(params0)
+        if plan_abstract:
+            # the coordinator's layout is fully determined by shapes:
+            # the abstract master is (flat_rows, LANES) fp32 under the
+            # real device sharding — layout-exact, zero bytes
+            master0 = jax.ShapeDtypeStruct(
+                self.flat.flat_shape, jnp.float32,
+                sharding=self.flat.master_device_sharding)
+        else:
+            master0 = self.flat.flatten_to_master(params0)
         if self._config.zero_config.cpu_offload:
             # free the fp32 init params BEFORE later init work dispatches:
             # with state host-offloaded, the async param cast otherwise
@@ -530,6 +563,14 @@ class DeepSpeedEngine:
                     "cpu_offload with row-grouped host state requires a "
                     "zeros-init flat optimizer (adam/lamb family), got "
                     f"{getattr(self.optimizer, 'name', type(self.optimizer))}")
+            elif plan_abstract:
+                # abstract optimizer state with the real shardings: the
+                # step program lowers from these avals directly
+                opt0 = jax.tree_util.tree_map(
+                    lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                                      sharding=s),
+                    jax.eval_shape(self.optimizer.init_state, master0),
+                    self._opt_shardings_device)
             else:
                 master0_dev = (jax.device_put(
                     master0, self.flat.master_device_sharding)
@@ -676,7 +717,6 @@ class DeepSpeedEngine:
         from ..profiling.memory import MemoryLedger
 
         self.profiling_config = self._config.profiling_config
-        self._aot_plan = bool(aot_plan)
         self.comm_ledger = CommLedger(
             enabled=self.profiling_config.comm_ledger_enabled(
                 self.telemetry.enabled),
@@ -1420,23 +1460,43 @@ class DeepSpeedEngine:
             mesh_axes = {str(a): int(n)
                          for a, n in mesh_axis_sizes(self.mesh).items()}
             families = {}
-            # params: the module weights exactly as the jits consume
-            # them (compute dtype), on the specs the engine placed them
-            spec_leaves = jax.tree_util.tree_leaves(
-                self._param_specs, is_leaf=lambda x: isinstance(x, P))
-            tmpl_leaves = jax.tree_util.tree_leaves(self._param_template)
-            if len(spec_leaves) == len(tmpl_leaves):
-                families["params"] = sharding_prof.build_declared_family(
-                    (int(np.prod(t.shape)) * np.dtype(t.dtype).itemsize,
-                     *sharding_prof.spec_axes_and_divisor(s, mesh_axes))
-                    for t, s in zip(tmpl_leaves, spec_leaves))
-            # master: the flat fp32 buffer(s) under master_sharding
             m_axes, m_div = sharding_prof.spec_axes_and_divisor(
                 self.flat.master_sharding.spec, mesh_axes)
-            families["master"] = sharding_prof.build_declared_family(
-                (int(arr.size) * np.dtype(arr.dtype).itemsize,
-                 m_axes, m_div)
-                for arr in jax.tree_util.tree_leaves(self.state["master"]))
+            if self.zero_stage >= 3:
+                # stage 3: parameters never persist — the step consumes
+                # the ÷dp-sharded flat fp32 master directly and
+                # re-gathers leaves per use, so the "params" family IS
+                # the master buffer (the ÷dp residency claim DSS801/
+                # DSS803 verify).  A separate "master" family would
+                # double-claim the same entry tensor in the greedy
+                # byte matcher.
+                families["params"] = sharding_prof.build_declared_family(
+                    (int(arr.size) * np.dtype(arr.dtype).itemsize,
+                     m_axes, m_div)
+                    for arr in jax.tree_util.tree_leaves(
+                        self.state["master"]))
+            else:
+                # params: the module weights exactly as the jits consume
+                # them (compute dtype), on the specs the engine placed
+                # them
+                spec_leaves = jax.tree_util.tree_leaves(
+                    self._param_specs, is_leaf=lambda x: isinstance(x, P))
+                tmpl_leaves = jax.tree_util.tree_leaves(
+                    self._param_template)
+                if len(spec_leaves) == len(tmpl_leaves):
+                    families["params"] = \
+                        sharding_prof.build_declared_family(
+                            (int(np.prod(t.shape))
+                             * np.dtype(t.dtype).itemsize,
+                             *sharding_prof.spec_axes_and_divisor(
+                                 s, mesh_axes))
+                            for t, s in zip(tmpl_leaves, spec_leaves))
+                # master: the flat fp32 buffer(s) under master_sharding
+                families["master"] = sharding_prof.build_declared_family(
+                    (int(arr.size) * np.dtype(arr.dtype).itemsize,
+                     m_axes, m_div)
+                    for arr in jax.tree_util.tree_leaves(
+                        self.state["master"]))
             # optimizer: read the live shardings (flat buffers follow
             # the master, scalars replicate, per-rank optimizers
             # declare their own), never re-derived
@@ -1615,19 +1675,12 @@ class DeepSpeedEngine:
             devices=summary["devices"], reporting=summary["reporting"],
             host_buffer_bytes=self.memory_ledger.host_buffers.total_bytes())
 
-    def aot_compile_train_step(self, sample_batch):
-        """Lower + compile the fused train-step program WITHOUT running
-        it, and record its ``memory_analysis()`` in the ledger.
-
-        ``sample_batch`` is one host micro-batch pytree of the training
-        shapes (numpy; nothing is transferred).  State/optimizer
-        arguments lower from the engine's real (host-resident, under
-        offload) buffers, module params from their abstract shapes — so
-        with ``aot_plan=True`` nothing model-sized ever lands in device
-        memory.  Returns ``(compiled, ledger_entry)``; the entry is None
-        when the backend lacks ``memory_analysis``.  The AOT capacity
-        planner's core (``python -m deepspeed_tpu.profiling.capacity``);
-        warm under the persistent compile cache."""
+    def aot_lower_train_step(self, sample_batch):
+        """Lower (trace + StableHLO emission) the fused train-step
+        program without compiling or running it — abstract avals only,
+        nothing model-sized materializes.  The compile-scale guards
+        inspect the returned ``Lowered``'s program text; the capacity
+        planner compiles it via :meth:`aot_compile_train_step`."""
         from ..profiling.memory import _LedgeredJit
 
         acc = self.gradient_accumulation_steps()
@@ -1648,12 +1701,28 @@ class DeepSpeedEngine:
         fn = self._train_step_fn
         raw = fn.wrapped if isinstance(fn, _LedgeredJit) else fn
         with self.mesh:
-            lowered = raw.lower(
+            return raw.lower(
                 self.state["master"], self.state["opt"], self.state["scale"],
                 self.state["skipped"], self.state["ustep"], params_arg,
                 packed_sds, spec, self._device_hyperparams(),
                 self._segment_ids, self._extra_kwargs(),
                 self.state.get("hostgrad"), self.state.get("qres"))
+
+    def aot_compile_train_step(self, sample_batch):
+        """Lower + compile the fused train-step program WITHOUT running
+        it, and record its ``memory_analysis()`` in the ledger.
+
+        ``sample_batch`` is one host micro-batch pytree of the training
+        shapes (numpy; nothing is transferred).  State/optimizer
+        arguments lower from the engine's real (host-resident, under
+        offload) buffers, module params from their abstract shapes — so
+        with ``aot_plan=True`` nothing model-sized ever lands in device
+        memory.  Returns ``(compiled, ledger_entry)``; the entry is None
+        when the backend lacks ``memory_analysis``.  The AOT capacity
+        planner's core (``python -m deepspeed_tpu.profiling.capacity``);
+        warm under the persistent compile cache."""
+        lowered = self.aot_lower_train_step(sample_batch)
+        with self.mesh:
             compiled = lowered.compile()
         entry = self.memory_ledger.record("train_step", compiled)
         return compiled, entry
@@ -1705,9 +1774,10 @@ class DeepSpeedEngine:
         so the A/B control carries its receipt."""
         reason = None
         shape = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
-        if self.zero_stage != 2:
-            reason = (f"requires ZeRO stage 2 (the sharded-gradient "
-                      f"exchange; stage={self.zero_stage})")
+        if self.zero_stage not in (2, 3):
+            reason = (f"requires ZeRO stage 2 or 3 (the sharded-gradient "
+                      f"exchange rides the shard-major flat layout; "
+                      f"stage={self.zero_stage})")
         elif self.dp_world_size <= 1:
             reason = ("requires dp > 1 (a single data group has no "
                       "gradient exchange to overlap)")
@@ -2130,6 +2200,15 @@ class DeepSpeedEngine:
             # gradient buffer, the all-gather side the updated master
             sched["grad_bytes"] = int(pplan.rows * LANES * 4)
             sched["gather_bytes"] = int(pplan.rows * LANES * 4)
+            if self.zero_stage >= 3:
+                # stage 3: parameters gather per group in the forward
+                # AND re-gather in the backward (jax.checkpoint remat —
+                # the freed-after-use trade), so the gather side moves
+                # twice the flat buffer per step; the gradient
+                # reduce-scatter is the all_gather transpose (same
+                # bucket geometry, no separate schedule)
+                sched["param_gathers"] = True
+                sched["gather_bytes"] = int(2 * pplan.rows * LANES * 4)
             self._collective_schedule = sched
             if self.telemetry.enabled:
                 self.telemetry.gauge("comm/overlap_comm_enabled").set(
@@ -2816,6 +2895,11 @@ class DeepSpeedEngine:
         # per-group master all-gathers (allgather_bucket_size) stay
         # collective-free beyond the declared schedule.
         comm_overlap = bool(self._comm_overlap)
+        # stage-3 parameter sharding rides the same shard-major bucket
+        # layout: the step differentiates w.r.t. the LOCAL master shard
+        # and the per-group all-gathers move INSIDE the differentiated
+        # function (see zero3_loss_and_flat_grads below)
+        stage3_overlap = stage3 and comm_overlap
         bucket_plan = self.flat.bucket_plan
         flat_shape = self.flat.flat_shape
         rep_spec = P()
@@ -2860,13 +2944,19 @@ class DeepSpeedEngine:
                 batch, rng, cur_scale, extra, params)
             return sloss * grad_acc / cur_scale, flat_g, {}
 
-        def _gather_cast_leaves(m_loc):
+        def _gather_cast_leaves(m_loc, remat=False):
             """Manual-region helper: my (piece_rows, LANES) master shard
             -> every param leaf in compute dtype, ONE all_gather per
             allgather_bucket_size group — each leaf then depends only on
             its group's gather (and that gather only on its buckets'
             updated pieces), so the gathers overlap the other buckets'
-            update compute."""
+            update compute.
+
+            ``remat=True`` (the stage-3 forward) wraps each group's
+            gather+carve in ``jax.checkpoint``: the gathered leaves are
+            FREED after their last forward use and re-gathered on the
+            backward instead of persisting as residuals, so peak param
+            residency stays one-to-two groups — never the model."""
             out = [None] * len(ag_templates)
             for g_lo, g_hi in bucket_plan.ag_groups:
                 lo_b = bucket_plan.buckets[g_lo]
@@ -2874,16 +2964,24 @@ class DeepSpeedEngine:
                 piece = jax.lax.slice_in_dim(
                     m_loc, lo_b.piece_start,
                     hi_b.piece_start + hi_b.piece_rows)
-                full = jax.lax.all_gather(piece, DATA_AXIS, axis=0,
-                                          tiled=False)
-                off = 0
-                for bi in range(g_lo, g_hi):
+
+                def gather_group(piece_, g_lo=g_lo, g_hi=g_hi):
+                    full = jax.lax.all_gather(piece_, DATA_AXIS, axis=0,
+                                              tiled=False)
+                    off = 0
+                    groups = []
+                    for bi in range(g_lo, g_hi):
+                        b = bucket_plan.buckets[bi]
+                        block = full[:, off:off + b.piece_rows].reshape(
+                            b.rows, LANES)
+                        off += b.piece_rows
+                        groups.append(bucket_plan.carve_bucket(
+                            block, bi, ag_templates, self.compute_dtype))
+                    return groups
+                carved_groups = (jax.checkpoint(gather_group)(piece)
+                                 if remat else gather_group(piece))
+                for bi, carved in zip(range(g_lo, g_hi), carved_groups):
                     b = bucket_plan.buckets[bi]
-                    block = full[:, off:off + b.piece_rows].reshape(
-                        b.rows, LANES)
-                    off += b.piece_rows
-                    carved = bucket_plan.carve_bucket(
-                        block, bi, ag_templates, self.compute_dtype)
                     for k, li in enumerate(range(b.leaf_lo, b.leaf_hi)):
                         out[li] = carved[k]
             return tuple(out)
@@ -2964,10 +3062,61 @@ class DeepSpeedEngine:
                 param_treedef, list(cast_leaves)) if want_cast else None)
             return m_out, new_opt, new_params
 
+        # -- stage-3 sharded parameters (zero_stage 3 + overlap_comm) ---
+        # The naive stage-3 step gathers the WHOLE flat master up front
+        # (GSPMD lazy, but one fused all-gather the entire forward
+        # depends on — profiling/overlap classifies it serialized).
+        # Here the loss differentiates w.r.t. the local (piece_rows,
+        # LANES) master shard inside ONE manual region: each allgather
+        # group's parameters gather just in time in forward order —
+        # group k's gather is data-independent of group k-1's compute,
+        # so XLA's latency-hiding scheduler issues it early and hides
+        # the wire — and jax.checkpoint around each group frees the
+        # gathered leaves after last use and re-gathers on backward
+        # (peak param residency = one-to-two groups, not the model).
+        # The transpose of the tiled=False all_gather is exactly
+        # psum_scatter, so the stage-3 gradient exchange arrives
+        # reduced AND sharded with no extra collective code.
+        def zero3_loss_and_flat_grads(master, batch, rng, cur_scale,
+                                      extra):
+            dp = self.dp_world_size
+
+            def body(batch_, rng_, cur_scale_, extra_, m_loc):
+                key = jax.random.fold_in(rng_,
+                                         jax.lax.axis_index(DATA_AXIS))
+
+                def scaled_loss(m):
+                    leaves = _gather_cast_leaves(m, remat=True)
+                    p = jax.tree_util.tree_unflatten(param_treedef,
+                                                     list(leaves))
+                    loss = self._loss_fn(p, batch_, rng=key, train=True,
+                                         **extra_)
+                    return (loss.astype(jnp.float32) * cur_scale_) / grad_acc
+
+                sloss, g_loc = jax.value_and_grad(scaled_loss)(m_loc)
+                # the all_gather transpose delivers the cross-rank SUM
+                # of gradient shards; ×1/dp makes it the dp mean
+                return (jax.lax.pmean(sloss, DATA_AXIS),
+                        g_loc * jnp.float32(1.0 / dp))
+
+            sloss, flat_g = shard_map(
+                body, mesh=mesh,
+                in_specs=(P(DATA_AXIS), rep_spec, rep_spec, rep_spec,
+                          P(DATA_AXIS)),
+                out_specs=(rep_spec, P(DATA_AXIS)),
+                axis_names={DATA_AXIS}, check_vma=False)(
+                batch, rng, cur_scale, extra, master)
+            return sloss * grad_acc / cur_scale, flat_g, {}
+
         def loss_and_flat_grads(params, batch, rng, cur_scale, extra):
             if sparse_paths:
                 return sparse_loss_and_flat_grads(params, batch, rng,
                                                   cur_scale, extra)
+            if stage3_overlap:
+                # ``params`` IS the sharded flat master here — gathers
+                # happen inside the differentiated body
+                return zero3_loss_and_flat_grads(params, batch, rng,
+                                                 cur_scale, extra)
             if comm_overlap:
                 return bucketed_loss_and_flat_grads(params, batch, rng,
                                                     cur_scale, extra)
@@ -2997,7 +3146,10 @@ class DeepSpeedEngine:
             # trace-time: mesh-aware ops (ring attention) resolve THIS
             # engine's mesh even when several engines coexist in-process
             set_current_mesh(mesh)
-            params = cast_params(params_or_master) if stage3 else params_or_master
+            # stage3_overlap passes the sharded master straight through:
+            # zero3_loss_and_flat_grads gathers per group inside
+            params = (params_or_master if not stage3 or stage3_overlap
+                      else cast_params(params_or_master))
             return loss_and_flat_grads(params, batch, rng, cur_scale, extra)
 
         self._fwd_bwd_fn = self.memory_ledger.wrap(
@@ -3150,7 +3302,15 @@ class DeepSpeedEngine:
                        hostgrad, qres):
             set_current_mesh(mesh)
             cur_scale = scale_state.cur_scale
-            fwd_params = cast_params(master) if stage3 else params
+            # stage3_overlap: the forward consumes the sharded master
+            # directly (zero3_loss_and_flat_grads gathers per group
+            # just in time); naive stage 3 gathers up front via
+            # cast_params' lazy GSPMD path
+            if stage3:
+                fwd_params = master if stage3_overlap else \
+                    cast_params(master)
+            else:
+                fwd_params = params
             batches = _unpack_batches(packed, unpack_spec)
             rng = jax.random.fold_in(base_rng,
                                      ustep * jnp.uint32(acc_steps))
@@ -3206,7 +3366,8 @@ class DeepSpeedEngine:
 
             upd = apply_update(master, opt_state, scale_state, skipped,
                                flat_g, hp, segment_ids, qres=qres,
-                               want_cast=offload_stream or comm_overlap)
+                               want_cast=(offload_stream or comm_overlap)
+                               and not stage3)
             (master, opt_state, scale_state, skipped, overflow,
              gnorm, qres) = upd[:7]
             if stage3:

@@ -252,6 +252,11 @@ _LEG_FIELDS = {
     "dsp_violations": numbers.Integral,
     # sharding residency receipt (round 17, DSS8xx)
     "param_bytes_per_device": numbers.Integral,
+    # stage-3 ÷dp receipt (round 20): the global parameter bytes the
+    # per-device residency divides out of, and the shard divisor the
+    # leg proved (== dp under zero_optimization.stage 3)
+    "param_bytes_global": numbers.Integral,
+    "shard_divisor": numbers.Integral,
     # overlap receipts (round 11)
     "exposed_wire_seconds": numbers.Real,
     "overlap_fraction": numbers.Real,
@@ -439,6 +444,10 @@ _LEG_FIELD_THRESHOLDS = {
     "comm_wire_bytes": ("lower", 0.25),
     "dsp_violations": ("lower", 0.0),
     "param_bytes_per_device": ("lower", 0.10),
+    # stage-3 ÷dp receipt (round 20): the divisor can only grow (a drop
+    # back to 1 is the sharding silently un-landing); global bytes are
+    # informational (they track the dryrun model, not code quality)
+    "shard_divisor": ("higher", 0.0),
     "exposed_wire_seconds": ("lower", 0.25),
     "overlap_fraction": ("higher", 0.10),
     # informational since round 16: the dryrun legs' predicted step

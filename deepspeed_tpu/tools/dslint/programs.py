@@ -952,14 +952,22 @@ def exposure_metric_key(name: str) -> str:
     return f"<programs>|exposed_wire_seconds|{name}"
 
 
-def comm_exposure_metric_key(name: str) -> str:
+def comm_exposure_metric_key(name: str, tag=None) -> str:
     """Baseline ``metrics`` key for one program's exposed COLLECTIVE
     wire under a declared overlap_comm schedule.  A distinct metric
     name, not a reuse of :func:`exposure_metric_key`: the checked-in
     baseline records the offload fixture's host-stream exposure and the
     zero-2 fixture's collective exposure for programs that share the
     ``train_step`` name — one key would collide across the two
-    recorded run dirs."""
+    recorded run dirs.  TAG-qualified when the artifact declares a
+    sharding tag (round 20: the zero-2-overlap AND stage-3 fixtures
+    both dump an overlapped ``train_step`` with the same model
+    geometry — a name-only key would be last-write-wins across the
+    recorded run dirs, corrupting whichever fixture regenerated
+    first); ``tag=None`` keeps the legacy name-only form for
+    artifacts without a declared sharding."""
+    if tag:
+        return f"<programs>|comm_exposed_wire_seconds|{tag}|{name}"
     return f"<programs>|comm_exposed_wire_seconds|{name}"
 
 
@@ -973,7 +981,8 @@ def _exposure_keys(artifact):
     if artifact.host_state_wire_bytes:
         keys.append(exposure_metric_key(artifact.name))
     if (artifact.collective_schedule or {}).get("overlap"):
-        keys.append(comm_exposure_metric_key(artifact.name))
+        keys.append(comm_exposure_metric_key(artifact.name,
+                                             _sharding_tag(artifact)))
     return keys
 
 

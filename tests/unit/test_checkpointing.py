@@ -64,6 +64,46 @@ def test_elastic_dp_degree_change(cpu_devices, tmp_path):
     np.testing.assert_allclose(new_losses, ref_losses, rtol=1e-5)
 
 
+@pytest.mark.parametrize("offload", ["none", "save", "load"])
+@pytest.mark.parametrize("load_stage", [2, 3])
+@pytest.mark.parametrize("save_stage", [2, 3])
+def test_cross_stage_checkpoint_matrix(save_stage, load_stage, offload,
+                                       cpu_devices, tmp_path):
+    """Round-20 cross-stage matrix: checkpoints are canonical unpadded
+    fp32 (PR 14 pattern), so stage-2 and stage-3 engines restore each
+    other BIT-exactly in both directions — across dp widths (save dp=4,
+    load dp=2: elastic) and across the offload layout (the pinned-host
+    flat master gathers/scatters through the same canonical form).
+    Loss continuity after restore rides the same data."""
+    def cfg(stage, off):
+        zo = {"stage": stage, "overlap_comm": "auto"}
+        if off:
+            zo["cpu_offload"] = True
+        return base_config(zero_optimization=zo)
+
+    def canonical_master(engine):
+        # the canonical unpadded fp32 vector — the checkpoint format,
+        # independent of dp padding, bucket layout, or host grouping
+        return np.asarray(engine.flat.gather_master_unpadded(
+            engine.state["master"]))
+
+    batches = random_batches(5, 16, HIDDEN, seed=3)
+    e1 = make_engine(cfg(save_stage, offload == "save"), cpu_devices,
+                     dp=1 if offload == "save" else 4)
+    run_steps(e1, batches[:3])
+    saved_master = canonical_master(e1)
+    e1.save_checkpoint(str(tmp_path))
+    ref = run_steps(e1, batches[3:])
+
+    e2 = make_engine(cfg(load_stage, offload == "load"), cpu_devices,
+                     dp=1 if offload == "load" else 2)
+    path, _ = e2.load_checkpoint(str(tmp_path))
+    assert path is not None
+    np.testing.assert_array_equal(canonical_master(e2), saved_master)
+    new = run_steps(e2, batches[3:])
+    np.testing.assert_allclose(new, ref, rtol=2e-5)
+
+
 def test_load_without_optimizer_states(cpu_devices, tmp_path):
     config = base_config(zero_optimization={"stage": 1}, bf16={"enabled": True})
     e1 = make_engine(config, cpu_devices)

@@ -288,6 +288,39 @@ def test_overlap_comm_true_raises_on_unsupported(cpu_devices):
              optimizer={"type": "Lamb", "params": {"lr": 1e-3}})
 
 
+def test_stage3_unmet_requirements_raise_loudly(cpu_devices):
+    """Round-20 contract: stage 3 never silently degrades — an
+    unsupported composition raises a ValueError NAMING the unmet
+    requirement (no 'stage 3 not supported' stubs remain)."""
+    mesh4 = make_mesh({"data": 4}, devices=cpu_devices[:4])
+    model = SimpleModel(HIDDEN, nlayers=2)
+
+    def init(zero, mesh=mesh4, **over):
+        cfg = base_config(steps_per_print=10 ** 9,
+                          zero_optimization=zero, **over)
+        return deepspeed.initialize(model=model, config=cfg, mesh=mesh)
+
+    # sparse row-sparse exchange cannot ride the ÷dp-sharded parameter
+    # space — the error says exactly that (and the fix)
+    with pytest.raises(ValueError, match=r"sparse_gradients: true "
+                                         r"requires ZeRO stage 0"):
+        init({"stage": 3}, sparse_gradients=True)
+    # explicit overlap_comm: true under stage 3 names the blocking
+    # requirement, same contract as the stage-2 arm above
+    with pytest.raises(ValueError, match="dp > 1"):
+        init({"stage": 3, "overlap_comm": True},
+             mesh=make_mesh({"data": 1}, devices=cpu_devices[:1]))
+    with pytest.raises(ValueError, match="cpu_offload"):
+        init({"stage": 3, "overlap_comm": True, "cpu_offload": True})
+    with pytest.raises(ValueError, match="pure data-parallel"):
+        init({"stage": 3, "overlap_comm": True},
+             mesh=make_mesh({"data": 2, "model": 2},
+                            devices=cpu_devices[:4]))
+    with pytest.raises(ValueError, match="Adam"):
+        init({"stage": 3, "overlap_comm": True},
+             optimizer={"type": "Lamb", "params": {"lr": 1e-3}})
+
+
 def test_overlap_comm_config_validation():
     from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
 

@@ -79,7 +79,7 @@ def test_engine_sparse_gradients_wiring(cpu_devices):
 
     config2 = base_config(sparse_gradients=True,
                           zero_optimization={"stage": 2})
-    with pytest.raises(AssertionError, match="not supported with ZeRO"):
+    with pytest.raises(ValueError, match=r"sparse_gradients: true requires ZeRO stage 0"):
         deepspeed.initialize(model=SimpleModel(16, nlayers=2),
                              config=config2, mesh=mesh)
 

@@ -108,26 +108,40 @@ def test_checked_in_baseline_is_empty_of_violations():
     # its two serve programs pin their serve|data1 residency the same
     # way — no exposure/attribution keys (no host stream, no
     # overlapped collective schedule on the serve programs)
+    # round 20 added the stage-3 fixture (same geometry/buckets as
+    # zero2_overlap) and TAG-qualified the comm-exposure keys: two
+    # overlapped train_step programs now coexist, and a name-only key
+    # would be last-write-wins across the recorded run dirs
     keys = {exposure_metric_key("train_step"),
             predicted_step_metric_key("train_step"),
-            comm_exposure_metric_key("train_step"),
-            comm_exposure_metric_key("cast_params"),
+            comm_exposure_metric_key("train_step", "zero2|data4"),
+            comm_exposure_metric_key("cast_params", "zero2|data4"),
+            comm_exposure_metric_key("train_step", "zero3|data4"),
             sharding_metric_key("zero2-offload|data1", "train_step"),
             sharding_metric_key("zero2|data4", "train_step"),
+            sharding_metric_key("zero3|data4", "train_step"),
             sharding_metric_key("serve|data1", "serve_decode"),
             sharding_metric_key("serve|data1", "serve_prefill_16")}
     assert set(metrics) == keys, (
         "the baseline records exactly the offload-step exposed-wire + "
-        "attribution ratchet metrics, the zero-2 overlap fixture's "
+        "attribution ratchet metrics, the overlap fixtures' "
         "collective-exposure metrics, and the fixtures' DSS803 "
         f"param-bytes pins ({sorted(keys)}); anything else needs "
         "review")
     for key in keys:
         assert metrics[key] > 0
-    # the two fixtures share SimpleModel(256, nlayers=8) with
-    # replicated params: both pins state the same full byte count
+    # the zero2/offload fixtures share SimpleModel(256, nlayers=8)
+    # with replicated params: both pins state the same full byte count
     pb = metrics[sharding_metric_key("zero2|data4", "train_step")]
     assert pb == 8 * (256 * 256 + 256) * 4
+    # the stage-3 pin is the SAME model's flat master ÷dp: 520 leaf
+    # rows pad to 528 over 4 buckets × dp=4 (132 rows each), so the
+    # per-device claim is 528 × 1024 lanes × 4 B / 4 — the replicated
+    # 2105344-byte figure shrunk to a quarter (modulo dp padding), the
+    # ÷dp receipt of ROADMAP item 2 as a checked-in ratchet
+    pb3 = metrics[sharding_metric_key("zero3|data4", "train_step")]
+    assert pb3 == 528 * 1024 * 4 // 4
+    assert pb3 < pb / 3
     assert main([PKG_DIR, "--baseline", baseline]) == 0
 
 

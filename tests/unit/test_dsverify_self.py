@@ -317,6 +317,96 @@ def test_offload_serialized_control_trips_dso702_and_ratchet(
                         "--baseline", CHECKED_IN_BASELINE]) == 1
 
 
+def _zero3_engine(cpu_devices, tmp_path, run_name, overlap=True):
+    """The round-20 stage-3 fixture: the SAME geometry/buckets as
+    ``_zero2_overlap_engine`` but with sharded parameters — the flat
+    fp32 master is the only persistent parameter surface (÷dp
+    resident), and the step program issues the JIT per-group
+    all-gathers inline.  ``overlap=False`` is the serialized GSPMD
+    control (a single full-tensor gather schedule the analyzer must
+    flag)."""
+    cfg = _cfg(
+        tmp_path,
+        zero_optimization={"stage": 3, "overlap_comm": overlap,
+                           "reduce_bucket_size": 140000,
+                           "allgather_bucket_size": 280000},
+        gradient_clipping=1.0)
+    cfg["telemetry"]["run_dir"] = str(tmp_path / run_name)
+    mesh = make_mesh({"data": 4}, devices=cpu_devices[:4])
+    engine, *_ = deepspeed.initialize(
+        model=SimpleModel(256, nlayers=8), config=cfg, mesh=mesh)
+    engine.train_batch(iter([random_batches(
+        1, engine.train_micro_batch_size_per_gpu() * 4, 256,
+        seed=0)[0]]))
+    return engine
+
+
+def test_zero3_step_programs_verify_clean(cpu_devices, tmp_path):
+    """Round-20 acceptance criterion, overlap+sharding side: the
+    stage-3 step — JIT per-group parameter all-gathers in forward
+    order, rematerialized on backward, gradients arriving reduced AND
+    sharded through the all-gather transpose — verifies CLEAN.  DSO701
+    quiet, DSS801 clean with the ÷dp residency receipt
+    (param_shard_divisor == dp), bare ``--programs`` exit 0, and the
+    checked-in baseline's tag-qualified pins hold."""
+    engine = _zero3_engine(cpu_devices, tmp_path, "run")
+    assert engine.comm_overlap_enabled()
+    sched = engine.collective_schedule()
+    assert sched["overlap"] is True and sched["param_gathers"] is True
+    assert sched["rs_buckets"] == 4 and sched["ag_buckets"] == 2, sched
+    assert sched["gather_bytes"] > 0
+    report = _assert_clean(engine)
+    assert report["overlap"] is not None
+    agg = report["overlap"]
+    assert agg["exposed_wire_seconds"] < agg["wire_seconds"]
+    sh = report["sharding"]["train_step"]
+    assert sh["param_shard_divisor"] == 4
+    # the ÷dp receipt: 528 padded rows × 1024 lanes × 4 B over dp=4
+    assert sh["param_bytes_per_device"] == 528 * 1024 * 4 // 4
+    receipt = engine.overlap_receipt()
+    assert receipt["program"] == "train_step"
+    assert 0 < receipt["exposed_wire_seconds"] < receipt["wire_seconds"]
+    assert 0 < receipt["overlap_fraction"] < 1.0
+    engine.close()
+    assert dslint_main(["--programs", str(tmp_path / "run")]) == 0
+    assert dslint_main(["--programs", str(tmp_path / "run"),
+                        "--baseline", CHECKED_IN_BASELINE]) == 0
+
+
+def test_zero3_serialized_control_trips_dso701_and_ratchet(
+        cpu_devices, tmp_path):
+    """``overlap_comm: false`` under stage 3 — the serialized control:
+    parameters still shard ÷dp but the gathers ride the un-bucketed
+    GSPMD schedule.  DSO701 must fire on the fused step with a NONZERO
+    independent-compute window, its exposed wire must be STRICTLY
+    higher than the overlapped schedule's, and the checked-in baseline
+    must NOT absolve it."""
+    eng_on = _zero3_engine(cpu_devices, tmp_path, "run_on")
+    on = eng_on.overlap_receipt()
+    eng_on.close()
+    eng_off = _zero3_engine(cpu_devices, tmp_path, "run_off",
+                            overlap=False)
+    assert not eng_off.comm_overlap_enabled()
+    report = eng_off.verify_programs()
+    dso701 = [d for d in report["diagnostics"]
+              if d.rule_id == "DSO701"]
+    assert dso701 and any("[train_step]" in d.message
+                          for d in dso701), [
+        d.format() for d in report["diagnostics"]]
+    msg = next(d.message for d in dso701 if "[train_step]" in d.message)
+    import re as _re
+
+    m = _re.search(r"up to ([0-9.]+) ms of independent compute", msg)
+    assert m and float(m.group(1)) > 0, msg
+    off = eng_off.overlap_receipt()
+    eng_off.close()
+    assert on["exposed_wire_seconds"] < off["exposed_wire_seconds"]
+    assert on["overlap_fraction"] > off["overlap_fraction"]
+    assert dslint_main(["--programs", str(tmp_path / "run_off")]) == 1
+    assert dslint_main(["--programs", str(tmp_path / "run_off"),
+                        "--baseline", CHECKED_IN_BASELINE]) == 1
+
+
 def test_serving_decode_programs_verify_clean(cpu_devices, tmp_path):
     """Round-17 serving leg of the self-verify suite: the paged-KV
     decode/prefill programs carry a declared spec (``serve|data1`` —

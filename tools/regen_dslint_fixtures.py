@@ -13,6 +13,13 @@ compiles fresh each run:
 - ``zero2_overlap``  — dp=4 bucketed-exchange ZeRO-2
   (reduce_bucket_size=140000 / allgather_bucket_size=280000), the
   ``_zero2_overlap_engine`` fixture;
+- ``zero3``          — dp=4 stage-3 sharded parameters (same bucket
+  geometry; JIT per-group all-gathers inside the step), the
+  ``_zero3_engine`` fixture — its DSS803 pin records the ÷dp
+  ``param_bytes_per_device`` next to the zero2 fixture's replicated
+  figure, and its comm-exposure pin rides the TAG-qualified key
+  (``zero3|data4``) so the two overlapped ``train_step`` programs
+  never collide in the baseline;
 - ``serving``        — the single-replica continuous-batching
   inference engine (tiny GPT-2, one prefill bucket + the donated
   decode program, ``inference.slo`` armed), so ``dslint --all``
@@ -103,6 +110,25 @@ def _build_engines(tmp):
         seed=0)[0]]))
     engine.close()
     runs["zero2_overlap"] = os.path.join(tmp, "zero2_overlap")
+
+    # -- zero3: the stage-3 sharded-parameter fixture (round 20) ------
+    # same geometry/buckets as zero2_overlap so the DSS803 pin states
+    # the ÷dp claim directly against the stage-2 fixture's figure:
+    # params are the flat fp32 master (528 padded rows × 1024 lanes ×
+    # 4 B = 2162688 global) sharded over dp=4 → 540672 bytes/device
+    c = cfg("zero3",
+            zero_optimization={"stage": 3, "overlap_comm": True,
+                               "reduce_bucket_size": 140000,
+                               "allgather_bucket_size": 280000},
+            gradient_clipping=1.0)
+    mesh = make_mesh({"data": 4}, devices=devices[:4])
+    engine, *_ = deepspeed.initialize(
+        model=SimpleModel(256, nlayers=8), config=c, mesh=mesh)
+    engine.train_batch(iter([random_batches(
+        1, engine.train_micro_batch_size_per_gpu() * 4, 256,
+        seed=0)[0]]))
+    engine.close()
+    runs["zero3"] = os.path.join(tmp, "zero3")
 
     # -- serving: the inference-engine sidecar (round 19) -------------
     from deepspeed_tpu.inference import InferenceEngine
